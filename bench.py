@@ -1,0 +1,72 @@
+"""Benchmark: PHOLD events/sec on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no performance numbers (BASELINE.md); the
+recorded value is raw engine throughput (events/sec/chip) on the PHOLD
+DES stress workload, and vs_baseline reports the simulated-seconds per
+wallclock-second ratio (the north-star metric per BASELINE.json).
+"""
+
+import json
+import sys
+import time
+
+
+def main():
+    num_hosts = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    stop_s = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+
+    import jax
+    from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario
+    from shadow_tpu.engine.sim import Simulation
+    from shadow_tpu.engine.state import EngineConfig
+
+    topo = """
+<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="d7"/>
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d9"/>
+  <key attr.name="packetloss" attr.type="double" for="node" id="d0"/>
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d4"/>
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d3"/>
+  <graph edgedefault="undirected">
+    <node id="poi"><data key="d0">0.0</data>
+      <data key="d3">102400</data><data key="d4">102400</data></node>
+    <edge source="poi" target="poi"><data key="d7">25.0</data>
+      <data key="d9">0.0</data></edge>
+  </graph>
+</graphml>
+"""
+    scen = Scenario(
+        stop_time=stop_s * 10**9,
+        topology_graphml=topo,
+        hosts=[HostSpec(id="node", quantity=num_hosts, processes=[
+            ProcessSpec(plugin="phold", start_time=10**9,
+                        arguments="port=9000 mean=500ms size=64 init=1")])],
+    )
+
+    cfg = EngineConfig(num_hosts=num_hosts, qcap=16, scap=4, obcap=8,
+                       incap=16, chunk_windows=32)
+
+    # Warm-up run at identical array shapes but a tiny stop time:
+    # stop_time is a dynamic scalar, so this compiles the full window
+    # program without recompiling for the measured run below.
+    import copy
+    warm_scen = copy.deepcopy(scen)
+    warm_scen.stop_time = int(1.2 * 10**9)
+    Simulation(warm_scen, engine_cfg=cfg).run()
+
+    report = Simulation(scen, engine_cfg=cfg).run()
+    s = report.summary()
+
+    print(json.dumps({
+        "metric": f"phold-{num_hosts} events/sec/chip",
+        "value": round(s["events_per_sec"], 1),
+        "unit": "events/s",
+        "vs_baseline": round(s["speedup"], 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""shadow-tpu: a TPU-native discrete-event network simulator.
+
+A ground-up JAX/XLA redesign with the capabilities of the Shadow
+simulator (reference surveyed in SURVEY.md): hundreds of thousands of
+simulated hosts, each with a virtual TCP/UDP stack, bandwidth-modeled
+NIC, CPU model and application behavior, connected by weighted Internet
+topologies with latency and packet loss.
+
+Architecture (vs. the reference's callback/event-object design):
+- per-host event queues are fixed-capacity struct-of-arrays in device
+  memory; the scheduler's pop-min becomes a vectorized reduction;
+- the conservative lookahead window barrier (reference master/scheduler
+  round loop) becomes a jnp.min / lax.pmin reduction over the mesh;
+- cross-host packet sends buffer into per-host outboxes and are
+  exchanged at window boundaries (the reference's "bump to barrier"
+  causality rule, shd-scheduler-policy-host-single.c:171-175);
+- TCP/UDP/NIC/app logic runs as branchless-ish vectorized kernels under
+  vmap/shard_map instead of per-connection callbacks.
+"""
+
+# Simulation time is int64 nanoseconds; JAX must be in x64 mode before
+# any arrays are created.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

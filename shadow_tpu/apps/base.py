@@ -1,0 +1,69 @@
+"""App framework: vectorized per-host application state machines.
+
+The reference hosts real ELF binaries in linker namespaces with green
+threads (/root/reference/src/main/host/shd-process.c); the TPU-resident
+app tier replaces that with fixed state machines dispatched by app kind
+through lax.switch — the engine's EV_APP handler calls
+:func:`dispatch`, which runs the app registered for this host.
+
+App calling convention (all row-level under vmap):
+    app(row, hp, sh, now, wake) -> row
+where ``wake`` is a packet-word vector: ACK = wake reason (defs.WAKE_*),
+SEQ = socket slot (or -1), and for packet-triggered wakes the original
+SRC/SPORT/DPORT/LEN/AUX words are preserved.
+
+Apps keep their dynamic state in row.app_node (phase) and row.app_r
+(eight int64 registers); static per-host parameters come from
+hp.app_cfg (eight int64s compiled from the scenario config).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import rng as R
+from ..net import packet as P
+from ..engine import equeue
+from ..engine.defs import EV_APP, WAKE_TIMER
+
+# App kind registry. Order is the lax.switch index — append only.
+APP_NULL = 0
+APP_PING = 1
+APP_PING_SERVER = 2
+APP_PHOLD = 3
+APP_TGEN = 4
+N_APP_KINDS = 5
+
+
+def app_null(row, hp, sh, now, wake):
+    return row
+
+
+def draw(row, hp, sh):
+    """Draw one uniform [0,1) float deterministically for this host.
+    Returns (row, u)."""
+    key = R.counter_key(R.host_key(sh.rng_root, hp.hid), row.rng_ctr)
+    return row.replace(rng_ctr=row.rng_ctr + 1), jax.random.uniform(key)
+
+
+def schedule_wake(row, t, reason, sock=-1, aux=0):
+    """Push a future EV_APP (app timer) for this host."""
+    wake = (jnp.zeros((P.PKT_WORDS,), jnp.int32)
+            .at[P.ACK].set(jnp.int32(reason))
+            .at[P.SEQ].set(jnp.int32(sock))
+            .at[P.AUX].set(jnp.int32(aux)))
+    return equeue.q_push(row, t, EV_APP, wake)
+
+
+def timer(row, t, aux=0):
+    return schedule_wake(row, t, WAKE_TIMER, aux=aux)
+
+
+def dispatch(row, hp, sh, now, wake):
+    """EV_APP entry: route to this host's app by kind."""
+    from .ping import app_ping, app_ping_server
+    from .phold import app_phold
+    from .tgen import app_tgen
+    branches = [app_null, app_ping, app_ping_server, app_phold, app_tgen]
+    return jax.lax.switch(hp.app_kind, branches, row, hp, sh, now, wake)

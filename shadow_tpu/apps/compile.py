@@ -1,0 +1,59 @@
+"""Compile scenario process specs into per-host app wiring.
+
+The reference launches real plugin binaries with an argv string
+(shd-configuration.h process element); the TPU app tier instead maps
+each plugin id to a vectorized app kind plus eight int64 config words
+(HostParams.app_cfg). Arguments use `key=value` pairs; hostnames
+resolve through the virtual DNS.
+
+Builtin plugins:
+  ping        peer=<host> port=N interval=<time> size=BYTES count=N
+  pingserver  port=N
+  phold       port=N mean=<time> size=BYTES init=N
+  tgen        <behavior graphml path>   (tgen milestone)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.simtime import parse_time
+from .base import APP_PING, APP_PING_SERVER, APP_PHOLD, APP_TGEN
+
+
+def parse_kv(args: str) -> dict:
+    out = {}
+    for tok in args.split():
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            out[k] = v
+        else:
+            out.setdefault("_positional", []).append(tok)
+    return out
+
+
+def compile_app(plugin: str, args: str, dns, num_hosts: int):
+    """-> (app_kind, cfg[8] int64) for one process spec."""
+    cfg = np.zeros(8, dtype=np.int64)
+    kv = parse_kv(args)
+    if plugin == "ping":
+        cfg[0] = dns.resolve(kv["peer"])
+        cfg[1] = int(kv.get("port", 8000))
+        cfg[2] = parse_time(kv.get("interval", "1s"))
+        cfg[3] = int(kv.get("size", 64))
+        cfg[4] = int(kv.get("count", 0))
+        return APP_PING, cfg
+    if plugin == "pingserver":
+        cfg[1] = int(kv.get("port", 8000))
+        return APP_PING_SERVER, cfg
+    if plugin == "phold":
+        cfg[0] = num_hosts
+        cfg[1] = int(kv.get("port", 8000))
+        cfg[2] = parse_time(kv.get("mean", "100ms"))
+        cfg[3] = int(kv.get("size", 64))
+        cfg[4] = int(kv.get("init", 1))
+        return APP_PHOLD, cfg
+    if plugin == "tgen":
+        return APP_TGEN, cfg
+    raise ValueError(f"unknown plugin {plugin!r} "
+                     "(builtin: ping, pingserver, phold, tgen)")

@@ -1,0 +1,70 @@
+"""PHOLD: the classic parallel-DES stress benchmark.
+
+Mirrors the reference's phold plugin (/root/reference/src/test/phold/
+shd-test-phold.c): every host holds messages; on receiving one it
+schedules a send to a uniformly random peer after an exponential delay.
+Doubles as the scheduler/exchange stress test, exactly as in the
+reference's test suite.
+
+Config (hp.app_cfg): c0=num hosts, c1=port, c2=mean delay ns,
+c3=payload bytes, c4=initial messages per host.
+Registers: r0=socket, r1=messages sent.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..engine.defs import WAKE_START, WAKE_TIMER, WAKE_SOCKET
+from ..net import packet as P
+from ..net.udp import udp_open, udp_sendto
+from .base import draw, timer
+
+
+def _exp_delay(row, hp, sh):
+    """Exponential delay with mean c2 (ns), minimum 1ns."""
+    row, u = draw(row, hp, sh)
+    mean = hp.app_cfg[2].astype(jnp.float32)
+    d = (-mean * jnp.log1p(-u)).astype(jnp.int64)
+    return row, jnp.maximum(d, 1)
+
+
+def _send_to_random_peer(row, hp, sh, now):
+    row, u = draw(row, hp, sh)
+    n = hp.app_cfg[0]
+    peer = jnp.minimum((u * n).astype(jnp.int64), n - 1)
+    # avoid self as the reference does by redrawing — here: shift by one
+    peer = jnp.where(peer == hp.hid, (peer + 1) % n, peer)
+    sock = row.app_r[0].astype(jnp.int32)
+    row = udp_sendto(row, hp, now, sock, dst_host=peer,
+                     dst_port=hp.app_cfg[1], nbytes=hp.app_cfg[3])
+    return row.replace(app_r=row.app_r.at[1].add(1))
+
+
+def app_phold(row, hp, sh, now, wake):
+    reason = wake[P.ACK]
+
+    def on_start(r):
+        r, sock, ok = udp_open(r, port=hp.app_cfg[1])
+        r = r.replace(app_r=r.app_r.at[0].set(jnp.int64(sock)))
+
+        # seed the system with c4 initial messages at exponential offsets
+        def seed_one(i, rr):
+            rr, d = _exp_delay(rr, hp, sh)
+            return timer(rr, now + d)
+        n0 = hp.app_cfg[4].astype(jnp.int32)
+        return jax.lax.fori_loop(0, n0, seed_one, r)
+
+    def on_timer(r):
+        return _send_to_random_peer(r, hp, sh, now)
+
+    def on_msg(r):
+        # a message arrived: schedule the next hop after an exp delay
+        r, d = _exp_delay(r, hp, sh)
+        return timer(r, now + d)
+
+    return jax.lax.switch(
+        jnp.clip(reason, 0, 2),
+        [on_start, on_timer, on_msg],
+        row)

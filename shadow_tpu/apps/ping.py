@@ -1,0 +1,96 @@
+"""Ping/echo over UDP: the minimum end-to-end workload.
+
+This is the 2-node ping/echo config from BASELINE.json (config #1) and
+the vectorized analogue of a trivial tgen client/server pair.
+
+Client config (hp.app_cfg): c0=peer host id, c1=server port,
+c2=interval ns, c3=payload bytes, c4=ping count (0 = until sim end).
+Client registers: r0=socket, r1=sent, r2=received.
+Server config: c1=listen port. Registers: r0=socket.
+
+RTT samples accumulate into stats ST_RTT_SUM_US / ST_RTT_COUNT; the
+send timestamp rides the datagram's AUX tag in microseconds (mod 2^31).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.simtime import SIMTIME_ONE_MICROSECOND
+from ..engine.defs import (WAKE_START, WAKE_TIMER, WAKE_SOCKET,
+                           ST_RTT_SUM_US, ST_RTT_COUNT, ST_XFER_DONE, ST_APP_DONE)
+from ..net import packet as P
+from ..net.udp import udp_open, udp_sendto
+from .base import timer
+
+_US_MOD = jnp.int64(2**31)
+
+
+def _us31(t_ns):
+    return (t_ns // SIMTIME_ONE_MICROSECOND) % _US_MOD
+
+
+def _send_ping(row, hp, now):
+    """Send one ping and arm the next-send timer at a fixed interval —
+    the send clock is independent of echo arrival, so a lost packet
+    never stalls the client and the send rate is exactly 1/interval."""
+    sock = row.app_r[0].astype(jnp.int32)
+    row = udp_sendto(row, hp, now, sock,
+                     dst_host=hp.app_cfg[0], dst_port=hp.app_cfg[1],
+                     nbytes=hp.app_cfg[3], aux=_us31(now))
+    row = row.replace(app_r=row.app_r.at[1].add(1))
+    limit = hp.app_cfg[4]
+    more = (limit == 0) | (row.app_r[1] < limit)
+    return jax.lax.cond(more, lambda r: timer(r, now + hp.app_cfg[2]),
+                        lambda r: r, row)
+
+
+def app_ping(row, hp, sh, now, wake):
+    reason = wake[P.ACK]
+
+    def on_start(r):
+        r, sock, ok = udp_open(r)
+        r = r.replace(app_r=r.app_r.at[0].set(jnp.int64(sock)))
+        return _send_ping(r, hp, now)
+
+    def on_timer(r):
+        return _send_ping(r, hp, now)
+
+    def on_echo(r):
+        rtt_us = (_us31(now) - jnp.int64(wake[P.AUX])) % _US_MOD
+        r = r.replace(
+            app_r=r.app_r.at[2].add(1),
+            stats=r.stats.at[ST_RTT_SUM_US].add(rtt_us)
+                         .at[ST_RTT_COUNT].add(1)
+                         .at[ST_XFER_DONE].add(1))
+        limit = hp.app_cfg[4]
+        done = (limit > 0) & (r.app_r[2] >= limit)
+        return r.replace(stats=r.stats.at[ST_APP_DONE].add(
+            jnp.where(done, 1, 0)))
+
+    return jax.lax.switch(
+        jnp.clip(reason, 0, 2),
+        [on_start, on_timer, on_echo],  # WAKE_START, WAKE_TIMER, WAKE_SOCKET
+        row)
+
+
+def app_ping_server(row, hp, sh, now, wake):
+    reason = wake[P.ACK]
+
+    def on_start(r):
+        r, sock, ok = udp_open(r, port=hp.app_cfg[1])
+        return r.replace(app_r=r.app_r.at[0].set(jnp.int64(sock)))
+
+    def on_dgram(r):
+        # echo the payload back to the sender, preserving the AUX tag
+        sock = wake[P.SEQ]
+        return udp_sendto(r, hp, now, sock,
+                          dst_host=wake[P.SRC], dst_port=wake[P.SPORT],
+                          nbytes=jnp.int64(wake[P.LEN]), aux=wake[P.AUX])
+
+    is_start = reason == WAKE_START
+    return jax.lax.cond(is_start, on_start,
+                        lambda r: jax.lax.cond(reason == WAKE_SOCKET,
+                                               on_dgram, lambda rr: rr, r),
+                        row)

@@ -1,0 +1,18 @@
+"""tgen: vectorized traffic-generator behavior graphs.
+
+Reimplements the logic of the reference's bundled tgen plugin
+(/root/reference/src/plugin/shadow-plugin-tgen/, 5.7k LoC): igraph-
+described behavior graphs whose nodes are start / transfer / pause /
+end actions walked by each client, driving TCP transfers against tgen
+servers. Here the graph is compiled to device tables and every host
+walks its graph as a state machine.
+
+Lands with the tgen milestone (after TCP); the dispatch stub keeps the
+app registry complete.
+"""
+
+from __future__ import annotations
+
+
+def app_tgen(row, hp, sh, now, wake):
+    return row

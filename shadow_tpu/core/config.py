@@ -1,0 +1,161 @@
+"""Scenario configuration.
+
+Two front ends, one typed model:
+
+- :func:`load_xml` parses the reference's ``shadow.config.xml`` schema
+  (elements and attributes per
+  /root/reference/src/main/core/support/shd-configuration.h:36-95 /
+  shd-configuration.c): ``<shadow stoptime bootstraptime preload>``,
+  ``<topology path=... | CDATA>``, ``<plugin id path>``,
+  ``<host id quantity iphint geocodehint typehint bandwidthup
+  bandwidthdown cpufrequency loglevel ...>`` containing
+  ``<process plugin starttime stoptime arguments>``.
+- Plain Python construction of the same dataclasses (the native API).
+
+Bandwidth attributes are KiB/s in the XML (reference semantics); we store
+bytes/sec internally.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+from xml.etree import ElementTree
+
+from .simtime import parse_time
+
+
+@dataclass
+class ProcessSpec:
+    """One virtual process on a host (reference ConfigurationProcessElement)."""
+    plugin: str                  # plugin/app id, e.g. "tgen", "ping", "phold"
+    start_time: int = 0          # ns
+    stop_time: int = 0           # ns; 0 = run to simulation end
+    arguments: str = ""          # app-specific argument string
+
+
+@dataclass
+class HostSpec:
+    """One host template, expanded ``quantity`` times
+    (reference ConfigurationHostElement)."""
+    id: str
+    quantity: int = 1
+    processes: list = field(default_factory=list)
+    ip_hint: Optional[str] = None
+    geocode_hint: Optional[str] = None
+    type_hint: Optional[str] = None
+    bandwidth_down: Optional[int] = None   # bytes/sec; None = from topology vertex
+    bandwidth_up: Optional[int] = None     # bytes/sec
+    cpu_frequency: Optional[int] = None    # kHz, reference semantics
+    log_level: Optional[str] = None
+    pcap: bool = False
+    pcap_dir: Optional[str] = None
+    socket_recv_buffer: Optional[int] = None
+    socket_send_buffer: Optional[int] = None
+    interface_buffer: Optional[int] = None
+    autotune_recv_buffer: bool = True
+    autotune_send_buffer: bool = True
+
+
+@dataclass
+class PluginSpec:
+    id: str
+    path: str = ""
+
+
+@dataclass
+class Scenario:
+    stop_time: int                      # ns
+    topology_graphml: Optional[str] = None   # inline graphml text
+    topology_path: Optional[str] = None      # or a file path (.graphml[.xz])
+    hosts: list = field(default_factory=list)
+    plugins: list = field(default_factory=list)
+    bootstrap_end: int = 0
+    seed: int = 1
+
+    def total_hosts(self) -> int:
+        return sum(h.quantity for h in self.hosts)
+
+    def expand_hosts(self):
+        """Yield (flat_host_index, unique_name, HostSpec) with quantity
+        expansion. Names follow the reference's hostname scheme: a host
+        with quantity>1 gets a 1-based suffix (``web1``, ``web2``, ...;
+        reference shd-master.c host registration)."""
+        idx = 0
+        for spec in self.hosts:
+            for q in range(spec.quantity):
+                name = spec.id if spec.quantity == 1 else f"{spec.id}{q + 1}"
+                yield idx, name, spec
+                idx += 1
+
+
+_BOOL_TRUE = {"1", "true", "yes", "on"}
+
+
+def _get_time(attrs, key, default=0):
+    if key in attrs:
+        return parse_time(attrs[key], default_unit="s")
+    return default
+
+
+def _kib_to_bytes(v) -> int:
+    return int(v) * 1024
+
+
+def load_xml(source: str) -> Scenario:
+    """Parse a shadow.config.xml string or file path into a Scenario."""
+    if os.path.exists(source):
+        with open(source) as f:
+            text = f.read()
+    else:
+        text = source
+    root = ElementTree.fromstring(text)
+    if root.tag != "shadow":
+        raise ValueError(f"expected <shadow> root element, got <{root.tag}>")
+
+    scen = Scenario(stop_time=_get_time(root.attrib, "stoptime"))
+    scen.bootstrap_end = _get_time(root.attrib, "bootstraptime")
+
+    for el in root:
+        if el.tag == "topology":
+            if "path" in el.attrib:
+                scen.topology_path = el.attrib["path"]
+            elif el.text and el.text.strip():
+                scen.topology_graphml = el.text
+        elif el.tag == "plugin":
+            scen.plugins.append(
+                PluginSpec(id=el.attrib["id"], path=el.attrib.get("path", "")))
+        elif el.tag == "host" or el.tag == "node":
+            a = el.attrib
+            host = HostSpec(
+                id=a["id"],
+                quantity=int(a.get("quantity", 1) or 1),
+                ip_hint=a.get("iphint"),
+                geocode_hint=a.get("geocodehint"),
+                type_hint=a.get("typehint"),
+                bandwidth_down=_kib_to_bytes(a["bandwidthdown"]) if "bandwidthdown" in a else None,
+                bandwidth_up=_kib_to_bytes(a["bandwidthup"]) if "bandwidthup" in a else None,
+                cpu_frequency=int(a["cpufrequency"]) if "cpufrequency" in a else None,
+                log_level=a.get("loglevel"),
+                pcap=a.get("logpcap", "").lower() in _BOOL_TRUE,
+                pcap_dir=a.get("pcapdir"),
+                socket_recv_buffer=int(a["socketrecvbuffer"]) if "socketrecvbuffer" in a else None,
+                socket_send_buffer=int(a["socketsendbuffer"]) if "socketsendbuffer" in a else None,
+                interface_buffer=int(a["interfacebuffer"]) if "interfacebuffer" in a else None,
+            )
+            host.autotune_recv_buffer = host.socket_recv_buffer is None
+            host.autotune_send_buffer = host.socket_send_buffer is None
+            for pel in el:
+                if pel.tag in ("process", "application"):
+                    pa = pel.attrib
+                    host.processes.append(ProcessSpec(
+                        plugin=pa["plugin"],
+                        start_time=_get_time(pa, "starttime"),
+                        stop_time=_get_time(pa, "stoptime"),
+                        arguments=pa.get("arguments", ""),
+                    ))
+            scen.hosts.append(host)
+    if scen.stop_time <= 0:
+        raise ValueError("scenario requires a positive stoptime")
+    return scen

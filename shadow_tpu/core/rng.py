@@ -1,0 +1,73 @@
+"""Deterministic seed tree.
+
+The reference derives all randomness from one CLI seed through a chain of
+seeded rand_r generators (master -> slave -> scheduler/host,
+/root/reference/src/main/utility/shd-random.c plus shd-master.c:80,
+shd-slave.c:153, shd-host.c:272). We keep the same *shape* — one root
+seed deterministically fanning out to every consumer — but use JAX's
+counter-based threefry keys so randomness is order-independent and
+reproducible under any parallel schedule:
+
+    root = seed
+    host_key(h)         = fold_in(fold_in(root, DOMAIN_HOST), h)
+    per-use key         = fold_in(host_key, monotonic per-host counter)
+    packet drop key     = fold_in(fold_in(root, DOMAIN_DROP), packet uid)
+
+Everything that consumes randomness on-device uses these helpers, so two
+runs with the same seed produce bit-identical simulations regardless of
+sharding — a stronger guarantee than the reference, whose determinism
+holds only for a fixed worker count.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Domain separators for the fold_in tree.
+DOMAIN_HOST = 1
+DOMAIN_DROP = 2
+DOMAIN_APP = 3
+DOMAIN_TOPOLOGY = 4
+DOMAIN_JITTER = 5
+DOMAIN_PORT = 6
+
+
+def root_key(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(seed)
+
+
+def domain_key(root: jax.Array, domain: int) -> jax.Array:
+    return jax.random.fold_in(root, domain)
+
+
+def host_key(root: jax.Array, host_id) -> jax.Array:
+    """Per-host key; host_id may be a traced int32."""
+    return jax.random.fold_in(domain_key(root, DOMAIN_HOST), host_id)
+
+
+def counter_key(base: jax.Array, counter) -> jax.Array:
+    """Derive a fresh single-use key from a monotonic counter."""
+    return jax.random.fold_in(base, counter)
+
+
+def uniform_from(key: jax.Array) -> jax.Array:
+    """One uniform float32 in [0, 1)."""
+    return jax.random.uniform(key)
+
+
+def drop_decision(root: jax.Array, src_host, packet_uid, reliability) -> jax.Array:
+    """Bernoulli drop matching worker_sendPacket's reliability test
+    (/root/reference/src/main/core/shd-worker.c:238-244): the packet is
+    DELIVERED iff uniform() <= reliability. Keyed by the globally unique
+    (src_host, per-source packet counter) pair stamped at NIC emit —
+    engine.window.exchange uses the identical key derivation."""
+    k = counter_key(counter_key(domain_key(root, DOMAIN_DROP), src_host),
+                    packet_uid)
+    return jax.random.uniform(k) > reliability  # True = drop
+
+
+def bounded_int(key: jax.Array, lo: int, hi):
+    """Uniform integer in [lo, hi) — used for ephemeral port picks and
+    app-level random choices."""
+    return jax.random.randint(key, (), lo, hi, dtype=jnp.int32)

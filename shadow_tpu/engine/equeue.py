@@ -1,0 +1,70 @@
+"""Per-host event queue operations.
+
+The reference's scheduler policies keep one locked binary-heap priority
+queue per host and pop events while their time is under the round
+barrier (/root/reference/src/main/core/scheduler/
+shd-scheduler-policy-host-single.c:158-278). Here a host's queue is a
+fixed-capacity unsorted slot array; "pop min" is a lexicographic
+(time, seq) reduction — a handful of vectorized ops per host per event,
+which is what a TPU wants instead of pointer-chasing heaps. The
+(time, sequence) total order matches the reference's event_compare
+(shd-event.c:102).
+
+All functions here operate on a *row* (one host's slice of
+state.Hosts, as seen under vmap).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.simtime import SIMTIME_MAX
+from .defs import EV_NULL, ST_EQ_FULL_LOCAL
+
+_I32_MAX = jnp.int32(2**31 - 1)
+
+
+def q_push(row, t, kind, pkt):
+    """Push an event into the first free slot of this host's queue.
+
+    Returns the updated row. If the queue is full the event is dropped
+    and counted in ST_EQ_FULL_LOCAL — an explicit capacity budget where
+    the reference would malloc (overflow is visible in stats, never
+    silent).
+    """
+    free = row.eq_time == SIMTIME_MAX
+    has_free = jnp.any(free)
+    slot = jnp.argmax(free)  # first free slot
+    seq = row.eq_ctr
+
+    t_eff = jnp.where(has_free, jnp.int64(t), SIMTIME_MAX)
+    return row.replace(
+        eq_time=row.eq_time.at[slot].set(jnp.where(has_free, t_eff, row.eq_time[slot])),
+        eq_seq=row.eq_seq.at[slot].set(jnp.where(has_free, seq, row.eq_seq[slot])),
+        eq_kind=row.eq_kind.at[slot].set(jnp.where(has_free, jnp.int32(kind), row.eq_kind[slot])),
+        eq_pkt=row.eq_pkt.at[slot].set(jnp.where(has_free, pkt, row.eq_pkt[slot])),
+        eq_ctr=row.eq_ctr + 1,
+        stats=row.stats.at[ST_EQ_FULL_LOCAL].add(jnp.where(has_free, 0, 1)),
+    )
+
+
+def q_min(row):
+    """Lexicographic (time, seq) minimum. Returns (slot, time)."""
+    tmin = jnp.min(row.eq_time)
+    cand = row.eq_time == tmin
+    seq_key = jnp.where(cand, row.eq_seq, _I32_MAX)
+    slot = jnp.argmin(seq_key)
+    return slot, tmin
+
+
+def q_next_time(row):
+    """Earliest pending event time (SIMTIME_MAX if queue empty)."""
+    return jnp.min(row.eq_time)
+
+
+def q_clear_slot(row, slot):
+    """Free a slot after popping its event."""
+    return row.replace(
+        eq_time=row.eq_time.at[slot].set(SIMTIME_MAX),
+        eq_kind=row.eq_kind.at[slot].set(EV_NULL),
+    )

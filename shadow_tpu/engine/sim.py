@@ -1,0 +1,200 @@
+"""Simulation: scenario -> device state -> run loop -> report.
+
+This is the L6/L7 equivalent of the reference's Master/Slave
+(/root/reference/src/main/core/shd-master.c, shd-slave.c): it loads the
+scenario, builds the topology oracle and DNS, registers hosts and their
+processes, then drives the window loop. There is no worker-thread
+machinery to manage — the "scheduler" is the compiled window program of
+engine.window.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng as R
+from ..core.config import Scenario
+from ..core.constants import DEFAULT_MIN_TIME_JUMP, INTERFACE_BUFFER_SIZE
+from ..core.simtime import SIMTIME_MAX, SIMTIME_ONE_SECOND
+from ..routing.dns import DNS
+from ..routing.topology import Topology, attach_hosts, build_topology
+from ..apps.compile import compile_app
+from ..net.packet import PKT_WORDS
+from . import defs
+from .defs import EV_APP, WAKE_START, N_STATS
+from .state import EngineConfig, Hosts, HostParams, Shared, alloc_hosts, make_shared
+from .window import run_windows
+from ..net import packet as P
+
+
+@dataclass
+class SimReport:
+    """Aggregated results of a run."""
+    stats: np.ndarray          # [H, N_STATS]
+    host_names: list
+    sim_time_ns: int
+    wall_seconds: float
+    windows: int
+
+    def total(self, stat: int) -> int:
+        return int(self.stats[:, stat].sum())
+
+    @property
+    def events(self) -> int:
+        return self.total(defs.ST_EVENTS)
+
+    @property
+    def events_per_sec(self) -> float:
+        return self.events / self.wall_seconds if self.wall_seconds else 0.0
+
+    @property
+    def speedup(self) -> float:
+        """Simulated seconds per wallclock second."""
+        if not self.wall_seconds:
+            return 0.0
+        return (self.sim_time_ns / SIMTIME_ONE_SECOND) / self.wall_seconds
+
+    def summary(self) -> dict:
+        mean_rtt_us = (self.total(defs.ST_RTT_SUM_US) /
+                       max(self.total(defs.ST_RTT_COUNT), 1))
+        return {
+            "hosts": len(self.host_names),
+            "events": self.events,
+            "windows": self.windows,
+            "sim_seconds": self.sim_time_ns / SIMTIME_ONE_SECOND,
+            "wall_seconds": self.wall_seconds,
+            "events_per_sec": self.events_per_sec,
+            "speedup": self.speedup,
+            "pkts_sent": self.total(defs.ST_PKTS_SENT),
+            "pkts_recv": self.total(defs.ST_PKTS_RECV),
+            "drop_net": self.total(defs.ST_PKTS_DROP_NET),
+            "drop_buf": self.total(defs.ST_PKTS_DROP_BUF),
+            "drop_q": self.total(defs.ST_PKTS_DROP_Q),
+            "bytes_recv": self.total(defs.ST_BYTES_RECV),
+            "retransmits": self.total(defs.ST_RETRANSMIT),
+            "transfers_done": self.total(defs.ST_XFER_DONE),
+            "mean_rtt_us": mean_rtt_us,
+        }
+
+
+class Simulation:
+    """Build and run one scenario on the JAX engine."""
+
+    def __init__(self, scenario: Scenario, topology: Topology = None,
+                 engine_cfg: EngineConfig = None, seed: int = None):
+        self.scenario = scenario
+        seed = scenario.seed if seed is None else seed
+
+        src = topology or scenario.topology_graphml or scenario.topology_path
+        self.topo = src if isinstance(src, Topology) else build_topology(src)
+
+        H = scenario.total_hosts()
+        self.cfg = engine_cfg or EngineConfig(num_hosts=H)
+        assert self.cfg.num_hosts == H
+
+        # --- register hosts: DNS, attachment, apps (reference
+        # _master_registerHosts -> slave_addNewVirtualHost analogue) ---
+        self.dns = DNS()
+        names, hints = [], []
+        for idx, name, spec in scenario.expand_hosts():
+            names.append(name)
+            hints.append((spec.ip_hint, spec.geocode_hint, spec.type_hint))
+            self.dns.register(idx, name, spec.ip_hint if spec.quantity == 1 else None)
+        self.host_names = names
+
+        vertex = attach_hosts(self.topo, hints, seed=seed)
+
+        bw_up = np.zeros(H, dtype=np.int64)
+        bw_down = np.zeros(H, dtype=np.int64)
+        nic_buf = np.full(H, INTERFACE_BUFFER_SIZE, dtype=np.int64)
+        app_kind = np.zeros(H, dtype=np.int32)
+        app_cfg = np.zeros((H, 8), dtype=np.int64)
+        start_times = np.zeros((H,), dtype=np.int64)
+        has_app = np.zeros(H, dtype=bool)
+
+        for idx, name, spec in scenario.expand_hosts():
+            v = vertex[idx]
+            bw_up[idx] = spec.bandwidth_up or self.topo.v_bw_up_bytes[v] or 1 << 40
+            bw_down[idx] = spec.bandwidth_down or self.topo.v_bw_down_bytes[v] or 1 << 40
+            if spec.interface_buffer:
+                nic_buf[idx] = spec.interface_buffer
+            if spec.processes:
+                # TPU app tier: one process per host for now (multi-process
+                # hosts arrive with the hosting milestone)
+                proc = spec.processes[0]
+                kind, cfg_words = compile_app(proc.plugin, proc.arguments,
+                                              self.dns, H)
+                app_kind[idx] = kind
+                app_cfg[idx] = cfg_words
+                start_times[idx] = proc.start_time
+                has_app[idx] = True
+
+        self.hp = HostParams(
+            hid=jnp.arange(H, dtype=jnp.int32),
+            vertex=jnp.asarray(vertex, dtype=jnp.int32),
+            bw_up=jnp.asarray(bw_up),
+            bw_down=jnp.asarray(bw_down),
+            app_kind=jnp.asarray(app_kind),
+            app_cfg=jnp.asarray(app_cfg),
+            nic_buf=jnp.asarray(nic_buf),
+        )
+
+        min_jump = self.topo.min_latency_ns or DEFAULT_MIN_TIME_JUMP
+        self.sh = make_shared(self.topo.latency_ns, self.topo.reliability,
+                              R.root_key(seed), scenario.stop_time, min_jump)
+
+        # --- initial events: process starts (reference process_schedule) ---
+        hosts = alloc_hosts(self.cfg)
+        eq_time = np.array(hosts.eq_time)
+        eq_kind = np.array(hosts.eq_kind)
+        eq_pkt = np.array(hosts.eq_pkt)
+        eq_ctr = np.array(hosts.eq_ctr)
+        idxs = np.flatnonzero(has_app)
+        eq_time[idxs, 0] = start_times[idxs]
+        eq_kind[idxs, 0] = EV_APP
+        eq_pkt[idxs, 0, P.ACK] = WAKE_START
+        eq_pkt[idxs, 0, P.SEQ] = -1
+        eq_ctr[idxs] = 1
+        self.hosts = hosts.replace(
+            eq_time=jnp.asarray(eq_time), eq_kind=jnp.asarray(eq_kind),
+            eq_pkt=jnp.asarray(eq_pkt), eq_ctr=jnp.asarray(eq_ctr))
+
+        self._ran = False
+
+    def run(self, verbose: bool = False) -> SimReport:
+        assert not self._ran, "Simulation objects are single-use"
+        self._ran = True
+        hosts, cfg, hp, sh = self.hosts, self.cfg, self.hp, self.sh
+
+        t0 = jnp.min(hosts.eq_time)
+        wstart = t0
+        wend = jnp.where(t0 == SIMTIME_MAX, t0, t0 + sh.min_jump)
+
+        total_windows = 0
+        wall0 = _time.perf_counter()
+        while True:
+            hosts, wstart, wend, n = run_windows(
+                hosts, hp, sh, wstart, wend, cfg, cfg.chunk_windows)
+            total_windows += int(n)
+            ws = int(wstart)
+            if verbose:
+                print(f"  t={ws / SIMTIME_ONE_SECOND:.3f}s "
+                      f"windows={total_windows}")
+            if ws >= int(sh.stop_time) or ws >= SIMTIME_MAX:
+                break
+        stats = np.asarray(hosts.stats)
+        wall = _time.perf_counter() - wall0
+        self.final_hosts = hosts
+        sim_ns = min(int(sh.stop_time), ws) if ws < SIMTIME_MAX else int(sh.stop_time)
+        return SimReport(stats=stats, host_names=self.host_names,
+                         sim_time_ns=sim_ns, wall_seconds=wall,
+                         windows=total_windows)
+
+
+def run_scenario(scenario: Scenario, **kw) -> SimReport:
+    return Simulation(scenario, **kw).run()

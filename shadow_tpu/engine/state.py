@@ -1,0 +1,209 @@
+"""Simulation state: struct-of-arrays pytrees.
+
+The reference keeps per-host state in heap objects (Host,
+NetworkInterface, Socket/TCP, descriptor tables —
+/root/reference/src/main/host/shd-host.c:64-130) and events as allocated
+closures in per-host priority queues. On TPU the whole simulation is
+three pytrees:
+
+- :class:`Hosts` — every mutable per-host array, leading dim H. This is
+  what the engine transforms (and donates between jit steps). Under
+  ``vmap`` a "row" of it is one simulated host.
+- :class:`HostParams` — read-only per-host configuration (topology
+  vertex, bandwidths, app wiring).
+- :class:`Shared` — replicated tables and scalars: the vertex-by-vertex
+  latency/reliability oracle, RNG root, stop time, lookahead window.
+
+Sizing knobs live in :class:`EngineConfig`; they are Python static so
+XLA sees fixed shapes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import chex
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.simtime import SIMTIME_MAX
+from ..core import constants as C
+from ..net.packet import PKT_WORDS
+from .defs import N_STATS
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Static engine shape/size configuration."""
+    num_hosts: int
+    qcap: int = 32          # event-queue slots per host
+    scap: int = 16          # socket table rows per host
+    obcap: int = 32         # outbox (per-window emit budget) per host
+    incap: int = 32         # per-window inbound packet budget per host
+    txqcap: int = 16        # NIC transmit-ring slots per host
+    chunk_windows: int = 16  # windows executed per jit invocation
+    cc_kind: int = 2        # 0=aimd 1=reno 2=cubic (reference default cubic)
+
+
+@chex.dataclass
+class Hosts:
+    """All mutable per-host state. Every leaf has leading dim H."""
+    # --- event queue (the per-host scheduler) ---
+    eq_time: jnp.ndarray   # [H, Q] i64, SIMTIME_MAX = free slot
+    eq_seq: jnp.ndarray    # [H, Q] i32 tie-break (reference event_compare order)
+    eq_kind: jnp.ndarray   # [H, Q] i32
+    eq_pkt: jnp.ndarray    # [H, Q, PKT_WORDS] i32 payload
+    eq_ctr: jnp.ndarray    # [H] i32 next sequence number
+    # --- per-host RNG use counter (key = fold_in(host_key, rng_ctr)) ---
+    rng_ctr: jnp.ndarray   # [H] i32
+    # --- NIC (reference shd-network-interface.c bandwidth accounting) ---
+    nic_busy: jnp.ndarray      # [H] i64: tx free at this time
+    nic_sched: jnp.ndarray     # [H] bool: an EV_NIC_TX event is in flight
+    nic_rr: jnp.ndarray        # [H] i32: round-robin pointer over sockets
+    nic_rx_until: jnp.ndarray  # [H] i64: rx engine busy horizon
+    # NIC transmit ring: fully-formed packets awaiting bandwidth (the
+    # analogue of socket output buffers + qdisc FIFO). UDP datagrams are
+    # enqueued here at sendto time; TCP regenerates segments on pull.
+    txq_pkt: jnp.ndarray       # [H, T, PKT_WORDS] i32
+    txq_head: jnp.ndarray      # [H] i32 ring head
+    txq_cnt: jnp.ndarray       # [H] i32 entries queued
+    pkt_ctr: jnp.ndarray       # [H] i32: packets originated (drop RNG uid)
+    next_eport: jnp.ndarray    # [H] i32: ephemeral port allocator cursor
+    # --- socket table [H, S] ---
+    sk_used: jnp.ndarray     # bool
+    sk_proto: jnp.ndarray    # i32: 0 free, 6 tcp, 17 udp
+    sk_state: jnp.ndarray    # i32 TCP state (net.tcp)
+    sk_lport: jnp.ndarray    # i32 local port
+    sk_rport: jnp.ndarray    # i32 remote port (0 = unconnected)
+    sk_rhost: jnp.ndarray    # i32 remote host id (-1 = unconnected)
+    sk_parent: jnp.ndarray   # i32 listener slot for accepted children (-1)
+    sk_snd_una: jnp.ndarray  # i64 oldest unacked stream offset
+    sk_snd_nxt: jnp.ndarray  # i64 next offset to transmit
+    sk_snd_end: jnp.ndarray  # i64 total bytes app has written
+    sk_rcv_nxt: jnp.ndarray  # i64 next in-order offset expected
+    sk_peer_fin: jnp.ndarray  # i64 peer's FIN stream offset (-1 = none seen)
+    sk_fin_acked: jnp.ndarray  # bool our FIN was acked
+    sk_close_after: jnp.ndarray  # bool app closed: FIN after snd_end drains
+    sk_cwnd: jnp.ndarray     # f32 congestion window (bytes)
+    sk_ssthresh: jnp.ndarray  # f32
+    sk_srtt: jnp.ndarray     # i64 (-1 until first sample; RFC6298)
+    sk_rttvar: jnp.ndarray   # i64
+    sk_rto: jnp.ndarray      # i64 current retransmission timeout
+    sk_timer_gen: jnp.ndarray  # i32 timer generation (stale-event filter)
+    sk_dupacks: jnp.ndarray  # i32 duplicate-ack counter
+    sk_rtt_seq: jnp.ndarray  # i64 offset being RTT-timed (-1 none; Karn)
+    sk_rtt_time: jnp.ndarray  # i64 send time of the timed offset
+    sk_ctl: jnp.ndarray      # i32 pending control bitmask (net.tcp CTL_*)
+    sk_peer_rwnd: jnp.ndarray  # i64 peer advertised window
+    sk_sndbuf: jnp.ndarray   # i64
+    sk_rcvbuf: jnp.ndarray   # i64
+    sk_hs_time: jnp.ndarray  # i64 handshake start (connect timeout/rtt)
+    # cubic congestion-control per-socket vars (net.congestion)
+    sk_cc_wmax: jnp.ndarray   # f32 window before last loss
+    sk_cc_epoch: jnp.ndarray  # i64 start of current cubic epoch (-1)
+    # --- app layer (vectorized behavior machines) ---
+    app_node: jnp.ndarray  # [H] i32 current behavior-graph node / phase
+    app_r: jnp.ndarray     # [H, 8] i64 app registers
+    # --- outbox: packets emitted this window awaiting exchange ---
+    ob_pkt: jnp.ndarray    # [H, O, PKT_WORDS] i32
+    ob_time: jnp.ndarray   # [H, O] i64 send (wire-entry) time
+    ob_cnt: jnp.ndarray    # [H] i32
+    # --- observability ---
+    stats: jnp.ndarray     # [H, N_STATS] i64
+
+
+@chex.dataclass
+class HostParams:
+    """Read-only per-host configuration, leading dim H."""
+    hid: jnp.ndarray        # [H] i32 own host id (global, shard-invariant)
+    vertex: jnp.ndarray     # [H] i32 topology attachment
+    bw_up: jnp.ndarray      # [H] i64 bytes/sec uplink
+    bw_down: jnp.ndarray    # [H] i64 bytes/sec downlink
+    app_kind: jnp.ndarray   # [H] i32 which app runs here (apps registry)
+    app_cfg: jnp.ndarray    # [H, 8] i64 app static params
+    nic_buf: jnp.ndarray    # [H] i64 NIC input buffer bytes
+
+
+@chex.dataclass
+class Shared:
+    """Replicated loop-invariant tables and scalars. The live window
+    bounds [wstart, wend) are loop-carried scalars in engine.window, not
+    stored here."""
+    lat_ns: jnp.ndarray    # [V, V] i64 path latency
+    rel: jnp.ndarray       # [V, V] f32 path reliability
+    rng_root: jnp.ndarray  # PRNG key
+    stop_time: jnp.ndarray  # i64 scalar
+    min_jump: jnp.ndarray   # i64 scalar: lookahead window width
+
+
+def alloc_hosts(cfg: EngineConfig) -> Hosts:
+    H, Q, S, O = cfg.num_hosts, cfg.qcap, cfg.scap, cfg.obcap
+    T = cfg.txqcap
+
+    def full(shape, val, dt):
+        return jnp.full(shape, val, dtype=dt)
+
+    return Hosts(
+        eq_time=full((H, Q), SIMTIME_MAX, jnp.int64),
+        eq_seq=full((H, Q), 0, jnp.int32),
+        eq_kind=full((H, Q), 0, jnp.int32),
+        eq_pkt=full((H, Q, PKT_WORDS), 0, jnp.int32),
+        eq_ctr=full((H,), 0, jnp.int32),
+        rng_ctr=full((H,), 0, jnp.int32),
+        nic_busy=full((H,), 0, jnp.int64),
+        nic_sched=full((H,), False, jnp.bool_),
+        nic_rr=full((H,), 0, jnp.int32),
+        nic_rx_until=full((H,), 0, jnp.int64),
+        txq_pkt=full((H, T, PKT_WORDS), 0, jnp.int32),
+        txq_head=full((H,), 0, jnp.int32),
+        txq_cnt=full((H,), 0, jnp.int32),
+        pkt_ctr=full((H,), 0, jnp.int32),
+        next_eport=full((H,), C.MIN_RANDOM_PORT, jnp.int32),
+        sk_used=full((H, S), False, jnp.bool_),
+        sk_proto=full((H, S), 0, jnp.int32),
+        sk_state=full((H, S), 0, jnp.int32),
+        sk_lport=full((H, S), 0, jnp.int32),
+        sk_rport=full((H, S), 0, jnp.int32),
+        sk_rhost=full((H, S), -1, jnp.int32),
+        sk_parent=full((H, S), -1, jnp.int32),
+        sk_snd_una=full((H, S), 0, jnp.int64),
+        sk_snd_nxt=full((H, S), 0, jnp.int64),
+        sk_snd_end=full((H, S), 0, jnp.int64),
+        sk_rcv_nxt=full((H, S), 0, jnp.int64),
+        sk_peer_fin=full((H, S), -1, jnp.int64),
+        sk_fin_acked=full((H, S), False, jnp.bool_),
+        sk_close_after=full((H, S), False, jnp.bool_),
+        sk_cwnd=full((H, S), 0.0, jnp.float32),
+        sk_ssthresh=full((H, S), 0.0, jnp.float32),
+        sk_srtt=full((H, S), -1, jnp.int64),
+        sk_rttvar=full((H, S), 0, jnp.int64),
+        sk_rto=full((H, S), C.TCP_RTO_INIT, jnp.int64),
+        sk_timer_gen=full((H, S), 0, jnp.int32),
+        sk_dupacks=full((H, S), 0, jnp.int32),
+        sk_rtt_seq=full((H, S), -1, jnp.int64),
+        sk_rtt_time=full((H, S), 0, jnp.int64),
+        sk_ctl=full((H, S), 0, jnp.int32),
+        sk_peer_rwnd=full((H, S), C.RECV_BUFFER_SIZE, jnp.int64),
+        sk_sndbuf=full((H, S), C.SEND_BUFFER_SIZE, jnp.int64),
+        sk_rcvbuf=full((H, S), C.RECV_BUFFER_SIZE, jnp.int64),
+        sk_hs_time=full((H, S), 0, jnp.int64),
+        sk_cc_wmax=full((H, S), 0.0, jnp.float32),
+        sk_cc_epoch=full((H, S), -1, jnp.int64),
+        app_node=full((H,), 0, jnp.int32),
+        app_r=full((H, 8), 0, jnp.int64),
+        ob_pkt=full((H, O, PKT_WORDS), 0, jnp.int32),
+        ob_time=full((H, O), 0, jnp.int64),
+        ob_cnt=full((H,), 0, jnp.int32),
+        stats=full((H, N_STATS), 0, jnp.int64),
+    )
+
+
+def make_shared(topo_lat_ns: np.ndarray, topo_rel: np.ndarray, rng_root,
+                stop_time: int, min_jump: int) -> Shared:
+    return Shared(
+        lat_ns=jnp.asarray(topo_lat_ns, dtype=jnp.int64),
+        rel=jnp.asarray(topo_rel, dtype=jnp.float32),
+        rng_root=rng_root,
+        stop_time=jnp.int64(stop_time),
+        min_jump=jnp.int64(min_jump),
+    )

@@ -1,0 +1,228 @@
+"""The conservative-lookahead window loop.
+
+This is the TPU redesign of the reference's round machinery: the
+master/slave round loop (shd-slave.c:397-449, shd-master.c:410-440),
+the scheduler barriers (shd-scheduler.c:602-635), the worker event loop
+(shd-worker.c:123-190) and cross-host packet delivery
+(shd-worker.c:216-271) — collapsed into three pure array programs:
+
+1. `step_all_hosts`: every host pops and executes its earliest event if
+   it falls inside the window — one lockstep iteration of the inner
+   `lax.while_loop`, which runs until no host has a ready event. This
+   replaces N worker threads walking per-host priority queues.
+2. `exchange`: all packets emitted into per-host outboxes this window
+   are routed (two [V,V] table gathers), loss-rolled (counter-based
+   PRNG), grouped by destination and scattered into destination event
+   queues. Cross-host arrivals always land at or after the window end
+   because path latency >= the lookahead bound — the same causality
+   argument as the reference's bump-to-barrier rule
+   (shd-scheduler-policy-host-single.c:171-175).
+3. `advance`: the global min next-event time (a jnp.min today, a
+   lax.pmin over the mesh when sharded) opens the next window
+   [t_min, t_min + min_jump) — exactly master_slaveFinishedCurrentRound.
+
+`run_windows` stitches these into a device-resident multi-window loop so
+one jit call executes many windows without host round-trips.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..core import rng as R
+from ..core.simtime import SIMTIME_MAX
+from ..net import nic
+from ..net import packet as P
+from ..net.socket import sock_demux
+from ..net.tcp import on_tcp_timer, on_tcp_close, tcp_rx
+from ..net.udp import udp_deliver
+from ..apps.base import dispatch as app_dispatch
+from . import equeue
+from .defs import (EV_NULL, EV_APP, EV_PKT, EV_NIC_TX, EV_TCP_TIMER,
+                   EV_TCP_CLOSE, ST_EVENTS, ST_PKTS_RECV, ST_PKTS_DROP_NET,
+                   ST_PKTS_DROP_Q)
+from .state import EngineConfig
+
+
+# --- Event handlers (row-level) -------------------------------------------
+
+def _on_null(row, hp, sh, now, pkt):
+    return row
+
+
+def _on_app(row, hp, sh, now, pkt):
+    return app_dispatch(row, hp, sh, now, pkt)
+
+
+def _on_pkt(row, hp, sh, now, pkt):
+    """Packet arrival at the NIC: admission, demux, protocol dispatch."""
+    row, keep = nic.rx_admit(row, hp, now, pkt)
+
+    def deliver(r):
+        r = r.replace(stats=r.stats.at[ST_PKTS_RECV].add(1))
+        proto = pkt[P.FLAGS] & P.PROTO_MASK
+
+        def tcp_path(rr):
+            slot = sock_demux(rr, pkt[P.SRC], pkt[P.SPORT], pkt[P.DPORT],
+                              P.PROTO_TCP)
+            return jax.lax.cond(slot >= 0,
+                                lambda r2: tcp_rx(r2, hp, sh, now, slot, pkt),
+                                lambda r2: r2, rr)
+
+        def udp_path(rr):
+            slot = sock_demux(rr, pkt[P.SRC], pkt[P.SPORT], pkt[P.DPORT],
+                              P.PROTO_UDP)
+            return jax.lax.cond(slot >= 0,
+                                lambda r2: udp_deliver(r2, hp, sh, now, slot, pkt),
+                                lambda r2: r2, rr)
+
+        return jax.lax.cond(proto == P.PROTO_TCP, tcp_path, udp_path, r)
+
+    return jax.lax.cond(keep, deliver, lambda r: r, row)
+
+
+_HANDLERS = [_on_null, _on_app, _on_pkt, nic.on_tx, on_tcp_timer, on_tcp_close]
+
+
+def step_one_host(row, hp, sh, wend):
+    """Pop and execute this host's earliest event if inside the window."""
+    slot, t = equeue.q_min(row)
+    ready = t < wend
+    kind = jnp.where(ready, row.eq_kind[slot], EV_NULL)
+    pkt = row.eq_pkt[slot]
+    row = jax.lax.cond(ready, lambda r: equeue.q_clear_slot(r, slot),
+                       lambda r: r, row)
+    row = jax.lax.switch(kind, _HANDLERS, row, hp, sh, t, pkt)
+    return row.replace(
+        stats=row.stats.at[ST_EVENTS].add(jnp.where(ready, 1, 0)))
+
+
+def step_all_hosts(hosts, hp, sh, wend):
+    return jax.vmap(step_one_host, in_axes=(0, 0, None, None))(
+        hosts, hp, sh, wend)
+
+
+# --- Window-boundary packet exchange --------------------------------------
+
+def exchange(hosts, hp, sh, cfg: EngineConfig):
+    """Route, loss-roll and deliver all outbox packets into destination
+    event queues. Pure array program; runs once per window."""
+    H, O, IN = cfg.num_hosts, cfg.obcap, cfg.incap
+    N = H * O
+
+    pkts = hosts.ob_pkt.reshape(N, P.PKT_WORDS)
+    stimes = hosts.ob_time.reshape(N)
+    valid = (jnp.arange(O)[None, :] < hosts.ob_cnt[:, None]).reshape(N)
+
+    src = jnp.clip(pkts[:, P.SRC], 0, H - 1)
+    dst = jnp.clip(pkts[:, P.DST], 0, H - 1)
+    sv = hp.vertex[src]
+    dv = hp.vertex[dst]
+    lat = sh.lat_ns[sv, dv]
+    rel = sh.rel[sv, dv]
+    arrival = stimes + lat
+
+    # Deterministic per-packet drop roll keyed by the globally unique
+    # (src, uid) stamped at NIC emit — the counter-based analogue of
+    # worker_sendPacket's reliability test (shd-worker.c:238-244).
+    dk = R.domain_key(sh.rng_root, R.DOMAIN_DROP)
+    keys = jax.vmap(jax.random.fold_in, (None, 0))(dk, src)
+    keys = jax.vmap(jax.random.fold_in)(keys, pkts[:, P.UID])
+    u = jax.vmap(jax.random.uniform)(keys)
+
+    reachable = rel > 0
+    deliver = valid & reachable & (u <= rel)
+    net_dropped = valid & ~deliver
+
+    # group-by-destination via stable sort; rank within group
+    sortkey = jnp.where(deliver, dst, H)
+    order = jnp.argsort(sortkey, stable=True)
+    sdst = sortkey[order]
+    first = jnp.searchsorted(sdst, sdst, side="left")
+    rank = jnp.arange(N) - first
+    accept = (sdst < H) & (rank < IN)
+    q_dropped = (sdst < H) & (rank >= IN)
+
+    # scatter accepted packets into dense [H, IN] inbound buffers
+    tgt = jnp.where(accept, sdst * IN + rank, N * IN)  # OOB -> dropped
+    in_time = jnp.full((H * IN,), SIMTIME_MAX, jnp.int64)
+    in_time = in_time.at[tgt].set(arrival[order], mode="drop")
+    in_pkt = jnp.zeros((H * IN, P.PKT_WORDS), jnp.int32)
+    in_pkt = in_pkt.at[tgt].set(pkts[order], mode="drop")
+
+    # stat scatters (to source for net drops, destination for queue drops)
+    stats = hosts.stats
+    stats = stats.at[src, ST_PKTS_DROP_NET].add(
+        jnp.where(net_dropped, 1, 0).astype(jnp.int64))
+    stats = stats.at[jnp.clip(sdst, 0, H - 1), ST_PKTS_DROP_Q].add(
+        jnp.where(q_dropped, 1, 0).astype(jnp.int64))
+    hosts = hosts.replace(stats=stats)
+
+    # merge inbound packets into per-host queue free slots
+    def merge(row, ipkt, itime):
+        k = jnp.sum(itime != SIMTIME_MAX).astype(jnp.int32)
+        free = row.eq_time == SIMTIME_MAX
+        frank = jnp.cumsum(free) - 1
+        take = free & (frank < k)
+        j = jnp.clip(frank, 0, IN - 1)
+        nfree = jnp.sum(free).astype(jnp.int32)
+        overflow = jnp.maximum(k - nfree, 0)
+        return row.replace(
+            eq_time=jnp.where(take, itime[j], row.eq_time),
+            eq_kind=jnp.where(take, EV_PKT, row.eq_kind),
+            eq_seq=jnp.where(take, row.eq_ctr + frank.astype(jnp.int32),
+                             row.eq_seq),
+            eq_pkt=jnp.where(take[:, None], ipkt[j], row.eq_pkt),
+            eq_ctr=row.eq_ctr + k,
+            stats=row.stats.at[ST_PKTS_DROP_Q].add(jnp.int64(overflow)),
+        )
+
+    hosts = jax.vmap(merge)(hosts,
+                            in_pkt.reshape(H, IN, P.PKT_WORDS),
+                            in_time.reshape(H, IN))
+    return hosts.replace(ob_cnt=jnp.zeros_like(hosts.ob_cnt))
+
+
+# --- Multi-window driver ---------------------------------------------------
+
+def next_event_time(hosts):
+    """Global minimum pending event time (the pmin reduction)."""
+    return jnp.min(hosts.eq_time)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_windows"), donate_argnums=(0,))
+def run_windows(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
+                max_windows: int):
+    """Execute up to `max_windows` lookahead windows on device.
+
+    Returns (hosts, wstart', wend', windows_run). The caller loops until
+    wstart' >= stop_time or wstart' == SIMTIME_MAX (no events left).
+    """
+
+    def win_cond(carry):
+        _, ws, _, i = carry
+        return (i < max_windows) & (ws < sh.stop_time) & (ws < SIMTIME_MAX)
+
+    def win_body(carry):
+        hosts, ws, we, i = carry
+        # never execute past the simulation end (the reference clamps the
+        # execution window to endTime, shd-master.c:410-440)
+        we_eff = jnp.minimum(we, sh.stop_time)
+
+        def ev_cond(h):
+            return next_event_time(h) < we_eff
+
+        def ev_body(h):
+            return step_all_hosts(h, hp, sh, we_eff)
+
+        hosts = jax.lax.while_loop(ev_cond, ev_body, hosts)
+        hosts = exchange(hosts, hp, sh, cfg)
+        nt = next_event_time(hosts)
+        we2 = jnp.where(nt == SIMTIME_MAX, SIMTIME_MAX, nt + sh.min_jump)
+        return hosts, nt, we2, i + 1
+
+    return jax.lax.while_loop(
+        win_cond, win_body, (hosts, wstart, wend, jnp.int32(0)))

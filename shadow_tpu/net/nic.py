@@ -1,0 +1,168 @@
+"""NIC model: transmit scheduling, bandwidth accounting, packet emission.
+
+Redesigns the reference NetworkInterface
+(/root/reference/src/main/host/shd-network-interface.c): its
+time-per-byte uplink accounting with scheduled "next send" callbacks
+(:229-286,386-454) becomes an ``nic_busy`` horizon plus one EV_NIC_TX
+event in flight per host; its qdisc socket selection (:335-379) becomes
+a round-robin scan over the socket table; local-vs-remote delivery
+split (:414-425) becomes loopback queue push vs. outbox append; and the
+bounded input buffer with drop-on-overflow (:288-311) plus the 10ms
+batched receive become the rx-horizon admission test in `rx_admit`.
+
+All functions are row-level (one host under vmap).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.simtime import SIMTIME_ONE_SECOND
+from ..engine import equeue
+from ..engine.defs import (EV_NIC_TX, EV_PKT, ST_PKTS_SENT, ST_PKTS_DROP_BUF,
+                           ST_OUTBOX_DROP, ST_TXQ_DROP)
+from . import packet as P
+
+LOOPBACK_DELAY = 1  # ns, reference's local-delivery task delay (:414-421)
+
+
+def tx_duration(nbytes, bw_bytes_per_sec):
+    """Nanoseconds the uplink is busy transmitting nbytes."""
+    return (jnp.int64(nbytes) * SIMTIME_ONE_SECOND) // jnp.maximum(bw_bytes_per_sec, 1)
+
+
+def tx_want(row):
+    """[S] bool: TCP sockets owing the wire a packet (control or data).
+    (UDP work lives in the transmit ring, checked separately.)"""
+    from .tcp import tcp_want_tx  # late import; tcp depends on nic
+    return (row.sk_used & ((row.sk_ctl != 0) | tcp_want_tx(row)))
+
+
+def has_work(row):
+    return (row.txq_cnt > 0) | jnp.any(tx_want(row))
+
+
+def txq_push(row, pkt):
+    """Enqueue a fully-formed packet on the NIC transmit ring."""
+    T = row.txq_pkt.shape[0]
+    ok = row.txq_cnt < T
+    slot = (row.txq_head + row.txq_cnt) % T
+    return row.replace(
+        txq_pkt=row.txq_pkt.at[slot].set(jnp.where(ok, pkt, row.txq_pkt[slot])),
+        txq_cnt=row.txq_cnt + jnp.where(ok, 1, 0),
+        stats=row.stats.at[ST_TXQ_DROP].add(jnp.where(ok, 0, 1)),
+    )
+
+
+def emit(row, hp, now, pkt):
+    """Hand a packet to the wire: loopback to own queue, or outbox for
+    the window-boundary exchange. Stamps the per-source UID that keys
+    the topology loss roll."""
+    pkt = pkt.at[P.UID].set(row.pkt_ctr)
+    is_loop = pkt[P.DST] == hp.hid
+
+    def local(r):
+        return equeue.q_push(r, now + LOOPBACK_DELAY, EV_PKT, pkt)
+
+    def remote(r):
+        cnt = r.ob_cnt
+        ok = cnt < r.ob_time.shape[0]
+        slot = jnp.minimum(cnt, r.ob_time.shape[0] - 1)
+        return r.replace(
+            ob_pkt=r.ob_pkt.at[slot].set(jnp.where(ok, pkt, r.ob_pkt[slot])),
+            ob_time=r.ob_time.at[slot].set(jnp.where(ok, now, r.ob_time[slot])),
+            ob_cnt=cnt + jnp.where(ok, 1, 0),
+            stats=r.stats.at[ST_OUTBOX_DROP].add(jnp.where(ok, 0, 1)),
+        )
+
+    row = jax.lax.cond(is_loop, local, remote, row)
+    return row.replace(stats=row.stats.at[ST_PKTS_SENT].add(1),
+                       pkt_ctr=row.pkt_ctr + 1)
+
+
+def kick(row, now):
+    """Ensure an EV_NIC_TX event is pending if the NIC has work.
+    Called whenever a socket gains something to send."""
+    need = has_work(row) & ~row.nic_sched
+
+    def sched(r):
+        t = jnp.maximum(now, r.nic_busy)
+        r = equeue.q_push(r, t, EV_NIC_TX, jnp.zeros((P.PKT_WORDS,), jnp.int32))
+        return r.replace(nic_sched=jnp.bool_(True))
+
+    return jax.lax.cond(need, sched, lambda r: r, row)
+
+
+def on_tx(row, hp, sh, now, pkt):
+    """EV_NIC_TX handler: pull one packet — transmit ring first (UDP and
+    queued control), else the round-robin-selected TCP socket — emit it,
+    account bandwidth, reschedule while work remains."""
+    from .tcp import tcp_pull
+
+    row = row.replace(nic_sched=jnp.bool_(False))
+    want = tx_want(row)
+    S = want.shape[0]
+    order = (jnp.arange(S) + row.nic_rr) % S
+    sock = order[jnp.argmax(want[order])]
+    ring_has = row.txq_cnt > 0
+
+    def pull_ring(r):
+        T = r.txq_pkt.shape[0]
+        out = r.txq_pkt[r.txq_head]
+        r = r.replace(txq_head=(r.txq_head + 1) % T, txq_cnt=r.txq_cnt - 1)
+        return r, out, jnp.bool_(True)
+
+    def pull_tcp(r):
+        def go(rr):
+            rr, out, has = tcp_pull(rr, hp, sh, now, sock)
+            rr = rr.replace(nic_rr=jnp.where(
+                has, (sock + 1) % S, rr.nic_rr).astype(jnp.int32))
+            return rr, out, has
+
+        def nothing(rr):
+            return rr, jnp.zeros((P.PKT_WORDS,), jnp.int32), jnp.bool_(False)
+
+        return jax.lax.cond(jnp.any(want), go, nothing, r)
+
+    row, out_pkt, has_pkt = jax.lax.cond(ring_has, pull_ring, pull_tcp, row)
+
+    wire = P.wire_bytes(out_pkt)
+    busy_end = now + jnp.where(has_pkt, tx_duration(wire, hp.bw_up), 0)
+    row = jax.lax.cond(has_pkt, lambda r: emit(r, hp, now, out_pkt),
+                       lambda r: r, row)
+    row = row.replace(nic_busy=busy_end)
+
+    # Keep draining while the ring or sockets still owe packets — but
+    # only if this invocation actually made progress (pulled a packet);
+    # otherwise rescheduling at busy_end == now would spin the window
+    # loop on the same timestamp forever. A want-but-unpullable socket
+    # rearms through kick() when its state changes.
+    more = has_work(row) & has_pkt
+
+    def resched(r):
+        r = equeue.q_push(r, busy_end, EV_NIC_TX,
+                          jnp.zeros((P.PKT_WORDS,), jnp.int32))
+        return r.replace(nic_sched=jnp.bool_(True))
+
+    return jax.lax.cond(more, resched, lambda r: r, row)
+
+
+def rx_admit(row, hp, now, pkt):
+    """Downlink admission: models the reference's bounded NIC input
+    buffer (drop on overflow) + receive bandwidth. Returns (row, keep).
+
+    The rx engine drains at bw_down; the backlog at `now` in bytes is
+    (rx_until - now) * bw_down. A packet is dropped iff backlog + its
+    wire size exceeds the configured buffer."""
+    wire = P.wire_bytes(pkt)
+    bw = jnp.maximum(hp.bw_down, 1)
+    backlog_ns = jnp.maximum(row.nic_rx_until - now, 0)
+    backlog_bytes = (backlog_ns * bw) // SIMTIME_ONE_SECOND
+    keep = (backlog_bytes + wire) <= hp.nic_buf
+    new_until = jnp.maximum(row.nic_rx_until, now) + tx_duration(wire, bw)
+    row = row.replace(
+        nic_rx_until=jnp.where(keep, new_until, row.nic_rx_until),
+        stats=row.stats.at[ST_PKTS_DROP_BUF].add(jnp.where(keep, 0, 1)),
+    )
+    return row, keep

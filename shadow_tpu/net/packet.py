@@ -1,0 +1,67 @@
+"""Packet representation: a fixed-width vector of int32 words.
+
+The reference's Packet is a refcounted heap object with a protocol
+header union and a delivery-status trail
+(/root/reference/src/main/host/shd-packet.c:11-66, shd-packet.h:15-51).
+On TPU a packet is a row of PKT_WORDS int32s living in event queues,
+outboxes and exchange buffers — no allocation, no refcounts; lifecycle
+status becomes per-host counters (see obs.tracker).
+
+Word layout (all int32):
+  0 SRC    source host id
+  1 DST    destination host id
+  2 SPORT  source port
+  3 DPORT  destination port
+  4 FLAGS  bits 0-7 protocol (6=TCP, 17=UDP); bits 8+ TCP control flags
+  5 SEQ    TCP: first data byte offset of this segment (see note)
+  6 ACK    TCP: cumulative ack — next expected data byte offset
+  7 WND    TCP: advertised receive window (bytes, clamped to int32)
+  8 LEN    payload bytes in this segment
+  9 AUX    TCP: timestamp echo / listener child hint; apps: opaque tag
+ 10 UID    per-source packet counter stamped at emit; (SRC, UID) is the
+           globally unique packet id keying the loss roll (rng.DOMAIN_DROP)
+
+Note on sequence numbers: stream offsets are plain byte counts starting
+at 0 (SYN/FIN are modeled as control flags with their own state-machine
+retransmission, not as sequence-space occupants — unlike wire TCP but
+equivalent for a byte-accounting simulator). int32 offsets cap a single
+connection at 2 GiB transferred, matching real TCP's 32-bit sequence
+space scale; connections are per-transfer in the bundled apps.
+"""
+
+import jax.numpy as jnp
+
+PKT_WORDS = 11
+
+SRC, DST, SPORT, DPORT, FLAGS, SEQ, ACK, WND, LEN, AUX, UID = range(11)
+
+# FLAGS word
+PROTO_MASK = 0xFF
+PROTO_TCP = 6
+PROTO_UDP = 17
+
+F_SYN = 1 << 8
+F_ACK = 1 << 9
+F_FIN = 1 << 10
+F_RST = 1 << 11
+
+# Header sizes on the (virtual) wire — used for NIC bandwidth accounting,
+# matching reference CONFIG_HEADER_SIZE_{TCP,UDP}IPETH.
+from ..core.constants import HEADER_SIZE_TCPIPETH, HEADER_SIZE_UDPIPETH  # noqa: E402
+
+
+def make(src, dst, sport, dport, flags, seq=0, ack=0, wnd=0, length=0, aux=0):
+    """Assemble a packet word vector (traced or concrete int32s).
+    UID is stamped later, at NIC emit time."""
+    return jnp.stack([
+        jnp.int32(src), jnp.int32(dst), jnp.int32(sport), jnp.int32(dport),
+        jnp.int32(flags), jnp.int32(seq), jnp.int32(ack), jnp.int32(wnd),
+        jnp.int32(length), jnp.int32(aux), jnp.int32(0),
+    ])
+
+
+def wire_bytes(pkt):
+    """Total on-wire size for bandwidth accounting."""
+    proto = pkt[FLAGS] & PROTO_MASK
+    hdr = jnp.where(proto == PROTO_TCP, HEADER_SIZE_TCPIPETH, HEADER_SIZE_UDPIPETH)
+    return pkt[LEN] + hdr

@@ -1,0 +1,137 @@
+"""Socket table operations (row-level, under vmap).
+
+The reference's descriptor hierarchy (Descriptor -> Transport -> Socket
+-> TCP/UDP, /root/reference/src/main/host/descriptor/shd-socket.h:18-60)
+becomes a fixed socket table of SoA columns per host; "allocation" is
+claiming a free row, and the NIC's (protocol, port) -> socket demux
+(shd-network-interface.c:164-184) is a vectorized match over the table.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.constants import MIN_RANDOM_PORT, MAX_PORT, SEND_BUFFER_SIZE, RECV_BUFFER_SIZE, TCP_RTO_INIT
+from .packet import PROTO_TCP, PROTO_UDP
+
+# TCP states — same machine as the reference's 11 states (shd-tcp.c:10-15).
+TCPS_CLOSED = 0
+TCPS_LISTEN = 1
+TCPS_SYN_SENT = 2
+TCPS_SYN_RECEIVED = 3
+TCPS_ESTABLISHED = 4
+TCPS_FIN_WAIT_1 = 5
+TCPS_FIN_WAIT_2 = 6
+TCPS_CLOSE_WAIT = 7
+TCPS_CLOSING = 8
+TCPS_LAST_ACK = 9
+TCPS_TIME_WAIT = 10
+
+# Pending control-transmission bits (sk_ctl): which header-only packets
+# this socket owes the wire. Pulled by the NIC ahead of data.
+CTL_SYN = 1
+CTL_SYNACK = 2
+CTL_ACKNOW = 4
+CTL_FIN = 8
+CTL_RST = 16
+
+
+def sock_alloc(row, proto):
+    """Claim a free socket row. Returns (row, slot, ok)."""
+    free = ~row.sk_used
+    ok = jnp.any(free)
+    slot = jnp.argmax(free)
+
+    def setf(arr, val, dt):
+        return arr.at[slot].set(jnp.where(ok, jnp.asarray(val, dt), arr[slot]))
+
+    row = row.replace(
+        sk_used=setf(row.sk_used, True, jnp.bool_),
+        sk_proto=setf(row.sk_proto, proto, jnp.int32),
+        sk_state=setf(row.sk_state, TCPS_CLOSED, jnp.int32),
+        sk_lport=setf(row.sk_lport, 0, jnp.int32),
+        sk_rport=setf(row.sk_rport, 0, jnp.int32),
+        sk_rhost=setf(row.sk_rhost, -1, jnp.int32),
+        sk_parent=setf(row.sk_parent, -1, jnp.int32),
+        sk_snd_una=setf(row.sk_snd_una, 0, jnp.int64),
+        sk_snd_nxt=setf(row.sk_snd_nxt, 0, jnp.int64),
+        sk_snd_end=setf(row.sk_snd_end, 0, jnp.int64),
+        sk_rcv_nxt=setf(row.sk_rcv_nxt, 0, jnp.int64),
+        sk_peer_fin=setf(row.sk_peer_fin, -1, jnp.int64),
+        sk_fin_acked=setf(row.sk_fin_acked, False, jnp.bool_),
+        sk_close_after=setf(row.sk_close_after, False, jnp.bool_),
+        sk_cwnd=setf(row.sk_cwnd, 0.0, jnp.float32),
+        sk_ssthresh=setf(row.sk_ssthresh, 0.0, jnp.float32),
+        sk_srtt=setf(row.sk_srtt, -1, jnp.int64),
+        sk_rttvar=setf(row.sk_rttvar, 0, jnp.int64),
+        sk_rto=setf(row.sk_rto, TCP_RTO_INIT, jnp.int64),
+        sk_timer_gen=row.sk_timer_gen.at[slot].add(jnp.where(ok, 1, 0)),
+        sk_dupacks=setf(row.sk_dupacks, 0, jnp.int32),
+        sk_rtt_seq=setf(row.sk_rtt_seq, -1, jnp.int64),
+        sk_rtt_time=setf(row.sk_rtt_time, 0, jnp.int64),
+        sk_ctl=setf(row.sk_ctl, 0, jnp.int32),
+        sk_peer_rwnd=setf(row.sk_peer_rwnd, RECV_BUFFER_SIZE, jnp.int64),
+        sk_sndbuf=setf(row.sk_sndbuf, SEND_BUFFER_SIZE, jnp.int64),
+        sk_rcvbuf=setf(row.sk_rcvbuf, RECV_BUFFER_SIZE, jnp.int64),
+        sk_hs_time=setf(row.sk_hs_time, 0, jnp.int64),
+        sk_cc_wmax=setf(row.sk_cc_wmax, 0.0, jnp.float32),
+        sk_cc_epoch=setf(row.sk_cc_epoch, -1, jnp.int64),
+    )
+    return row, slot, ok
+
+
+def sock_free(row, slot):
+    """Release a socket row (descriptor close)."""
+    return row.replace(
+        sk_used=row.sk_used.at[slot].set(False),
+        sk_proto=row.sk_proto.at[slot].set(0),
+        sk_state=row.sk_state.at[slot].set(TCPS_CLOSED),
+        sk_ctl=row.sk_ctl.at[slot].set(0),
+        sk_timer_gen=row.sk_timer_gen.at[slot].add(1),
+    )
+
+
+def alloc_eport(row):
+    """Allocate an ephemeral local port.
+
+    The reference picks random unused ports >= MIN_RANDOM_PORT
+    (shd-host.c:967-1049); we use a deterministic per-host cursor with a
+    short probe against the table, which preserves uniqueness with the
+    same port range.
+    """
+    span = MAX_PORT - MIN_RANDOM_PORT
+
+    def used(p):
+        return jnp.any(row.sk_used & (row.sk_lport == p))
+
+    p0 = row.next_eport
+    p = p0
+    # unrolled linear probe (collisions need S simultaneous hits; 4 is ample)
+    for _ in range(4):
+        p = jnp.where(used(p), MIN_RANDOM_PORT + (p + 1 - MIN_RANDOM_PORT) % span, p)
+    row = row.replace(
+        next_eport=MIN_RANDOM_PORT + (p + 1 - MIN_RANDOM_PORT) % span)
+    return row, p
+
+
+def sock_demux(row, src_host, sport, dport, proto):
+    """Find the socket owning an inbound packet.
+
+    Preference order matches a real stack: exact 4-tuple connection
+    match, then a bound-but-unconnected (UDP) or listening (TCP) socket
+    on the destination port. Returns slot (or -1).
+    """
+    usable = row.sk_used & (row.sk_proto == proto)
+    port_ok = usable & (row.sk_lport == dport)
+    exact = port_ok & (row.sk_rhost == src_host) & (row.sk_rport == sport)
+    if proto == PROTO_TCP:
+        fallback = port_ok & (row.sk_state == TCPS_LISTEN)
+    else:
+        fallback = port_ok & (row.sk_rhost == -1)
+    any_exact = jnp.any(exact)
+    any_fb = jnp.any(fallback)
+    slot = jnp.where(any_exact, jnp.argmax(exact),
+                     jnp.where(any_fb, jnp.argmax(fallback), -1))
+    return slot
+
+

@@ -1,0 +1,43 @@
+"""TCP: vectorized state machine over the socket table.
+
+Re-implements the behavior of the reference's TCP
+(/root/reference/src/main/host/descriptor/shd-tcp.c, 2254 LoC): the
+11-state machine, server multiplexing into child sockets, sliding
+windows, RFC6298 retransmission timers, fast retransmit, and pluggable
+congestion control — as branch-masked vectorized kernels instead of
+per-connection callbacks.
+
+This module currently carries the interface stubs wired into the NIC;
+the full state machine lands with the TCP milestone.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import packet as P
+
+
+def tcp_want_tx(row):
+    """[S] bool: TCP sockets owing the wire a data segment."""
+    return jnp.zeros_like(row.sk_used)
+
+
+def tcp_pull(row, hp, sh, now, slot):
+    """NIC pull for a TCP socket. Returns (row, pkt, has_pkt)."""
+    return row, jnp.zeros((P.PKT_WORDS,), jnp.int32), jnp.bool_(False)
+
+
+def tcp_rx(row, hp, sh, now, slot, pkt):
+    """Inbound TCP segment dispatch for socket `slot`."""
+    return row
+
+
+def on_tcp_timer(row, hp, sh, now, pkt):
+    """EV_TCP_TIMER handler (retransmission timeout)."""
+    return row
+
+
+def on_tcp_close(row, hp, sh, now, pkt):
+    """EV_TCP_CLOSE handler (TIME_WAIT / close teardown)."""
+    return row

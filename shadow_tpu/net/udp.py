@@ -1,0 +1,68 @@
+"""UDP: connectionless datagram sockets over the shared socket table.
+
+Mirrors the reference's UDP (/root/reference/src/main/host/descriptor/
+shd-udp.c): stateless send/receive through the socket buffers with NIC
+bandwidth applied. Payload contents are not materialized — apps are
+modeled, so a datagram is its byte count plus a 32-bit app tag
+(packet AUX), which is how the bundled apps carry timestamps.
+
+Row-level functions (one host under vmap).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.constants import UDP_MAX_PAYLOAD
+from ..engine import equeue
+from ..engine.defs import EV_APP, WAKE_SOCKET, ST_BYTES_RECV
+from . import nic
+from . import packet as P
+from .socket import sock_alloc, alloc_eport
+
+
+def udp_open(row, port=None):
+    """Create a UDP socket; bind to `port` or an ephemeral one.
+    Returns (row, slot, ok)."""
+    row, slot, ok = sock_alloc(row, P.PROTO_UDP)
+    if port is None:
+        row, p = alloc_eport(row)
+    else:
+        p = jnp.int32(port)
+    row = row.replace(sk_lport=row.sk_lport.at[slot].set(
+        jnp.where(ok, p, row.sk_lport[slot])))
+    return row, slot, ok
+
+
+def udp_sendto(row, hp, now, slot, dst_host, dst_port, nbytes, aux=0):
+    """Send one datagram of `nbytes` payload to (dst_host, dst_port).
+
+    The packet is fully formed here and enqueued on the host's NIC
+    transmit ring (the socket-output-buffer -> qdisc flow of the
+    reference), so concurrent sendto calls to different destinations
+    never interfere. The socket stays unconnected for demux, like a
+    real sendto. Payload is clamped to one MTU-sized datagram
+    (modeled apps send message-sized datagrams).
+    """
+    length = jnp.minimum(jnp.int64(nbytes), UDP_MAX_PAYLOAD).astype(jnp.int32)
+    pkt = P.make(src=hp.hid, dst=dst_host, sport=row.sk_lport[slot],
+                 dport=dst_port, flags=P.PROTO_UDP, length=length, aux=aux)
+    row = row.replace(sk_snd_end=row.sk_snd_end.at[slot].add(jnp.int64(length)))
+    row = nic.txq_push(row, pkt)
+    return nic.kick(row, now)
+
+
+def udp_deliver(row, hp, sh, now, slot, pkt):
+    """Inbound datagram for socket `slot`: account bytes, wake the app.
+
+    The app wake carries the datagram's source/ports/len/tag with the
+    target socket in SEQ and the reason in ACK (see engine.defs) — the
+    vectorized analogue of the reference's epoll-notify ->
+    process_continue reentry chain (shd-epoll.c:597-658)."""
+    length = jnp.int64(pkt[P.LEN])
+    row = row.replace(
+        sk_rcv_nxt=row.sk_rcv_nxt.at[slot].add(length),
+        stats=row.stats.at[ST_BYTES_RECV].add(length),
+    )
+    wake = pkt.at[P.SEQ].set(jnp.int32(slot)).at[P.ACK].set(WAKE_SOCKET)
+    return equeue.q_push(row, now + 1, EV_APP, wake)

@@ -1,0 +1,59 @@
+"""Test configuration: run the engine on a virtual 8-device CPU mesh.
+
+Must set platform flags before the first jax import anywhere in the
+test process, mirroring how the driver validates multi-chip sharding
+without real chips.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def simple_topology_xml():
+    """A 2-PoI topology equivalent to resource/topology.simple.graphml:
+    20ms intra-vertex self-loops, 50ms inter-vertex link, no loss."""
+    return SIMPLE_TOPOLOGY
+
+
+SIMPLE_TOPOLOGY = """<?xml version="1.0" encoding="utf-8"?>
+<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d9" />
+  <key attr.name="jitter" attr.type="double" for="edge" id="d8" />
+  <key attr.name="latency" attr.type="double" for="edge" id="d7" />
+  <key attr.name="type" attr.type="string" for="node" id="d5" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d4" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d3" />
+  <key attr.name="geocode" attr.type="string" for="node" id="d2" />
+  <key attr.name="ip" attr.type="string" for="node" id="d1" />
+  <key attr.name="packetloss" attr.type="double" for="node" id="d0" />
+  <graph edgedefault="undirected">
+    <node id="poi-1">
+      <data key="d0">0.0</data><data key="d1">0.0.0.0</data>
+      <data key="d2">US</data><data key="d3">2048</data>
+      <data key="d4">1024</data><data key="d5">net</data>
+    </node>
+    <node id="poi-2">
+      <data key="d0">0.0</data><data key="d1">0.0.0.0</data>
+      <data key="d2">US</data><data key="d3">2048</data>
+      <data key="d4">1024</data><data key="d5">net</data>
+    </node>
+    <edge source="poi-1" target="poi-1">
+      <data key="d7">20.0</data><data key="d8">0.0</data><data key="d9">0.0</data>
+    </edge>
+    <edge source="poi-1" target="poi-2">
+      <data key="d7">50.0</data><data key="d8">0.0</data><data key="d9">0.0</data>
+    </edge>
+    <edge source="poi-2" target="poi-2">
+      <data key="d7">20.0</data><data key="d8">0.0</data><data key="d9">0.0</data>
+    </edge>
+  </graph>
+</graphml>
+"""

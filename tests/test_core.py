@@ -1,0 +1,57 @@
+"""Unit tests for core types: time parsing, config, RNG tree."""
+
+import numpy as np
+
+from shadow_tpu.core import simtime as T
+from shadow_tpu.core import rng as R
+from shadow_tpu.core.config import load_xml
+
+
+def test_parse_time_units():
+    assert T.parse_time(5) == 5 * T.SIMTIME_ONE_SECOND
+    assert T.parse_time("10 ms") == 10 * T.SIMTIME_ONE_MILLISECOND
+    assert T.parse_time("1.5s") == 1_500_000_000
+    assert T.parse_time("250us") == 250_000
+    assert T.parse_time("2 minutes") == 120 * T.SIMTIME_ONE_SECOND
+
+
+def test_format_time():
+    assert T.format_time(3 * T.SIMTIME_ONE_SECOND + 5) == "00:00:03.000000005"
+
+
+def test_config_xml_roundtrip():
+    xml = """
+    <shadow stoptime="60">
+      <topology path="topo.graphml"/>
+      <plugin id="tgen" path="x.so"/>
+      <host id="server" quantity="3" bandwidthdown="2048" bandwidthup="1024">
+        <process plugin="pingserver" starttime="1" arguments="port=8000"/>
+      </host>
+      <host id="client" iphint="11.0.0.5">
+        <process plugin="ping" starttime="2" arguments="peer=server1 port=8000"/>
+      </host>
+    </shadow>
+    """
+    scen = load_xml(xml)
+    assert scen.stop_time == 60 * T.SIMTIME_ONE_SECOND
+    assert scen.total_hosts() == 4
+    names = [n for _, n, _ in scen.expand_hosts()]
+    assert names == ["server1", "server2", "server3", "client"]
+    srv = scen.hosts[0]
+    assert srv.bandwidth_down == 2048 * 1024
+    assert srv.processes[0].start_time == T.SIMTIME_ONE_SECOND
+    assert scen.hosts[1].ip_hint == "11.0.0.5"
+
+
+def test_rng_determinism_and_independence():
+    root = R.root_key(42)
+    k1 = R.host_key(root, 7)
+    k2 = R.host_key(root, 8)
+    assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+    # same seed -> identical keys
+    again = R.host_key(R.root_key(42), 7)
+    assert np.array_equal(np.asarray(k1), np.asarray(again))
+    u1 = float(R.uniform_from(R.counter_key(k1, 0)))
+    u2 = float(R.uniform_from(R.counter_key(k1, 1)))
+    assert u1 != u2
+    assert 0.0 <= u1 < 1.0

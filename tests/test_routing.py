@@ -1,0 +1,80 @@
+"""Topology/DNS tests: path semantics must match the reference
+(see shadow_tpu.routing.topology docstring for the spec)."""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.core.simtime import SIMTIME_ONE_MILLISECOND
+from shadow_tpu.routing.dns import DNS
+from shadow_tpu.routing.graphml import parse_graphml
+from shadow_tpu.routing.topology import build_topology, attach_hosts
+
+TRIANGLE = """
+<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="d7"/>
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d9"/>
+  <key attr.name="packetloss" attr.type="double" for="node" id="d0"/>
+  <key attr.name="type" attr.type="string" for="node" id="d5"/>
+  <graph edgedefault="undirected">
+    <node id="a"><data key="d0">0.1</data><data key="d5">client</data></node>
+    <node id="b"><data key="d0">0.0</data><data key="d5">relay</data></node>
+    <node id="c"><data key="d0">0.2</data><data key="d5">server</data></node>
+    <edge source="a" target="b"><data key="d7">10.0</data><data key="d9">0.05</data></edge>
+    <edge source="b" target="c"><data key="d7">20.0</data><data key="d9">0.0</data></edge>
+    <edge source="a" target="c"><data key="d7">100.0</data><data key="d9">0.0</data></edge>
+    <edge source="a" target="a"><data key="d7">5.0</data><data key="d9">0.0</data></edge>
+  </graph>
+</graphml>
+"""
+
+
+def test_parse_graphml(simple_topology_xml):
+    g = parse_graphml(simple_topology_xml)
+    assert g.num_vertices == 2
+    assert g.num_edges == 3
+    assert g.v_bw_down[0] == 2048
+
+
+def test_shortest_path_latency():
+    topo = build_topology(TRIANGLE)
+    ms = SIMTIME_ONE_MILLISECOND
+    # a->c goes via b (30ms) not direct (100ms)
+    assert topo.latency_ns[0, 2] == 30 * ms
+    assert topo.latency_ns[2, 0] == 30 * ms
+    assert topo.latency_ns[0, 1] == 10 * ms
+    # self-loop on a: 5ms; no self-loop on b: reference 1ms fallback
+    assert topo.latency_ns[0, 0] == 5 * ms
+    assert topo.latency_ns[1, 1] == 1 * ms
+    assert topo.min_latency_ns == 1 * ms
+
+
+def test_path_reliability_matches_reference_formula():
+    topo = build_topology(TRIANGLE)
+    # a->c via b: (1-.1)src * (1-.05)(1-0) edges * (1-.2)dst; b's vertex
+    # loss (intermediate) is NOT applied, matching the reference.
+    expect = 0.9 * 0.95 * 1.0 * 0.8
+    assert topo.reliability[0, 2] == pytest.approx(expect, rel=1e-6)
+    # a->a: src vertex loss once * self-loop edge loss
+    assert topo.reliability[0, 0] == pytest.approx(0.9, rel=1e-6)
+
+
+def test_attach_hosts_type_hint():
+    topo = build_topology(TRIANGLE)
+    hints = [(None, None, "server")] * 5 + [(None, None, "client")] * 3
+    v = attach_hosts(topo, hints, seed=3)
+    assert (v[:5] == 2).all()
+    assert (v[5:] == 0).all()
+
+
+def test_dns_registry():
+    dns = DNS()
+    ip1 = dns.register(0, "alpha")
+    ip2 = dns.register(1, "beta")
+    assert ip1 != ip2
+    assert dns.resolve("alpha") == 0
+    assert dns.resolve(dns.ip_str(1)) == 1
+    assert dns.reverse(1) == "beta"
+    with pytest.raises(ValueError):
+        dns.register(2, "alpha")
+    arr = dns.ip_array(2)
+    assert arr[0] == ip1
