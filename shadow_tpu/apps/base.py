@@ -33,7 +33,9 @@ APP_PING = 1
 APP_PING_SERVER = 2
 APP_PHOLD = 3
 APP_TGEN = 4
-N_APP_KINDS = 5
+APP_BULK = 5
+APP_BULK_SERVER = 6
+N_APP_KINDS = 7
 
 
 def app_null(row, hp, sh, now, wake):
@@ -65,5 +67,7 @@ def dispatch(row, hp, sh, now, wake):
     from .ping import app_ping, app_ping_server
     from .phold import app_phold
     from .tgen import app_tgen
-    branches = [app_null, app_ping, app_ping_server, app_phold, app_tgen]
+    from .bulk import app_bulk, app_bulk_server
+    branches = [app_null, app_ping, app_ping_server, app_phold, app_tgen,
+                app_bulk, app_bulk_server]
     return jax.lax.switch(hp.app_kind, branches, row, hp, sh, now, wake)

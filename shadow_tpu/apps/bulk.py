@@ -1,0 +1,93 @@
+"""Bulk TCP transfer client/server — the tgen "bulk" workload shape.
+
+Equivalent to the reference example config's bulk clients
+(/root/reference/resource/examples/shadow.config.xml: tgen clients
+fetching fixed-size transfers from tgen servers on port 80): each client
+repeatedly opens a TCP connection to a server, PUTs a fixed number of
+bytes, closes, pauses, and repeats. This exercises the full TCP machine
+(handshake, windows, congestion control, retransmission, teardown); the
+general behavior-graph tgen app builds on the same calls.
+
+Client config (hp.app_cfg): c0=server host, c1=port, c2=bytes per
+transfer, c3=transfer count (0 = forever), c4=pause ns between
+transfers.
+Client registers: r0=socket, r1=transfers completed.
+Server config: c1=listen port. Server registers: r0=listener slot.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..engine.defs import (WAKE_START, WAKE_TIMER, WAKE_SOCKET,
+                           WAKE_CONNECTED, WAKE_EOF, WAKE_ACCEPT, WAKE_SENT,
+                           ST_XFER_DONE, ST_APP_DONE)
+from ..net import packet as P
+from ..net.tcp import tcp_connect, tcp_listen, tcp_write, tcp_close_call
+from .base import timer
+
+
+def _connect(row, hp, sh, now):
+    row, slot, ok = tcp_connect(row, hp, sh, now,
+                                dst_host=hp.app_cfg[0],
+                                dst_port=hp.app_cfg[1])
+    return row.replace(app_r=row.app_r.at[0].set(slot.astype(jnp.int64)))
+
+
+def app_bulk(row, hp, sh, now, wake):
+    reason = wake[P.ACK]
+    sock = row.app_r[0].astype(jnp.int32)
+
+    def on_start(r):
+        return _connect(r, hp, sh, now)
+
+    def on_connected(r):
+        return tcp_write(r, now, sock, hp.app_cfg[2])
+
+    def on_sent(r):
+        # all bytes acked: transfer complete; close and maybe go again
+        r = tcp_close_call(r, now, sock)
+        r = r.replace(
+            app_r=r.app_r.at[1].add(1),
+            stats=r.stats.at[ST_XFER_DONE].add(1))
+        done = (hp.app_cfg[3] > 0) & (r.app_r[1] >= hp.app_cfg[3])
+        return jax.lax.cond(
+            done,
+            lambda rr: rr.replace(stats=rr.stats.at[ST_APP_DONE].add(1)),
+            lambda rr: timer(rr, now + hp.app_cfg[4]), r)
+
+    def on_timer(r):
+        return _connect(r, hp, sh, now)
+
+    def nop(r):
+        return r
+
+    # reasons: START=0 TIMER=1 SOCKET=2 CONNECTED=3 EOF=4 ACCEPT=5 SENT=6
+    return jax.lax.switch(
+        jnp.clip(reason, 0, 6),
+        [on_start, on_timer, nop, on_connected, nop, nop, on_sent],
+        row)
+
+
+def app_bulk_server(row, hp, sh, now, wake):
+    reason = wake[P.ACK]
+
+    def on_start(r):
+        r, slot, ok = tcp_listen(r, hp.app_cfg[1])
+        return r.replace(app_r=r.app_r.at[0].set(slot.astype(jnp.int64)))
+
+    def on_eof(r):
+        # client finished sending: close our side (LAST_ACK path) and
+        # count the completed inbound transfer
+        child = wake[P.SEQ]
+        r = tcp_close_call(r, now, child)
+        return r.replace(stats=r.stats.at[ST_XFER_DONE].add(1))
+
+    def nop(r):
+        return r
+
+    return jax.lax.switch(
+        jnp.clip(reason, 0, 6),
+        [on_start, nop, nop, nop, on_eof, nop, nop],
+        row)

@@ -18,7 +18,8 @@ from __future__ import annotations
 import numpy as np
 
 from ..core.simtime import parse_time
-from .base import APP_PING, APP_PING_SERVER, APP_PHOLD, APP_TGEN
+from .base import (APP_PING, APP_PING_SERVER, APP_PHOLD, APP_TGEN,
+                   APP_BULK, APP_BULK_SERVER)
 
 
 def parse_kv(args: str) -> dict:
@@ -53,6 +54,16 @@ def compile_app(plugin: str, args: str, dns, num_hosts: int):
         cfg[3] = int(kv.get("size", 64))
         cfg[4] = int(kv.get("init", 1))
         return APP_PHOLD, cfg
+    if plugin == "bulk":
+        cfg[0] = dns.resolve(kv["peer"])
+        cfg[1] = int(kv.get("port", 80))
+        cfg[2] = int(kv.get("size", 1 << 20))
+        cfg[3] = int(kv.get("count", 1))
+        cfg[4] = parse_time(kv.get("pause", "1s"))
+        return APP_BULK, cfg
+    if plugin == "bulkserver":
+        cfg[1] = int(kv.get("port", 80))
+        return APP_BULK_SERVER, cfg
     if plugin == "tgen":
         return APP_TGEN, cfg
     raise ValueError(f"unknown plugin {plugin!r} "

@@ -49,11 +49,15 @@ def app_phold(row, hp, sh, now, wake):
         r, sock, ok = udp_open(r, port=hp.app_cfg[1])
         r = r.replace(app_r=r.app_r.at[0].set(jnp.int64(sock)))
 
-        # seed the system with c4 initial messages at exponential offsets
+        # Seed the system with c4 initial messages at exponential offsets.
+        # The bound must be clamped: under vmap every host executes every
+        # app branch masked, so an unclamped traced bound would spin on
+        # other apps' config words; the queue capacity is the true cap.
         def seed_one(i, rr):
             rr, d = _exp_delay(rr, hp, sh)
             return timer(rr, now + d)
-        n0 = hp.app_cfg[4].astype(jnp.int32)
+        qcap = r.eq_time.shape[0]
+        n0 = jnp.clip(hp.app_cfg[4], 0, qcap).astype(jnp.int32)
         return jax.lax.fori_loop(0, n0, seed_one, r)
 
     def on_timer(r):

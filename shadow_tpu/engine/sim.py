@@ -82,6 +82,47 @@ class SimReport:
         }
 
 
+def auto_engine_config(scenario: Scenario, topo: Topology) -> EngineConfig:
+    """Size the fixed-capacity buffers from the scenario.
+
+    The binding constraint is packets per lookahead window: a host's NIC
+    can emit up to bw_up * min_jump bytes between exchanges, so the
+    outbox (per-window emit budget) must cover that or the NIC defers to
+    the next window and throughput is artificially capped. Destination
+    fan-in gets 2x that budget; event queues must hold the inbound burst
+    plus timers/wakes. Capacities are clamped so memory stays bounded at
+    large H (beyond the clamp the NIC deferral keeps results exact, just
+    reflecting genuine queueing).
+    """
+    from ..core.constants import TCP_MSS
+
+    H = scenario.total_hosts()
+    min_jump = topo.min_latency_ns or DEFAULT_MIN_TIME_JUMP
+
+    bw = 0
+    for idx, name, spec in scenario.expand_hosts():
+        bw = max(bw, spec.bandwidth_up or 0, spec.bandwidth_down or 0)
+    if topo.v_bw_up_bytes.size:
+        bw = max(bw, int(topo.v_bw_up_bytes.max()),
+                 int(topo.v_bw_down_bytes.max()))
+    if bw <= 0:
+        bw = 128 * 1024 * 1024
+
+    pkts_per_window = (bw * min_jump) // (TCP_MSS * 10**9) + 1
+
+    def pow2(n, lo, hi):
+        v = lo
+        while v < n and v < hi:
+            v *= 2
+        return v
+
+    obcap = pow2(int(pkts_per_window * 5 // 4), 16, 512)
+    incap = pow2(2 * obcap, 32, 1024)
+    qcap = pow2(incap + 32, 32, 1024)
+    return EngineConfig(num_hosts=H, qcap=qcap, scap=16, obcap=obcap,
+                        incap=incap, txqcap=16)
+
+
 class Simulation:
     """Build and run one scenario on the JAX engine."""
 
@@ -94,7 +135,7 @@ class Simulation:
         self.topo = src if isinstance(src, Topology) else build_topology(src)
 
         H = scenario.total_hosts()
-        self.cfg = engine_cfg or EngineConfig(num_hosts=H)
+        self.cfg = engine_cfg or auto_engine_config(scenario, self.topo)
         assert self.cfg.num_hosts == H
 
         # --- register hosts: DNS, attachment, apps (reference
@@ -146,7 +187,8 @@ class Simulation:
 
         min_jump = self.topo.min_latency_ns or DEFAULT_MIN_TIME_JUMP
         self.sh = make_shared(self.topo.latency_ns, self.topo.reliability,
-                              R.root_key(seed), scenario.stop_time, min_jump)
+                              R.root_key(seed), scenario.stop_time, min_jump,
+                              cc_kind=self.cfg.cc_kind)
 
         # --- initial events: process starts (reference process_schedule) ---
         hosts = alloc_hosts(self.cfg)

@@ -79,6 +79,7 @@ class Hosts:
     sk_parent: jnp.ndarray   # i32 listener slot for accepted children (-1)
     sk_snd_una: jnp.ndarray  # i64 oldest unacked stream offset
     sk_snd_nxt: jnp.ndarray  # i64 next offset to transmit
+    sk_snd_max: jnp.ndarray  # i64 highest offset ever transmitted
     sk_snd_end: jnp.ndarray  # i64 total bytes app has written
     sk_rcv_nxt: jnp.ndarray  # i64 next in-order offset expected
     sk_peer_fin: jnp.ndarray  # i64 peer's FIN stream offset (-1 = none seen)
@@ -89,6 +90,8 @@ class Hosts:
     sk_srtt: jnp.ndarray     # i64 (-1 until first sample; RFC6298)
     sk_rttvar: jnp.ndarray   # i64
     sk_rto: jnp.ndarray      # i64 current retransmission timeout
+    sk_rto_deadline: jnp.ndarray  # i64 desired timer expiration (0 = off)
+    sk_timer_on: jnp.ndarray   # bool an EV_TCP_TIMER event is outstanding
     sk_timer_gen: jnp.ndarray  # i32 timer generation (stale-event filter)
     sk_dupacks: jnp.ndarray  # i32 duplicate-ack counter
     sk_rtt_seq: jnp.ndarray  # i64 offset being RTT-timed (-1 none; Karn)
@@ -98,9 +101,11 @@ class Hosts:
     sk_sndbuf: jnp.ndarray   # i64
     sk_rcvbuf: jnp.ndarray   # i64
     sk_hs_time: jnp.ndarray  # i64 handshake start (connect timeout/rtt)
+    sk_syn_tag: jnp.ndarray  # i32 connection-metadata tag carried on SYN
     # cubic congestion-control per-socket vars (net.congestion)
     sk_cc_wmax: jnp.ndarray   # f32 window before last loss
     sk_cc_epoch: jnp.ndarray  # i64 start of current cubic epoch (-1)
+    sk_cc_k: jnp.ndarray      # f32 cubic K (seconds to plateau)
     # --- app layer (vectorized behavior machines) ---
     app_node: jnp.ndarray  # [H] i32 current behavior-graph node / phase
     app_r: jnp.ndarray     # [H, 8] i64 app registers
@@ -134,6 +139,11 @@ class Shared:
     rng_root: jnp.ndarray  # PRNG key
     stop_time: jnp.ndarray  # i64 scalar
     min_jump: jnp.ndarray   # i64 scalar: lookahead window width
+    # TCP tuning scalars (reference --tcp-congestion-control /
+    # --tcp-windows / --tcp-ssthresh options, shd-options.c:132-133)
+    cc_kind: jnp.ndarray       # i32: 0=aimd 1=reno 2=cubic
+    tcp_init_wnd: jnp.ndarray  # f32 initial cwnd, packets (default 10)
+    tcp_ssthresh0: jnp.ndarray  # f32 initial ssthresh (0 = discover)
 
 
 def alloc_hosts(cfg: EngineConfig) -> Hosts:
@@ -168,6 +178,7 @@ def alloc_hosts(cfg: EngineConfig) -> Hosts:
         sk_parent=full((H, S), -1, jnp.int32),
         sk_snd_una=full((H, S), 0, jnp.int64),
         sk_snd_nxt=full((H, S), 0, jnp.int64),
+        sk_snd_max=full((H, S), 0, jnp.int64),
         sk_snd_end=full((H, S), 0, jnp.int64),
         sk_rcv_nxt=full((H, S), 0, jnp.int64),
         sk_peer_fin=full((H, S), -1, jnp.int64),
@@ -178,6 +189,8 @@ def alloc_hosts(cfg: EngineConfig) -> Hosts:
         sk_srtt=full((H, S), -1, jnp.int64),
         sk_rttvar=full((H, S), 0, jnp.int64),
         sk_rto=full((H, S), C.TCP_RTO_INIT, jnp.int64),
+        sk_rto_deadline=full((H, S), 0, jnp.int64),
+        sk_timer_on=full((H, S), False, jnp.bool_),
         sk_timer_gen=full((H, S), 0, jnp.int32),
         sk_dupacks=full((H, S), 0, jnp.int32),
         sk_rtt_seq=full((H, S), -1, jnp.int64),
@@ -187,8 +200,10 @@ def alloc_hosts(cfg: EngineConfig) -> Hosts:
         sk_sndbuf=full((H, S), C.SEND_BUFFER_SIZE, jnp.int64),
         sk_rcvbuf=full((H, S), C.RECV_BUFFER_SIZE, jnp.int64),
         sk_hs_time=full((H, S), 0, jnp.int64),
+        sk_syn_tag=full((H, S), 0, jnp.int32),
         sk_cc_wmax=full((H, S), 0.0, jnp.float32),
         sk_cc_epoch=full((H, S), -1, jnp.int64),
+        sk_cc_k=full((H, S), 0.0, jnp.float32),
         app_node=full((H,), 0, jnp.int32),
         app_r=full((H, 8), 0, jnp.int64),
         ob_pkt=full((H, O, PKT_WORDS), 0, jnp.int32),
@@ -199,11 +214,16 @@ def alloc_hosts(cfg: EngineConfig) -> Hosts:
 
 
 def make_shared(topo_lat_ns: np.ndarray, topo_rel: np.ndarray, rng_root,
-                stop_time: int, min_jump: int) -> Shared:
+                stop_time: int, min_jump: int, cc_kind: int = 2,
+                tcp_init_wnd: float = 10.0,
+                tcp_ssthresh0: float = 0.0) -> Shared:
     return Shared(
         lat_ns=jnp.asarray(topo_lat_ns, dtype=jnp.int64),
         rel=jnp.asarray(topo_rel, dtype=jnp.float32),
         rng_root=rng_root,
         stop_time=jnp.int64(stop_time),
         min_jump=jnp.int64(min_jump),
+        cc_kind=jnp.int32(cc_kind),
+        tcp_init_wnd=jnp.float32(tcp_init_wnd),
+        tcp_ssthresh0=jnp.float32(tcp_ssthresh0),
     )

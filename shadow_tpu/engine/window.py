@@ -48,16 +48,19 @@ from .state import EngineConfig
 
 
 # --- Event handlers (row-level) -------------------------------------------
+# Signature: handler(row, hp, sh, now, wend, pkt). `wend` is the current
+# window bound so the NIC can defer work past the next exchange when its
+# per-window emit budget is spent (overflow-to-next-window, never drop).
 
-def _on_null(row, hp, sh, now, pkt):
+def _on_null(row, hp, sh, now, wend, pkt):
     return row
 
 
-def _on_app(row, hp, sh, now, pkt):
+def _on_app(row, hp, sh, now, wend, pkt):
     return app_dispatch(row, hp, sh, now, pkt)
 
 
-def _on_pkt(row, hp, sh, now, pkt):
+def _on_pkt(row, hp, sh, now, wend, pkt):
     """Packet arrival at the NIC: admission, demux, protocol dispatch."""
     row, keep = nic.rx_admit(row, hp, now, pkt)
 
@@ -95,7 +98,7 @@ def step_one_host(row, hp, sh, wend):
     pkt = row.eq_pkt[slot]
     row = jax.lax.cond(ready, lambda r: equeue.q_clear_slot(r, slot),
                        lambda r: r, row)
-    row = jax.lax.switch(kind, _HANDLERS, row, hp, sh, t, pkt)
+    row = jax.lax.switch(kind, _HANDLERS, row, hp, sh, t, wend, pkt)
     return row.replace(
         stats=row.stats.at[ST_EVENTS].add(jnp.where(ready, 1, 0)))
 

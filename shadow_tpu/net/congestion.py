@@ -1,0 +1,93 @@
+"""Pluggable TCP congestion control, vectorized.
+
+Reimplements the behavior of the reference's CC vtable family
+(/root/reference/src/main/host/descriptor/shd-tcp-congestion.h:31-41,
+shd-tcp-aimd.c, shd-tcp-reno.c, shd-tcp-cubic.c) as branchless masked
+arithmetic selected by a runtime kind scalar (Shared.cc_kind), default
+cubic like the reference (shd-options.c:133).
+
+Window semantics follow the reference: the congestion window is counted
+in *packets* (segments), the initial window is 10 packets
+(shd-options.c:72), and a zero slow-start threshold means "not yet
+discovered" — multiplicative increase continues until the first loss
+sets it (shd-tcp-aimd.c:20-27,46-49).
+
+State per socket (columns of Hosts):
+  sk_cwnd      f32  congestion window, packets
+  sk_ssthresh  f32  slow-start threshold, packets (0 = unset)
+  sk_cc_wmax   f32  cubic: window before last loss (lastMaxWindow)
+  sk_cc_epoch  i64  cubic: epoch start time ns (-1 = unset)
+  sk_cc_k      f32  cubic: K, seconds until plateau
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+CC_AIMD = 0
+CC_RENO = 1
+CC_CUBIC = 2
+
+# Linux/reference cubic constants: beta = 717/1024, C = 0.4 pkt/s^3
+# (shd-tcp-cubic.c uses the same fixed-point beta via BETA_SCALE=1024).
+_CUBIC_BETA = 717.0 / 1024.0
+_CUBIC_C = 0.4
+
+_NS = 1e-9  # ns -> seconds
+
+
+def on_ack(kind, cwnd, ssthresh, wmax, epoch, k, npkts, now):
+    """Congestion avoidance on new-data ACK.
+
+    Args are per-socket scalars (or broadcastable arrays); `kind` is the
+    runtime cc selector, `npkts` the number of full segments this ACK
+    newly covered, `now` sim time ns.
+    Returns (cwnd', epoch', k').
+    """
+    npkts_f = npkts.astype(jnp.float32)
+    in_ss = (ssthresh == 0.0) | (cwnd < ssthresh)
+
+    # --- slow start (all kinds): window += packets acked ---
+    ss_cwnd = cwnd + npkts_f
+
+    # --- aimd/reno additive increase: ceil/frac of n^2/window ---
+    add_cwnd = cwnd + (npkts_f * npkts_f) / jnp.maximum(cwnd, 1.0)
+
+    # --- cubic: W(t) = C*(t-K)^3 + wmax, one epoch per loss-free run ---
+    fresh = epoch < 0
+    epoch2 = jnp.where(fresh, now, epoch)
+    k_calc = jnp.cbrt(jnp.maximum(wmax - cwnd, 0.0) / _CUBIC_C)
+    k2 = jnp.where(fresh, k_calc, k)
+    t = (now - epoch2).astype(jnp.float32) * _NS
+    target = _CUBIC_C * (t - k2) ** 3 + jnp.maximum(wmax, cwnd)
+    cubic_step = jnp.where(target > cwnd,
+                           (target - cwnd) / jnp.maximum(cwnd, 1.0),
+                           0.01 / jnp.maximum(cwnd, 1.0))
+    cubic_cwnd = cwnd + jnp.minimum(cubic_step, npkts_f)
+
+    avoid_cwnd = jnp.where(kind == CC_CUBIC, cubic_cwnd, add_cwnd)
+    cwnd2 = jnp.where(in_ss, ss_cwnd, avoid_cwnd)
+    # epoch/k only meaningful for cubic avoidance; harmless otherwise
+    epoch2 = jnp.where(in_ss, epoch, epoch2)
+    k2 = jnp.where(in_ss, k, k2)
+    return cwnd2, epoch2, k2
+
+
+def on_loss(kind, cwnd, ssthresh, wmax):
+    """Multiplicative decrease on a loss event (fast retransmit or RTO).
+
+    Mirrors the reference's packetLoss vtable calls and the caller's
+    `threshold = packetLoss(); window = threshold` contract
+    (shd-tcp.c:1063-1064).
+    Returns (cwnd', ssthresh', wmax', epoch'=-1).
+    """
+    # aimd/reno: halve (shd-tcp-aimd.c:44-60)
+    half = jnp.maximum(jnp.ceil(cwnd / 2.0), 1.0)
+    # cubic: fast convergence on wmax, beta decrease (shd-tcp-cubic.c:224-236)
+    wmax2 = jnp.where(cwnd < wmax, cwnd * (1.0 + _CUBIC_BETA) / 2.0, cwnd)
+    cub = jnp.maximum(cwnd * _CUBIC_BETA, 2.0)
+
+    new_wnd = jnp.where(kind == CC_CUBIC, cub, half)
+    return (new_wnd, new_wnd,
+            jnp.where(kind == CC_CUBIC, wmax2, wmax),
+            jnp.int64(-1))

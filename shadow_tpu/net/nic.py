@@ -94,13 +94,30 @@ def kick(row, now):
     return jax.lax.cond(need, sched, lambda r: r, row)
 
 
-def on_tx(row, hp, sh, now, pkt):
+def on_tx(row, hp, sh, now, wend, pkt):
     """EV_NIC_TX handler: pull one packet — transmit ring first (UDP and
     queued control), else the round-robin-selected TCP socket — emit it,
-    account bandwidth, reschedule while work remains."""
-    from .tcp import tcp_pull
+    account bandwidth, reschedule while work remains.
 
+    When the outbox (this window's emit budget) is full, transmission is
+    deferred to the window boundary instead of dropping: the exchange
+    drains the outbox between windows, so an EV_NIC_TX at `wend` resumes
+    with a fresh budget. Deterministic overflow-to-next-window."""
     row = row.replace(nic_sched=jnp.bool_(False))
+
+    no_room = row.ob_cnt >= row.ob_time.shape[0]
+
+    def defer(r):
+        r = equeue.q_push(r, jnp.maximum(wend, now + 1), EV_NIC_TX,
+                          jnp.zeros((P.PKT_WORDS,), jnp.int32))
+        return r.replace(nic_sched=jnp.bool_(True))
+
+    return jax.lax.cond(no_room, defer,
+                        lambda r: _tx_pull(r, hp, sh, now), row)
+
+
+def _tx_pull(row, hp, sh, now):
+    from .tcp import tcp_pull
     want = tx_want(row)
     S = want.shape[0]
     order = (jnp.arange(S) + row.nic_rr) % S
@@ -128,7 +145,8 @@ def on_tx(row, hp, sh, now, pkt):
     row, out_pkt, has_pkt = jax.lax.cond(ring_has, pull_ring, pull_tcp, row)
 
     wire = P.wire_bytes(out_pkt)
-    busy_end = now + jnp.where(has_pkt, tx_duration(wire, hp.bw_up), 0)
+    busy_end = now + jnp.where(has_pkt, jnp.maximum(
+        tx_duration(wire, hp.bw_up), 1), 0)
     row = jax.lax.cond(has_pkt, lambda r: emit(r, hp, now, out_pkt),
                        lambda r: r, row)
     row = row.replace(nic_busy=busy_end)

@@ -20,6 +20,9 @@ Word layout (all int32):
   9 AUX    TCP: timestamp echo / listener child hint; apps: opaque tag
  10 UID    per-source packet counter stamped at emit; (SRC, UID) is the
            globally unique packet id keying the loss roll (rng.DOMAIN_DROP)
+ 11 APP    application tag: connection metadata on TCP SYNs (e.g. a tgen
+           GET request size rides the handshake), opaque app payload tag
+           on datagrams. The modeled-app analogue of payload content.
 
 Note on sequence numbers: stream offsets are plain byte counts starting
 at 0 (SYN/FIN are modeled as control flags with their own state-machine
@@ -31,9 +34,10 @@ space scale; connections are per-transfer in the bundled apps.
 
 import jax.numpy as jnp
 
-PKT_WORDS = 11
+PKT_WORDS = 12
 
-SRC, DST, SPORT, DPORT, FLAGS, SEQ, ACK, WND, LEN, AUX, UID = range(11)
+(SRC, DST, SPORT, DPORT, FLAGS, SEQ, ACK, WND, LEN, AUX, UID,
+ APP) = range(12)
 
 # FLAGS word
 PROTO_MASK = 0xFF
@@ -50,13 +54,14 @@ F_RST = 1 << 11
 from ..core.constants import HEADER_SIZE_TCPIPETH, HEADER_SIZE_UDPIPETH  # noqa: E402
 
 
-def make(src, dst, sport, dport, flags, seq=0, ack=0, wnd=0, length=0, aux=0):
+def make(src, dst, sport, dport, flags, seq=0, ack=0, wnd=0, length=0,
+         aux=0, app=0):
     """Assemble a packet word vector (traced or concrete int32s).
     UID is stamped later, at NIC emit time."""
     return jnp.stack([
         jnp.int32(src), jnp.int32(dst), jnp.int32(sport), jnp.int32(dport),
         jnp.int32(flags), jnp.int32(seq), jnp.int32(ack), jnp.int32(wnd),
-        jnp.int32(length), jnp.int32(aux), jnp.int32(0),
+        jnp.int32(length), jnp.int32(aux), jnp.int32(0), jnp.int32(app),
     ])
 
 

@@ -55,6 +55,7 @@ def sock_alloc(row, proto):
         sk_parent=setf(row.sk_parent, -1, jnp.int32),
         sk_snd_una=setf(row.sk_snd_una, 0, jnp.int64),
         sk_snd_nxt=setf(row.sk_snd_nxt, 0, jnp.int64),
+        sk_snd_max=setf(row.sk_snd_max, 0, jnp.int64),
         sk_snd_end=setf(row.sk_snd_end, 0, jnp.int64),
         sk_rcv_nxt=setf(row.sk_rcv_nxt, 0, jnp.int64),
         sk_peer_fin=setf(row.sk_peer_fin, -1, jnp.int64),
@@ -65,6 +66,8 @@ def sock_alloc(row, proto):
         sk_srtt=setf(row.sk_srtt, -1, jnp.int64),
         sk_rttvar=setf(row.sk_rttvar, 0, jnp.int64),
         sk_rto=setf(row.sk_rto, TCP_RTO_INIT, jnp.int64),
+        sk_rto_deadline=setf(row.sk_rto_deadline, 0, jnp.int64),
+        sk_timer_on=setf(row.sk_timer_on, False, jnp.bool_),
         sk_timer_gen=row.sk_timer_gen.at[slot].add(jnp.where(ok, 1, 0)),
         sk_dupacks=setf(row.sk_dupacks, 0, jnp.int32),
         sk_rtt_seq=setf(row.sk_rtt_seq, -1, jnp.int64),
@@ -74,8 +77,10 @@ def sock_alloc(row, proto):
         sk_sndbuf=setf(row.sk_sndbuf, SEND_BUFFER_SIZE, jnp.int64),
         sk_rcvbuf=setf(row.sk_rcvbuf, RECV_BUFFER_SIZE, jnp.int64),
         sk_hs_time=setf(row.sk_hs_time, 0, jnp.int64),
+        sk_syn_tag=setf(row.sk_syn_tag, 0, jnp.int32),
         sk_cc_wmax=setf(row.sk_cc_wmax, 0.0, jnp.float32),
         sk_cc_epoch=setf(row.sk_cc_epoch, -1, jnp.int64),
+        sk_cc_k=setf(row.sk_cc_k, 0.0, jnp.float32),
     )
     return row, slot, ok
 
@@ -87,6 +92,8 @@ def sock_free(row, slot):
         sk_proto=row.sk_proto.at[slot].set(0),
         sk_state=row.sk_state.at[slot].set(TCPS_CLOSED),
         sk_ctl=row.sk_ctl.at[slot].set(0),
+        sk_rto_deadline=row.sk_rto_deadline.at[slot].set(0),
+        sk_timer_on=row.sk_timer_on.at[slot].set(False),
         sk_timer_gen=row.sk_timer_gen.at[slot].add(1),
     )
 

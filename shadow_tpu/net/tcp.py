@@ -1,43 +1,613 @@
-"""TCP: vectorized state machine over the socket table.
+"""TCP: vectorized connection state machine over the socket table.
 
 Re-implements the behavior of the reference's TCP
-(/root/reference/src/main/host/descriptor/shd-tcp.c, 2254 LoC): the
-11-state machine, server multiplexing into child sockets, sliding
-windows, RFC6298 retransmission timers, fast retransmit, and pluggable
-congestion control — as branch-masked vectorized kernels instead of
-per-connection callbacks.
+(/root/reference/src/main/host/descriptor/shd-tcp.c, 2254 LoC) as
+branch-masked row-level kernels instead of per-connection callbacks:
 
-This module currently carries the interface stubs wired into the NIC;
-the full state machine lands with the TCP milestone.
+- the 11-state machine (shd-tcp.c:10-15) lives in sk_state;
+- server multiplexing into child sockets keyed by peer
+  (shd-tcp.c:56-78,198-264) becomes child-row allocation on SYN plus the
+  exact-4-tuple demux preference in socket.sock_demux;
+- sliding windows (shd-tcp.c:88-132) are stream-offset arithmetic on
+  sk_snd_una/nxt/max/end and sk_rcv_nxt (SYN/FIN are control flags with
+  their own retransmission, not sequence-space occupants — see
+  net.packet for the offset model);
+- the retransmit queue + RFC6298 RTO timer chain (shd-tcp.c:729-843,
+  1068-1128) becomes go-back-N from snd_una driven by one outstanding
+  EV_TCP_TIMER per socket with a desired-deadline re-check, mirroring
+  the reference's desiredTimerExpiration pattern (shd-tcp.c:1091-1100);
+- dupack-counting fast retransmit stands in for the SACK scoreboard
+  (shd-tcp-scoreboard.c) — the receiver drops out-of-order segments and
+  acks every arrival, so cumulative-ack recovery is exact go-back-N;
+- congestion control is the pluggable aimd/reno/cubic family
+  (net.congestion), entered via the same avoidance/packetLoss seams as
+  the reference (shd-tcp.c:1809,1063-1064);
+- the close handshake (FIN/ACK, TIME_WAIT with the 60s close timer,
+  shd-tcp.c:439-523) runs on EV_TCP_CLOSE events.
+
+All functions are row-level (one host under vmap). App-facing calls:
+tcp_listen, tcp_connect, tcp_write, tcp_close_call.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
+from ..core.constants import (TCP_MSS, TCP_RTO_MIN, TCP_RTO_MAX,
+                              TCP_CLOSE_TIMER_DELAY)
+from ..engine import equeue
+from ..engine.defs import (EV_APP, EV_TCP_TIMER, EV_TCP_CLOSE,
+                           WAKE_CONNECTED, WAKE_ACCEPT, WAKE_SOCKET,
+                           WAKE_EOF, WAKE_SENT,
+                           ST_BYTES_RECV, ST_BYTES_SENT, ST_RETRANSMIT,
+                           ST_SOCK_FAIL)
+from . import congestion as CC
+from . import nic
 from . import packet as P
+from .socket import (TCPS_CLOSED, TCPS_LISTEN, TCPS_SYN_SENT,
+                     TCPS_SYN_RECEIVED, TCPS_ESTABLISHED, TCPS_FIN_WAIT_1,
+                     TCPS_FIN_WAIT_2, TCPS_CLOSE_WAIT, TCPS_CLOSING,
+                     TCPS_LAST_ACK, TCPS_TIME_WAIT,
+                     CTL_SYN, CTL_SYNACK, CTL_ACKNOW, CTL_FIN, CTL_RST,
+                     sock_alloc, sock_free, alloc_eport)
+
+_I32 = jnp.int32
+_I64 = jnp.int64
+
+# AUX bit on ACK-bearing segments: "your FIN is fully received" — the
+# offset model's stand-in for acking the FIN's sequence slot.
+AUX_FINACK = 1
+
+
+def _set(row, slot, **kw):
+    """Set row.<field>[slot] = value for each kwarg."""
+    return row.replace(
+        **{f: getattr(row, f).at[slot].set(v) for f, v in kw.items()})
+
+
+def _wake(row, now, reason, slot, pkt=None, ln=0, aux=0):
+    """Schedule an EV_APP notification — the vectorized analogue of the
+    epoll-notify -> process_continue reentry (shd-epoll.c:597-658)."""
+    w = jnp.zeros((P.PKT_WORDS,), _I32) if pkt is None else pkt
+    w = (w.at[P.ACK].set(_I32(reason))
+          .at[P.SEQ].set(_I32(slot))
+          .at[P.LEN].set(_I32(ln))
+          .at[P.AUX].set(_I32(aux)))
+    return equeue.q_push(row, now + 1, EV_APP, w)
+
+
+def _arm_timer(row, slot, now):
+    """Ensure the retransmission timer will fire at now + rto.
+
+    Keeps at most one EV_TCP_TIMER outstanding per socket: if one is in
+    flight we only move the desired deadline and the handler re-chains
+    (the reference's desiredTimerExpiration check, shd-tcp.c:1091-1100).
+    """
+    deadline = now + row.sk_rto[slot]
+    need_event = ~row.sk_timer_on[slot]
+
+    def push(r):
+        ev = (jnp.zeros((P.PKT_WORDS,), _I32)
+              .at[P.SEQ].set(_I32(slot))
+              .at[P.ACK].set(r.sk_timer_gen[slot]))
+        r = equeue.q_push(r, deadline, EV_TCP_TIMER, ev)
+        return _set(r, slot, sk_timer_on=jnp.bool_(True))
+
+    row = _set(row, slot, sk_rto_deadline=deadline)
+    return jax.lax.cond(need_event, push, lambda r: r, row)
+
+
+def _stop_timer(row, slot):
+    return _set(row, slot, sk_rto_deadline=_I64(0))
+
+
+# --- App-facing calls ------------------------------------------------------
+
+def tcp_listen(row, port):
+    """Create a listening socket on `port`. Returns (row, slot, ok)."""
+    row, slot, ok = sock_alloc(row, P.PROTO_TCP)
+    row = _set(row, slot,
+               sk_state=jnp.where(ok, TCPS_LISTEN, row.sk_state[slot]),
+               sk_lport=jnp.where(ok, _I32(port), row.sk_lport[slot]))
+    return row, slot, ok
+
+
+def tcp_connect(row, hp, sh, now, dst_host, dst_port, tag=0):
+    """Active open to (dst_host, dst_port). Returns (row, slot, ok).
+    Sends SYN via the NIC; app is woken WAKE_CONNECTED on completion.
+    `tag` is app connection metadata carried in the SYN's APP word and
+    delivered to the acceptor (e.g. a tgen GET request size)."""
+    row, slot, ok = sock_alloc(row, P.PROTO_TCP)
+    row, lport = alloc_eport(row)
+
+    def setup(r):
+        r = _set(r, slot,
+                 sk_state=_I32(TCPS_SYN_SENT),
+                 sk_lport=lport.astype(_I32),
+                 sk_rport=_I32(dst_port),
+                 sk_rhost=_I32(dst_host),
+                 sk_ctl=_I32(CTL_SYN),
+                 sk_cwnd=sh.tcp_init_wnd,
+                 sk_ssthresh=sh.tcp_ssthresh0,
+                 sk_hs_time=_I64(now),
+                 sk_syn_tag=_I32(tag))
+        r = _arm_timer(r, slot, now)
+        return nic.kick(r, now)
+
+    row = jax.lax.cond(ok, setup,
+                       lambda r: r.replace(
+                           stats=r.stats.at[ST_SOCK_FAIL].add(1)), row)
+    return row, slot, ok
+
+
+def tcp_write(row, now, slot, nbytes):
+    """App writes `nbytes` to the stream (payload is not materialized;
+    only byte counts flow, as with all modeled apps)."""
+    row = _set(row, slot,
+               sk_snd_end=row.sk_snd_end[slot] + _I64(nbytes))
+    return nic.kick(row, now)
+
+
+def tcp_close_call(row, now, slot):
+    """App close: FIN after in-flight data drains (close_after), or
+    immediate teardown for listeners/unconnected sockets."""
+    state = row.sk_state[slot]
+    instant = ((state == TCPS_LISTEN) | (state == TCPS_CLOSED) |
+               (state == TCPS_SYN_SENT) | (state == TCPS_SYN_RECEIVED))
+
+    def now_free(r):
+        return sock_free(r, slot)
+
+    def deferred(r):
+        r = _set(r, slot, sk_close_after=jnp.bool_(True))
+        return nic.kick(r, now)
+
+    return jax.lax.cond(instant, now_free, deferred, row)
+
+
+# --- Transmit path (NIC pull) ----------------------------------------------
+
+def _win_bytes(row, slot):
+    """Effective send window: min(cwnd, peer advertised window)."""
+    cw = (row.sk_cwnd[slot].astype(_I64)) * TCP_MSS
+    return jnp.minimum(cw, jnp.maximum(row.sk_peer_rwnd[slot], 1))
+
+
+def _fin_wait_states(state):
+    return ((state == TCPS_FIN_WAIT_1) | (state == TCPS_CLOSING) |
+            (state == TCPS_LAST_ACK))
 
 
 def tcp_want_tx(row):
-    """[S] bool: TCP sockets owing the wire a data segment."""
-    return jnp.zeros_like(row.sk_used)
+    """[S] bool: sockets owing the wire a data segment or a first FIN.
+    (Control-flag work is covered by sk_ctl != 0 in nic.tx_want.)"""
+    open_tx = ((row.sk_state == TCPS_ESTABLISHED) |
+               (row.sk_state == TCPS_CLOSE_WAIT))
+    cw = row.sk_cwnd.astype(_I64) * TCP_MSS
+    win = jnp.minimum(cw, jnp.maximum(row.sk_peer_rwnd, 1))
+    data_ok = (open_tx & (row.sk_snd_nxt < row.sk_snd_end) &
+               (row.sk_snd_nxt < row.sk_snd_una + win))
+    fin_due = (open_tx & row.sk_close_after &
+               (row.sk_snd_nxt == row.sk_snd_end))
+    return (row.sk_proto == P.PROTO_TCP) & (data_ok | fin_due)
+
+
+def _finack_aux(row, slot):
+    pf = row.sk_peer_fin[slot]
+    got_fin = (pf >= 0) & (row.sk_rcv_nxt[slot] >= pf)
+    return jnp.where(got_fin, AUX_FINACK, 0).astype(_I32)
 
 
 def tcp_pull(row, hp, sh, now, slot):
-    """NIC pull for a TCP socket. Returns (row, pkt, has_pkt)."""
-    return row, jnp.zeros((P.PKT_WORDS,), jnp.int32), jnp.bool_(False)
+    """NIC pull: produce this socket's next packet (one per TX event).
+    Priority: RST > SYN > SYNACK > data > FIN > pure ACK.
+    Returns (row, pkt, has_pkt)."""
+    state = row.sk_state[slot]
+    ctl = row.sk_ctl[slot]
+    open_tx = (state == TCPS_ESTABLISHED) | (state == TCPS_CLOSE_WAIT)
+
+    snd_nxt = row.sk_snd_nxt[slot]
+    snd_end = row.sk_snd_end[slot]
+    limit = row.sk_snd_una[slot] + _win_bytes(row, slot)
+    can_data = open_tx & (snd_nxt < snd_end) & (snd_nxt < limit)
+
+    fin_first = (open_tx & row.sk_close_after[slot] & (snd_nxt == snd_end))
+    fin_rexmit = ((ctl & CTL_FIN) != 0) & _fin_wait_states(state)
+
+    p_rst = (ctl & CTL_RST) != 0
+    p_syn = (ctl & CTL_SYN) != 0
+    p_synack = (ctl & CTL_SYNACK) != 0
+    p_fin = (fin_first | fin_rexmit) & ~can_data
+    p_ack = (ctl & CTL_ACKNOW) != 0
+
+    sel = jnp.where(p_rst, 0,
+          jnp.where(p_syn, 1,
+          jnp.where(p_synack, 2,
+          jnp.where(can_data, 3,
+          jnp.where(p_fin, 4,
+          jnp.where(p_ack, 5, -1))))))
+    has = sel >= 0
+
+    # common header
+    base_flags = _I32(P.PROTO_TCP)
+    ack_no = row.sk_rcv_nxt[slot].astype(_I32)
+    wnd = jnp.minimum(row.sk_rcvbuf[slot], _I64(2**31 - 1)).astype(_I32)
+    aux = _finack_aux(row, slot)
+
+    ln = jnp.where(sel == 3,
+                   jnp.minimum(_I64(TCP_MSS),
+                               jnp.minimum(snd_end, limit) - snd_nxt),
+                   _I64(0)).astype(_I32)
+    seq = jnp.where(sel == 3, snd_nxt,
+          jnp.where(sel == 4, snd_end, _I64(0))).astype(_I32)
+    flags = base_flags
+    flags = flags | jnp.where((sel == 1) | (sel == 2), P.F_SYN, 0)
+    flags = flags | jnp.where(sel == 0, P.F_RST, 0)
+    flags = flags | jnp.where(sel == 4, P.F_FIN, 0)
+    flags = flags | jnp.where((sel == 2) | (sel >= 3), P.F_ACK, 0)
+
+    pkt = P.make(src=hp.hid, dst=row.sk_rhost[slot],
+                 sport=row.sk_lport[slot], dport=row.sk_rport[slot],
+                 flags=flags, seq=seq, ack=ack_no, wnd=wnd, length=ln,
+                 aux=aux,
+                 app=jnp.where(sel == 1, row.sk_syn_tag[slot], 0))
+
+    # --- state updates per selection ---
+    # clear the control bit we served; any ACK-bearing send satisfies ACKNOW
+    clr = jnp.where(sel == 0, CTL_RST,
+          jnp.where(sel == 1, CTL_SYN,
+          jnp.where(sel == 2, CTL_SYNACK,
+          jnp.where(sel == 4, CTL_FIN, 0))))
+    acked_too = (sel == 2) | (sel >= 3)
+    clr = clr | jnp.where(acked_too, CTL_ACKNOW, 0)
+    row = _set(row, slot, sk_ctl=ctl & ~clr)
+
+    # data accounting: first-transmission vs retransmission, RTT timing
+    is_data = sel == 3
+    snd_max = row.sk_snd_max[slot]
+    new_nxt = snd_nxt + ln.astype(_I64)
+    advance = is_data & (new_nxt > snd_max)
+    rexmit = is_data & (snd_nxt < snd_max)
+    fresh_bytes = jnp.where(advance, new_nxt - jnp.maximum(snd_max, snd_nxt),
+                            0)
+    row = row.replace(stats=row.stats
+                      .at[ST_BYTES_SENT].add(fresh_bytes)
+                      .at[ST_RETRANSMIT].add(jnp.where(rexmit, 1, 0)))
+    time_it = is_data & (row.sk_rtt_seq[slot] < 0) & ~rexmit
+    row = _set(row, slot,
+               sk_snd_nxt=jnp.where(is_data, new_nxt, snd_nxt),
+               sk_snd_max=jnp.where(advance, new_nxt, snd_max),
+               sk_rtt_seq=jnp.where(time_it, new_nxt,
+                                    row.sk_rtt_seq[slot]),
+               sk_rtt_time=jnp.where(time_it, now,
+                                     row.sk_rtt_time[slot]))
+
+    # FIN send transitions: EST -> FIN_WAIT_1, CLOSE_WAIT -> LAST_ACK
+    is_fin = sel == 4
+    st2 = jnp.where(is_fin & (state == TCPS_ESTABLISHED), TCPS_FIN_WAIT_1,
+          jnp.where(is_fin & (state == TCPS_CLOSE_WAIT), TCPS_LAST_ACK,
+                    state)).astype(_I32)
+    row = _set(row, slot, sk_state=st2)
+
+    # RST teardown after emit
+    row = jax.lax.cond(sel == 0, lambda r: sock_free(r, slot),
+                       lambda r: r, row)
+
+    # arm the retransmission timer for anything that expects an answer
+    needs_timer = (sel == 1) | (sel == 2) | is_data | is_fin
+    row = jax.lax.cond(needs_timer, lambda r: _arm_timer(r, slot, now),
+                       lambda r: r, row)
+    return row, pkt, has
+
+
+# --- Receive path ----------------------------------------------------------
+
+def _rfc6298(srtt, rttvar, sample):
+    """RFC6298 smoothed-RTT update (reference shd-tcp.c:844-874).
+    Returns (srtt', rttvar', rto')."""
+    first = srtt < 0
+    srtt1 = jnp.where(first, sample, (7 * srtt + sample) // 8)
+    rttvar1 = jnp.where(first, sample // 2,
+                        (3 * rttvar + jnp.abs(srtt - sample)) // 4)
+    rto = jnp.clip(srtt1 + jnp.maximum(4 * rttvar1, 1),
+                   TCP_RTO_MIN, TCP_RTO_MAX)
+    return srtt1, rttvar1, rto
+
+
+def _accept_syn(row, hp, sh, now, lslot, pkt):
+    """Listener got a SYN: allocate a child connection row in
+    SYN_RECEIVED owing a SYN|ACK — the reference's multiplexed-children
+    pattern (shd-tcp.c:198-264)."""
+    row, child, ok = sock_alloc(row, P.PROTO_TCP)
+
+    def setup(r):
+        r = _set(r, child,
+                 sk_state=_I32(TCPS_SYN_RECEIVED),
+                 sk_lport=pkt[P.DPORT],
+                 sk_rport=pkt[P.SPORT],
+                 sk_rhost=pkt[P.SRC],
+                 sk_parent=_I32(lslot),
+                 sk_ctl=_I32(CTL_SYNACK),
+                 sk_cwnd=sh.tcp_init_wnd,
+                 sk_ssthresh=sh.tcp_ssthresh0,
+                 sk_peer_rwnd=jnp.maximum(pkt[P.WND].astype(_I64), 1),
+                 sk_hs_time=_I64(now),
+                 sk_syn_tag=pkt[P.APP])
+        return _arm_timer(r, child, now)
+
+    return jax.lax.cond(ok, setup,
+                        lambda r: r.replace(
+                            stats=r.stats.at[ST_SOCK_FAIL].add(1)), row)
+
+
+def _rx_conn(row, hp, sh, now, slot, pkt):
+    """Segment processing for a non-listening socket — the analogue of
+    tcp_processPacket's state dispatch + _tcp_dataProcessing /
+    _tcp_ackProcessing (shd-tcp.c:1402-1552)."""
+    flags = pkt[P.FLAGS]
+    syn = (flags & P.F_SYN) != 0
+    ackf = (flags & P.F_ACK) != 0
+    fin = (flags & P.F_FIN) != 0
+    seq = pkt[P.SEQ].astype(_I64)
+    ackno = pkt[P.ACK].astype(_I64)
+    ln = pkt[P.LEN].astype(_I64)
+    finack = (pkt[P.AUX] & AUX_FINACK) != 0
+
+    state0 = row.sk_state[slot]
+
+    # --- A. establishment ---
+    estA = (state0 == TCPS_SYN_SENT) & syn & ackf       # our SYN answered
+    estB = (state0 == TCPS_SYN_RECEIVED) & ackf & ~syn  # our SYN|ACK acked
+    resyn = (state0 == TCPS_SYN_RECEIVED) & syn & ~ackf  # dup SYN: re-answer
+    state1 = jnp.where(estA | estB, TCPS_ESTABLISHED, state0).astype(_I32)
+
+    hs_rtt = now - row.sk_hs_time[slot]
+    hs_srtt, hs_rttvar, hs_rto = _rfc6298(row.sk_srtt[slot],
+                                          row.sk_rttvar[slot], hs_rtt)
+    est = estA | estB
+    row = _set(row, slot,
+               sk_state=state1,
+               sk_ctl=row.sk_ctl[slot]
+               | jnp.where(estA, CTL_ACKNOW, 0)
+               | jnp.where(resyn, CTL_SYNACK, 0),
+               sk_srtt=jnp.where(est, hs_srtt, row.sk_srtt[slot]),
+               sk_rttvar=jnp.where(est, hs_rttvar, row.sk_rttvar[slot]),
+               sk_rto=jnp.where(est, hs_rto, row.sk_rto[slot]),
+               sk_rto_deadline=jnp.where(est, _I64(0),
+                                         row.sk_rto_deadline[slot]))
+    row = jax.lax.cond(
+        est,
+        lambda r: _wake(r, now,
+                        jnp.where(estA, WAKE_CONNECTED, WAKE_ACCEPT), slot,
+                        pkt=pkt),
+        lambda r: r, row)
+
+    # --- B. ACK processing ---
+    conn = state1 >= TCPS_ESTABLISHED
+    valid_ack = ackf & conn
+    snd_una0 = row.sk_snd_una[slot]
+    snd_end = row.sk_snd_end[slot]
+    new_ack = valid_ack & (ackno > snd_una0)
+    acked_bytes = jnp.maximum(ackno - snd_una0, 0)
+    npkts = (acked_bytes + TCP_MSS - 1) // TCP_MSS
+    snd_una1 = jnp.where(new_ack, ackno, snd_una0)
+
+    # RTT sample (Karn: only the timed offset, cleared on retransmit)
+    rtt_seq = row.sk_rtt_seq[slot]
+    sample_ok = new_ack & (rtt_seq >= 0) & (ackno >= rtt_seq)
+    srtt1, rttvar1, rto1 = _rfc6298(row.sk_srtt[slot], row.sk_rttvar[slot],
+                                    jnp.maximum(now - row.sk_rtt_time[slot],
+                                                1))
+    # congestion: avoidance on new acks, loss on the 3rd dupack
+    dup = (valid_ack & (ackno == snd_una0) & (ln == 0) & ~syn & ~fin &
+           (row.sk_snd_nxt[slot] > snd_una0))
+    dupacks1 = jnp.where(new_ack, 0,
+                         row.sk_dupacks[slot] + jnp.where(dup, 1, 0))
+    fast_rx = dup & (dupacks1 == 3)
+
+    cw0, ss0 = row.sk_cwnd[slot], row.sk_ssthresh[slot]
+    wm0, ep0, k0 = (row.sk_cc_wmax[slot], row.sk_cc_epoch[slot],
+                    row.sk_cc_k[slot])
+    cw_a, ep_a, k_a = CC.on_ack(sh.cc_kind, cw0, ss0, wm0, ep0, k0,
+                                npkts, now)
+    cw_l, ss_l, wm_l, ep_l = CC.on_loss(sh.cc_kind, cw0, ss0, wm0)
+
+    row = _set(
+        row, slot,
+        sk_snd_una=snd_una1,
+        sk_dupacks=dupacks1.astype(_I32),
+        sk_peer_rwnd=jnp.where(valid_ack,
+                               jnp.maximum(pkt[P.WND].astype(_I64), 1),
+                               row.sk_peer_rwnd[slot]),
+        sk_srtt=jnp.where(sample_ok, srtt1, row.sk_srtt[slot]),
+        sk_rttvar=jnp.where(sample_ok, rttvar1, row.sk_rttvar[slot]),
+        sk_rto=jnp.where(sample_ok, rto1, row.sk_rto[slot]),
+        sk_rtt_seq=jnp.where(sample_ok, _I64(-1), rtt_seq),
+        sk_cwnd=jnp.where(fast_rx, cw_l, jnp.where(new_ack, cw_a, cw0)),
+        sk_ssthresh=jnp.where(fast_rx, ss_l, ss0),
+        sk_cc_wmax=jnp.where(fast_rx, wm_l, wm0),
+        sk_cc_epoch=jnp.where(fast_rx, ep_l,
+                              jnp.where(new_ack, ep_a, ep0)),
+        sk_cc_k=jnp.where(new_ack & ~fast_rx, k_a, k0),
+        # go-back-N retransmit entry (reference enters recovery and
+        # retransmits from the last cumulative ack, shd-tcp.c:1044-1066)
+        sk_snd_nxt=jnp.where(fast_rx, snd_una1, row.sk_snd_nxt[slot]),
+    )
+
+    # our FIN acked?
+    fin_done = valid_ack & finack & (ackno >= snd_end)
+    fin_acked1 = row.sk_fin_acked[slot] | fin_done
+    state2 = jnp.where(fin_acked1 & (state1 == TCPS_FIN_WAIT_1),
+                       TCPS_FIN_WAIT_2,
+              jnp.where(fin_acked1 & (state1 == TCPS_CLOSING),
+                        TCPS_TIME_WAIT,
+              jnp.where(fin_acked1 & (state1 == TCPS_LAST_ACK),
+                        TCPS_CLOSED, state1))).astype(_I32)
+    row = _set(row, slot, sk_fin_acked=fin_acked1, sk_state=state2)
+
+    # restart/stop the retransmission timer on forward progress
+    flight = ((row.sk_snd_nxt[slot] > snd_una1) |
+              (_fin_wait_states(state2) & ~fin_acked1))
+    row = _set(row, slot, sk_rto_deadline=jnp.where(
+        valid_ack, jnp.where(flight, now + row.sk_rto[slot], _I64(0)),
+        row.sk_rto_deadline[slot]))
+
+    # all-written-bytes-acked notification
+    sent_all = new_ack & (ackno >= snd_end) & (snd_end > 0)
+    row = jax.lax.cond(sent_all,
+                       lambda r: _wake(r, now, WAKE_SENT, slot, pkt=pkt),
+                       lambda r: r, row)
+
+    # --- C. data ---
+    can_rx = ((state2 == TCPS_ESTABLISHED) | (state2 == TCPS_FIN_WAIT_1) |
+              (state2 == TCPS_FIN_WAIT_2))
+    has_data = (ln > 0) & can_rx
+    rcv0 = row.sk_rcv_nxt[slot]
+    in_order = has_data & (seq == rcv0)
+    rcv1 = jnp.where(in_order, rcv0 + ln, rcv0)
+    row = _set(row, slot,
+               sk_rcv_nxt=rcv1,
+               sk_ctl=row.sk_ctl[slot] |
+               jnp.where((ln > 0) | fin, CTL_ACKNOW, 0))
+    row = row.replace(stats=row.stats.at[ST_BYTES_RECV].add(
+        jnp.where(in_order, ln, 0)))
+    row = jax.lax.cond(
+        in_order,
+        lambda r: _wake(r, now, WAKE_SOCKET, slot, pkt=pkt,
+                        ln=ln.astype(_I32), aux=pkt[P.AUX]),
+        lambda r: r, row)
+
+    # --- D. peer FIN ---
+    fin_valid = fin & (state2 >= TCPS_ESTABLISHED)
+    peer_fin1 = jnp.where(fin_valid, seq, row.sk_peer_fin[slot])
+    fin_complete = fin_valid & (rcv1 >= peer_fin1)
+    eof_now = fin_complete & ((state2 == TCPS_ESTABLISHED) |
+                              (state2 == TCPS_FIN_WAIT_1) |
+                              (state2 == TCPS_FIN_WAIT_2))
+    state3 = jnp.where(eof_now & (state2 == TCPS_ESTABLISHED),
+                       TCPS_CLOSE_WAIT,
+              jnp.where(eof_now & (state2 == TCPS_FIN_WAIT_1),
+                        jnp.where(fin_acked1, TCPS_TIME_WAIT, TCPS_CLOSING),
+              jnp.where(eof_now & (state2 == TCPS_FIN_WAIT_2),
+                        TCPS_TIME_WAIT, state2))).astype(_I32)
+    row = _set(row, slot, sk_peer_fin=peer_fin1, sk_state=state3)
+    row = jax.lax.cond(eof_now,
+                       lambda r: _wake(r, now, WAKE_EOF, slot, pkt=pkt),
+                       lambda r: r, row)
+
+    # --- E. terminal bookkeeping ---
+    to_time_wait = (state3 == TCPS_TIME_WAIT) & (state0 != TCPS_TIME_WAIT)
+
+    def sched_close(r):
+        ev = (jnp.zeros((P.PKT_WORDS,), _I32)
+              .at[P.SEQ].set(_I32(slot))
+              .at[P.ACK].set(r.sk_timer_gen[slot]))
+        r = equeue.q_push(r, now + TCP_CLOSE_TIMER_DELAY, EV_TCP_CLOSE, ev)
+        return _stop_timer(r, slot)
+
+    row = jax.lax.cond(to_time_wait, sched_close, lambda r: r, row)
+    row = jax.lax.cond(state3 == TCPS_CLOSED,
+                       lambda r: sock_free(r, slot), lambda r: r, row)
+    return row
 
 
 def tcp_rx(row, hp, sh, now, slot, pkt):
-    """Inbound TCP segment dispatch for socket `slot`."""
-    return row
+    """Inbound TCP segment dispatch for socket `slot` (from the NIC
+    demux). Listener SYNs spawn children; everything else runs the
+    connection machine; any state change may unblock the NIC."""
+    flags = pkt[P.FLAGS]
+    syn = (flags & P.F_SYN) != 0
+    ackf = (flags & P.F_ACK) != 0
+    rst = (flags & P.F_RST) != 0
+    state = row.sk_state[slot]
+
+    def on_rst(r):
+        r = jax.lax.cond(state >= TCPS_ESTABLISHED,
+                         lambda rr: _wake(rr, now, WAKE_EOF, slot, pkt=pkt),
+                         lambda rr: rr, r)
+        return sock_free(r, slot)
+
+    def dispatch(r):
+        is_listen_syn = (state == TCPS_LISTEN) & syn & ~ackf
+        return jax.lax.cond(
+            is_listen_syn,
+            lambda rr: _accept_syn(rr, hp, sh, now, slot, pkt),
+            lambda rr: _rx_conn(rr, hp, sh, now, slot, pkt), r)
+
+    row = jax.lax.cond(rst, on_rst, dispatch, row)
+    return nic.kick(row, now)
 
 
-def on_tcp_timer(row, hp, sh, now, pkt):
-    """EV_TCP_TIMER handler (retransmission timeout)."""
-    return row
+# --- Timers ----------------------------------------------------------------
+
+def on_tcp_timer(row, hp, sh, now, wend, ev):
+    """EV_TCP_TIMER: RFC6298 retransmission timeout with deadline
+    re-chaining (one outstanding event per socket)."""
+    slot = ev[P.SEQ]
+    gen = ev[P.ACK]
+    valid = (row.sk_used[slot] & (gen == row.sk_timer_gen[slot]) &
+             (row.sk_proto[slot] == P.PROTO_TCP))
+
+    def live(r):
+        deadline = r.sk_rto_deadline[slot]
+
+        def off(rr):
+            return _set(rr, slot, sk_timer_on=jnp.bool_(False))
+
+        def rechain(rr):
+            ev2 = (jnp.zeros((P.PKT_WORDS,), _I32)
+                   .at[P.SEQ].set(slot).at[P.ACK].set(gen))
+            return equeue.q_push(rr, deadline, EV_TCP_TIMER, ev2)
+
+        def expired(rr):
+            state = rr.sk_state[slot]
+            # exponential backoff (rfc6298 5.5, shd-tcp.c:1104-1106)
+            rto2 = jnp.minimum(rr.sk_rto[slot] * 2, TCP_RTO_MAX)
+            # handshake control resends
+            ctl2 = (rr.sk_ctl[slot]
+                    | jnp.where(state == TCPS_SYN_SENT, CTL_SYN, 0)
+                    | jnp.where(state == TCPS_SYN_RECEIVED, CTL_SYNACK, 0)
+                    | jnp.where(_fin_wait_states(state) &
+                                ~rr.sk_fin_acked[slot], CTL_FIN, 0))
+            # go-back-N: rewind to the oldest unacked offset
+            had_flight = rr.sk_snd_nxt[slot] > rr.sk_snd_una[slot]
+            cw_l, ss_l, wm_l, ep_l = CC.on_loss(
+                sh.cc_kind, rr.sk_cwnd[slot], rr.sk_ssthresh[slot],
+                rr.sk_cc_wmax[slot])
+            rr = _set(
+                rr, slot,
+                sk_rto=rto2,
+                sk_ctl=ctl2.astype(_I32),
+                sk_snd_nxt=jnp.where(had_flight, rr.sk_snd_una[slot],
+                                     rr.sk_snd_nxt[slot]),
+                sk_cwnd=jnp.where(had_flight, cw_l, rr.sk_cwnd[slot]),
+                sk_ssthresh=jnp.where(had_flight, ss_l,
+                                      rr.sk_ssthresh[slot]),
+                sk_cc_wmax=jnp.where(had_flight, wm_l,
+                                     rr.sk_cc_wmax[slot]),
+                sk_cc_epoch=jnp.where(had_flight, ep_l,
+                                      rr.sk_cc_epoch[slot]),
+                sk_rtt_seq=_I64(-1),  # Karn
+                sk_timer_on=jnp.bool_(False),
+            )
+            rr = _arm_timer(rr, slot, now)
+            return nic.kick(rr, now)
+
+        return jax.lax.cond(
+            deadline == 0, off,
+            lambda rr: jax.lax.cond(now < deadline, rechain, expired, rr),
+            r)
+
+    return jax.lax.cond(valid, live, lambda r: r, row)
 
 
-def on_tcp_close(row, hp, sh, now, pkt):
-    """EV_TCP_CLOSE handler (TIME_WAIT / close teardown)."""
-    return row
+def on_tcp_close(row, hp, sh, now, wend, ev):
+    """EV_TCP_CLOSE: TIME_WAIT expiration frees the socket row
+    (the reference's 60s close timer, shd-tcp.c:439-523)."""
+    slot = ev[P.SEQ]
+    gen = ev[P.ACK]
+    valid = (row.sk_used[slot] & (gen == row.sk_timer_gen[slot]) &
+             (row.sk_state[slot] == TCPS_TIME_WAIT))
+    return jax.lax.cond(valid, lambda r: sock_free(r, slot),
+                        lambda r: r, row)
