@@ -1,0 +1,160 @@
+"""Command-line entry: ``python -m shadow_tpu [options] config.xml``.
+
+The L7 equivalent of the reference's ``shadow [options] config.xml``
+(/root/reference/src/main/core/shd-main.c:724, option groups
+shd-options.c:82-140). There is no relaunch/LD_PRELOAD machinery to
+bootstrap — the engine selection is ``--engine`` and the device mesh
+replaces worker threads (``--workers`` maps to mesh shards).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+TEST_TOPOLOGY = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d9" />
+  <key attr.name="latency" attr.type="double" for="edge" id="d7" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d4" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d3" />
+  <key attr.name="packetloss" attr.type="double" for="node" id="d0" />
+  <graph edgedefault="undirected">
+    <node id="poi-1"><data key="d0">0.0</data>
+      <data key="d3">17038</data><data key="d4">2251</data></node>
+    <edge source="poi-1" target="poi-1">
+      <data key="d7">50.0</data><data key="d9">0.0</data></edge>
+  </graph>
+</graphml>"""
+
+# The builtin benchmark scenario, mirroring the reference's --test
+# (shd-examples.c:10-41: 1000 clients x 10 small downloads from one
+# server pool over a single-PoI topology, 60 s stop).
+TEST_SERVER_GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="serverport" attr.type="string" for="node" id="d0" />
+  <graph edgedefault="directed">
+    <node id="start"><data key="d0">80</data></node>
+  </graph>
+</graphml>"""
+
+TEST_CLIENT_GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="count" attr.type="string" for="node" id="d6" />
+  <key attr.name="size" attr.type="string" for="node" id="d5" />
+  <key attr.name="type" attr.type="string" for="node" id="d4" />
+  <key attr.name="time" attr.type="string" for="node" id="d2" />
+  <key attr.name="peers" attr.type="string" for="node" id="d0" />
+  <graph edgedefault="directed">
+    <node id="start"><data key="d0">server:80</data></node>
+    <node id="transfer">
+      <data key="d4">get</data><data key="d5">18 KiB</data>
+    </node>
+    <node id="pause"><data key="d2">1</data></node>
+    <node id="end"><data key="d6">10</data></node>
+    <edge source="start" target="transfer" />
+    <edge source="transfer" target="end" />
+    <edge source="end" target="pause" />
+    <edge source="pause" target="start" />
+  </graph>
+</graphml>"""
+
+
+def build_test_scenario(n_clients: int = 1000, stop_s: int = 60):
+    from .core.config import HostSpec, ProcessSpec, Scenario
+    return Scenario(
+        stop_time=stop_s * 10**9,
+        topology_graphml=TEST_TOPOLOGY,
+        hosts=[
+            HostSpec(id="server", processes=[
+                ProcessSpec(plugin="tgen", start_time=10**9,
+                            arguments=TEST_SERVER_GRAPH)]),
+            HostSpec(id="client", quantity=n_clients, processes=[
+                ProcessSpec(plugin="tgen", start_time=2 * 10**9,
+                            arguments=TEST_CLIENT_GRAPH)]),
+        ],
+    )
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="shadow_tpu",
+        description="TPU-native discrete-event network simulator")
+    p.add_argument("config", nargs="?", help="shadow.config.xml path")
+    p.add_argument("--test", action="store_true",
+                   help="run the builtin benchmark scenario "
+                        "(reference --test)")
+    p.add_argument("--test-clients", type=int, default=1000)
+    p.add_argument("--seed", type=int, default=None,
+                   help="override the scenario seed")
+    p.add_argument("--stop-time", type=str, default=None,
+                   help="override stop time, e.g. 60s / 10m")
+    p.add_argument("--workers", type=int, default=0, metavar="N",
+                   help="shard hosts over N devices (0 = single chip; "
+                        "the reference's worker-thread knob)")
+    p.add_argument("--heartbeat-frequency", type=float, default=0,
+                   metavar="SEC", help="tracker heartbeat interval")
+    p.add_argument("--log-level", default="message",
+                   choices=["error", "warning", "message", "info", "debug"])
+    p.add_argument("--tcp-congestion-control", default="cubic",
+                   choices=["aimd", "reno", "cubic"])
+    p.add_argument("--pcap-dir", default=None, metavar="DIR",
+                   help="write pcap files for hosts with logpcap set")
+    p.add_argument("--checkpoint", default=None, metavar="PATH")
+    p.add_argument("--checkpoint-every", type=float, default=0,
+                   metavar="SEC")
+    p.add_argument("--resume", default=None, metavar="PATH")
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--summary-json", action="store_true",
+                   help="print the final summary as one JSON line")
+    args = p.parse_args(argv)
+
+    from .core.config import load_xml
+    from .core.simtime import parse_time
+    from .engine.sim import Simulation
+    from .obs.logger import SimLogger
+
+    if args.test:
+        scenario = build_test_scenario(args.test_clients)
+    elif args.config:
+        scenario = load_xml(args.config)
+    else:
+        p.error("provide a config.xml or --test")
+
+    if args.stop_time:
+        scenario.stop_time = parse_time(args.stop_time, default_unit="s")
+    if args.seed is not None:
+        scenario.seed = args.seed
+
+    logger = SimLogger(level=args.log_level)
+    logger.message(0, "main", f"shadow_tpu starting: "
+                   f"{scenario.total_hosts()} hosts, "
+                   f"stop={scenario.stop_time / 1e9:.1f}s")
+
+    sim = Simulation(scenario)
+    cc = {"aimd": 0, "reno": 1, "cubic": 2}[args.tcp_congestion_control]
+    if cc != sim.cfg.cc_kind:
+        import jax.numpy as jnp
+        sim.sh = sim.sh.replace(cc_kind=jnp.int32(cc))
+
+    mesh = None
+    if args.workers:
+        from .parallel.shard import make_mesh
+        mesh = make_mesh(args.workers)
+
+    report = sim.run(verbose=args.verbose, mesh=mesh,
+                     heartbeat_s=args.heartbeat_frequency, logger=logger,
+                     checkpoint_path=args.checkpoint,
+                     checkpoint_every_s=args.checkpoint_every,
+                     resume_from=args.resume, pcap_dir=args.pcap_dir)
+    s = report.summary()
+    logger.message(report.sim_time_ns, "main",
+                   f"done: {s['events']} events in {s['wall_seconds']:.2f}s "
+                   f"wall ({s['events_per_sec']:.0f} ev/s, "
+                   f"speedup x{s['speedup']:.2f})")
+    if args.summary_json:
+        print(json.dumps(s))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

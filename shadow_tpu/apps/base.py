@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core import rng as R
 from ..net import packet as P
@@ -35,7 +36,8 @@ APP_PHOLD = 3
 APP_TGEN = 4
 APP_BULK = 5
 APP_BULK_SERVER = 6
-N_APP_KINDS = 7
+APP_HOSTED = 7    # CPU-hosted real app code (hosting/)
+N_APP_KINDS = 8
 
 
 def app_null(row, hp, sh, now, wake):
@@ -62,12 +64,36 @@ def timer(row, t, aux=0):
     return schedule_wake(row, t, WAKE_TIMER, aux=aux)
 
 
-def dispatch(row, hp, sh, now, wake):
-    """EV_APP entry: route to this host's app by kind."""
+def _all_apps():
     from .ping import app_ping, app_ping_server
     from .phold import app_phold
     from .tgen import app_tgen
     from .bulk import app_bulk, app_bulk_server
-    branches = [app_null, app_ping, app_ping_server, app_phold, app_tgen,
-                app_bulk, app_bulk_server]
-    return jax.lax.switch(hp.app_kind, branches, row, hp, sh, now, wake)
+    from ..hosting.bridge import hosted_wake
+
+    def app_hosted(row, hp, sh, now, wake):
+        return hosted_wake(row, hp, sh, now, wake)
+
+    return [app_null, app_ping, app_ping_server, app_phold, app_tgen,
+            app_bulk, app_bulk_server, app_hosted]
+
+
+def dispatch(row, hp, sh, now, wake, app_kinds=None):
+    """EV_APP entry: route to this host's app by kind.
+
+    `app_kinds` (static tuple) prunes the switch to the kinds present
+    in the scenario — unused app machinery never reaches XLA.
+    """
+    all_apps = _all_apps()
+    if app_kinds is None:
+        app_kinds = tuple(range(len(all_apps)))
+    kinds = tuple(sorted(set(app_kinds) | {APP_NULL}))
+    if len(kinds) == 1:
+        return all_apps[kinds[0]](row, hp, sh, now, wake)
+    # static kind -> branch-position table
+    pos = np.zeros(N_APP_KINDS, dtype=np.int32)
+    for i, k in enumerate(kinds):
+        pos[k] = i
+    branches = [all_apps[k] for k in kinds]
+    idx = jnp.asarray(pos)[jnp.clip(hp.app_kind, 0, N_APP_KINDS - 1)]
+    return jax.lax.switch(idx, branches, row, hp, sh, now, wake)

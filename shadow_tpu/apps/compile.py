@@ -15,11 +15,13 @@ Builtin plugins:
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ..core.simtime import parse_time
 from .base import (APP_PING, APP_PING_SERVER, APP_PHOLD, APP_TGEN,
-                   APP_BULK, APP_BULK_SERVER)
+                   APP_BULK, APP_BULK_SERVER, APP_HOSTED)
 
 
 def parse_kv(args: str) -> dict:
@@ -33,7 +35,8 @@ def parse_kv(args: str) -> dict:
     return out
 
 
-def compile_app(plugin: str, args: str, dns, num_hosts: int):
+def compile_app(plugin: str, args: str, dns, num_hosts: int,
+                tgen_tables=None):
     """-> (app_kind, cfg[8] int64) for one process spec."""
     cfg = np.zeros(8, dtype=np.int64)
     kv = parse_kv(args)
@@ -64,7 +67,28 @@ def compile_app(plugin: str, args: str, dns, num_hosts: int):
     if plugin == "bulkserver":
         cfg[1] = int(kv.get("port", 80))
         return APP_BULK_SERVER, cfg
+    if plugin.startswith("hosted:"):
+        # CPU-hosted real app code (hosting/): the Simulation builds a
+        # HostingRuntime instance per such host; nothing device-side to
+        # compile beyond the wake-ring app kind.
+        return APP_HOSTED, cfg
     if plugin == "tgen":
+        if tgen_tables is None:
+            raise ValueError("tgen requires a TgenTables compile context")
+        source = args.strip()
+        if not source.startswith("<"):
+            # not inline graphml: a file path (the reference's argv
+            # form). Use the raw argument string, not parse_kv's
+            # key=value splitting (paths may contain '=').
+            if not source:
+                raise ValueError(
+                    "tgen requires a behavior graph (a graphml path or "
+                    "inline graphml) as its process argument")
+            if not os.path.exists(source):
+                raise ValueError(
+                    f"tgen behavior graph not found: {source!r}")
+        cfg[0] = tgen_tables.compile(source, dns)
         return APP_TGEN, cfg
     raise ValueError(f"unknown plugin {plugin!r} "
-                     "(builtin: ping, pingserver, phold, tgen)")
+                     "(builtin: ping, pingserver, phold, bulk, bulkserver, "
+                     "tgen)")

@@ -24,7 +24,7 @@ from ..net import packet as P
 from ..net.udp import udp_open, udp_sendto
 from .base import timer
 
-_US_MOD = jnp.int64(2**31)
+_US_MOD = 2**31  # python int: device consts would be hoisted as const_args
 
 
 def _us31(t_ns):

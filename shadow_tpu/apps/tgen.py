@@ -1,18 +1,450 @@
 """tgen: vectorized traffic-generator behavior graphs.
 
 Reimplements the logic of the reference's bundled tgen plugin
-(/root/reference/src/plugin/shadow-plugin-tgen/, 5.7k LoC): igraph-
-described behavior graphs whose nodes are start / transfer / pause /
-end actions walked by each client, driving TCP transfers against tgen
-servers. Here the graph is compiled to device tables and every host
-walks its graph as a state machine.
+(/root/reference/src/plugin/shadow-plugin-tgen/, 5.7k LoC C: graph walk
+shd-tgen-graph.c / shd-tgen-action.c, transfers shd-tgen-transfer.c)
+as a per-host vectorized state machine. The behavior-graph file format
+is tgen's: a directed GraphML whose vertex ids name actions — ``start``
+(peers list, serverport, initial delay), ``transfer`` (type get/put,
+protocol, size), ``pause`` (fixed time or a comma list to draw from),
+``end`` (count / time / size stop conditions) — connected by edges the
+client walks in a cycle (see resource/examples/tgen.webclient.graphml.xml).
 
-Lands with the tgen milestone (after TCP); the dispatch stub keeps the
-app registry complete.
+Compilation (host side): :func:`compile_tgen_graph` flattens a graph
+into rows of a device node table plus peer/pause pools shared across
+all hosts (state.Shared.tgen_*). Runtime (device side): :func:`app_tgen`
+walks the table with lax primitives; transfers ride the TCP stack with
+the request type+size carried on the SYN's APP word, exactly the role
+of tgen's command header on a real connection.
+
+Walk semantics notes vs the reference: each node has one active
+successor (the first outgoing edge); tgen's parallel multi-edge walks
+and ``synchronize`` joins collapse to sequential execution — the
+canonical example graphs are single-successor cycles, which this
+reproduces exactly. ``timeout``/``stallout`` attrs parse but v1 ignores
+them (no transfer abort path yet).
 """
 
 from __future__ import annotations
 
+import os
+import re
+from xml.etree import ElementTree
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.simtime import SIMTIME_ONE_SECOND
+from ..engine.defs import (WAKE_START, WAKE_TIMER, WAKE_SOCKET,
+                           WAKE_CONNECTED, WAKE_EOF, WAKE_ACCEPT, WAKE_SENT,
+                           ST_XFER_DONE, ST_APP_DONE)
+from ..net import packet as P
+from ..net.tcp import tcp_connect, tcp_listen, tcp_write, tcp_close_call
+from .base import draw, timer
+
+# --- node table encoding (Shared.tgen_nodes: int64 [N, 8]) ---
+# [kind, a, b, c, next, peers_off, n_peers, pool_ref]
+NK_START = 0      # a=serverport, b=initial delay ns
+NK_TRANSFER = 1   # a=type (0 get, 1 put), b=size bytes
+NK_PAUSE = 2      # a=fixed time ns (or -1: draw from pool[b:b+c])
+NK_END = 3        # a=count limit, b=time-limit ns, c=size-limit bytes
+COL_KIND, COL_A, COL_B, COL_C, COL_NEXT, COL_POFF, COL_PCNT, COL_REF = range(8)
+
+# transfer request tag riding the SYN (31 usable bits)
+TAG_PUT = 1 << 30
+TAG_SIZE_MASK = (1 << 30) - 1
+
+_SIZE_RE = re.compile(r"^\s*([0-9.]+)\s*([a-zA-Z]*)\s*$")
+_SIZE_UNITS = {
+    "": 1, "b": 1, "byte": 1, "bytes": 1,
+    "kb": 10**3, "mb": 10**6, "gb": 10**9, "tb": 10**12,
+    "kib": 2**10, "mib": 2**20, "gib": 2**30, "tib": 2**40,
+}
+
+
+def parse_size(text: str) -> int:
+    """Parse tgen size strings: '100 KiB', '1 MiB', '5242880'."""
+    m = _SIZE_RE.match(str(text))
+    if not m:
+        raise ValueError(f"bad size {text!r}")
+    val, unit = m.groups()
+    mult = _SIZE_UNITS.get(unit.lower())
+    if mult is None:
+        raise ValueError(f"bad size unit {unit!r} in {text!r}")
+    return int(float(val) * mult)
+
+
+def _parse_tgen_seconds(text: str) -> int:
+    """tgen times are seconds (may be fractional)."""
+    return int(float(text) * SIMTIME_ONE_SECOND)
+
+
+class TgenTables:
+    """Accumulates compiled behavior graphs into the shared device
+    tables (deduplicated per distinct graph)."""
+
+    def __init__(self):
+        self.nodes = []    # rows of 8 int64
+        self.peers = []    # (host, port) int32 rows
+        self.pool = []     # int64 pause choices (ns)
+        self._cache = {}
+
+    def compile(self, source: str, dns) -> int:
+        """Compile a behavior graphml (path or inline text); returns the
+        start-node index into the node table."""
+        key = source
+        if key in self._cache:
+            return self._cache[key]
+        start = compile_tgen_graph(source, dns, self)
+        self._cache[key] = start
+        return start
+
+    def arrays(self):
+        nodes = (np.asarray(self.nodes, dtype=np.int64)
+                 if self.nodes else np.zeros((1, 8), np.int64))
+        peers = (np.asarray(self.peers, dtype=np.int32)
+                 if self.peers else np.zeros((1, 2), np.int32))
+        pool = (np.asarray(self.pool, dtype=np.int64)
+                if self.pool else np.zeros((1,), np.int64))
+        return nodes, peers, pool
+
+
+def _resolve_peers(text: str, dns):
+    """'server1:30080,server2:30080' -> [(host_id, port), ...]"""
+    out = []
+    for item in str(text).split(","):
+        item = item.strip()
+        if not item:
+            continue
+        name, _, port = item.partition(":")
+        out.append((dns.resolve(name), int(port or 80)))
+    return out
+
+
+def compile_tgen_graph(source: str, dns, tab: TgenTables) -> int:
+    """Flatten one tgen behavior graphml into `tab`; returns start index."""
+    if os.path.exists(source):
+        with open(source) as f:
+            text = f.read()
+    else:
+        text = source
+    root = ElementTree.fromstring(text)
+    ns = ""
+    if root.tag.startswith("{"):
+        ns = root.tag[: root.tag.index("}") + 1]
+
+    keys = {}  # key id -> attr name
+    for k in root.iter(f"{ns}key"):
+        keys[k.attrib["id"]] = k.attrib["attr.name"]
+
+    graph = root.find(f"{ns}graph")
+    if graph is None:
+        raise ValueError("tgen graphml has no <graph>")
+
+    raw = {}      # node id -> attr dict
+    order = []    # node ids in file order
+    for nd in graph.findall(f"{ns}node"):
+        attrs = {}
+        for d in nd.findall(f"{ns}data"):
+            attrs[keys.get(d.attrib["key"], d.attrib["key"])] = (d.text or "")
+        raw[nd.attrib["id"]] = attrs
+        order.append(nd.attrib["id"])
+
+    succ = {}     # node id -> first-successor id
+    for e in graph.findall(f"{ns}edge"):
+        succ.setdefault(e.attrib["source"], e.attrib["target"])
+
+    base = len(tab.nodes)
+    index = {nid: base + i for i, nid in enumerate(order)}
+
+    def action_of(nid: str) -> str:
+        for prefix in ("start", "transfer", "pause", "synchronize", "end"):
+            if nid.startswith(prefix):
+                return prefix
+        raise ValueError(f"tgen node id {nid!r} names no known action")
+
+    default_peers = None
+    rows = []
+    for nid in order:
+        a = raw[nid]
+        act = action_of(nid)
+        nxt = index[succ[nid]] if succ.get(nid) in index else -1
+        poff = pcnt = 0
+        if act == "start":
+            peers = _resolve_peers(a.get("peers", ""), dns)
+            if peers:
+                poff = len(tab.peers)
+                pcnt = len(peers)
+                tab.peers.extend(peers)
+                default_peers = (poff, pcnt)
+            port = int(a.get("serverport", 0) or 0)
+            delay = _parse_tgen_seconds(a["time"]) if a.get("time") else 0
+            row = [NK_START, port, delay, 0, nxt, poff, pcnt, 0]
+        elif act == "transfer":
+            ttype = 1 if a.get("type", "get").lower() == "put" else 0
+            size = parse_size(a.get("size", "1 MiB"))
+            if a.get("peers"):
+                peers = _resolve_peers(a["peers"], dns)
+                poff, pcnt = len(tab.peers), len(peers)
+                tab.peers.extend(peers)
+            elif default_peers:
+                poff, pcnt = default_peers
+            else:
+                # the reference tgen errors the same way: a transfer
+                # with neither its own peers nor start-node peers
+                raise ValueError(
+                    f"tgen transfer node {nid!r} has no peers (set a "
+                    "'peers' attr on it or on the start node)")
+            row = [NK_TRANSFER, ttype, size, 0, nxt, poff, pcnt, 0]
+        elif act == "pause":
+            t = a.get("time", "1")
+            if "," in t:
+                choices = [_parse_tgen_seconds(x)
+                           for x in t.split(",") if x.strip()]
+                ref = len(tab.pool)
+                tab.pool.extend(choices)
+                row = [NK_PAUSE, -1, ref, len(choices), nxt, 0, 0, 0]
+            else:
+                row = [NK_PAUSE, _parse_tgen_seconds(t), 0, 0, nxt, 0, 0, 0]
+        elif act == "synchronize":
+            # v1: a join of one path is a no-op passthrough
+            row = [NK_PAUSE, 0, 0, 0, nxt, 0, 0, 0]
+        else:  # end
+            count = int(a.get("count", 0) or 0)
+            tlim = _parse_tgen_seconds(a["time"]) if a.get("time") else 0
+            slim = parse_size(a["size"]) if a.get("size") else 0
+            row = [NK_END, count, tlim, slim, nxt, 0, 0, 0]
+        rows.append(row)
+    tab.nodes.extend(rows)
+
+    if "start" not in index:
+        raise ValueError("tgen graph has no 'start' node")
+
+    # Reject walks that can spin forever: follow the single-successor
+    # chain from start; any reachable cycle must contain a blocking
+    # node (a transfer, or a pause/start with nonzero wait) or the
+    # device while_loop in _run_chain would never terminate.
+    def blocks(local_i: int) -> bool:
+        r = rows[local_i]
+        return (r[COL_KIND] == NK_TRANSFER or
+                (r[COL_KIND] == NK_PAUSE and (r[COL_A] != 0)) or
+                (r[COL_KIND] == NK_START and r[COL_B] > 0))
+
+    seen = {}
+    cur = index["start"] - base
+    step = 0
+    while cur >= 0:
+        if cur in seen:
+            cycle = [i for i, s in seen.items() if s >= seen[cur]]
+            if not any(blocks(i) for i in cycle):
+                names = [order[i] for i in cycle]
+                raise ValueError(
+                    "tgen graph cycle never blocks (no transfer or "
+                    f"nonzero pause): {' -> '.join(names)}")
+            break
+        seen[cur] = step
+        step += 1
+        nxt_abs = rows[cur][COL_NEXT]
+        cur = nxt_abs - base if nxt_abs >= 0 else -1
+
+    return index["start"]
+
+
+# --- device-side walk ------------------------------------------------------
+# registers: r0=active client socket (-1 none), r1=node to execute on the
+# next wake (timer) / node of the in-flight transfer, r2=transfers
+# completed, r3=total bytes transferred, r4=walk start time
+
+_I32 = jnp.int32
+_I64 = jnp.int64
+
+
+def _exec_node(row, hp, sh, now, cur):
+    """Execute node `cur`'s entry action. Returns (row, nxt) where
+    nxt >= 0 chains immediately and -1 blocks awaiting a wake."""
+    nd = sh.tgen_nodes[jnp.clip(cur, 0, sh.tgen_nodes.shape[0] - 1)]
+    kind = nd[COL_KIND]
+    nxt = nd[COL_NEXT].astype(_I32)
+
+    def do_start(r):
+        delay = nd[COL_B]
+
+        def wait(rr):
+            rr = rr.replace(app_r=rr.app_r.at[1].set(nxt.astype(_I64)))
+            return timer(rr, now + delay), _I32(-1)
+
+        return jax.lax.cond(delay > 0, wait, lambda rr: (rr, nxt), r)
+
+    def do_transfer(r):
+        pcnt = jnp.maximum(nd[COL_PCNT], 1)
+        r, u = draw(r, hp, sh)
+        pick = (nd[COL_POFF] +
+                jnp.minimum((u * pcnt.astype(jnp.float32)).astype(_I64),
+                            pcnt - 1))
+        pick = jnp.clip(pick, 0, sh.tgen_peers.shape[0] - 1)
+        peer_host = sh.tgen_peers[pick, 0]
+        peer_port = sh.tgen_peers[pick, 1]
+        size = jnp.minimum(nd[COL_B], TAG_SIZE_MASK)
+        ttype = nd[COL_A]
+        tag = (size | jnp.where(ttype == 1, TAG_PUT, 0)).astype(_I32)
+        r, slot, ok = tcp_connect(r, hp, sh, now, dst_host=peer_host,
+                                  dst_port=peer_port, tag=tag)
+        r = r.replace(app_r=r.app_r.at[0].set(slot.astype(_I64))
+                                  .at[1].set(_I64(cur)))
+        return r, _I32(-1)
+
+    def do_pause(r):
+        fixed = nd[COL_A]
+
+        def drawn(rr):
+            rr, u = draw(rr, hp, sh)
+            n = jnp.maximum(nd[COL_C], 1)
+            at = (nd[COL_B] +
+                  jnp.minimum((u * n.astype(jnp.float32)).astype(_I64),
+                              n - 1))
+            return rr, sh.tgen_pool[jnp.clip(at, 0,
+                                             sh.tgen_pool.shape[0] - 1)]
+
+        def fixed_t(rr):
+            return rr, fixed
+
+        r, t = jax.lax.cond(fixed < 0, drawn, fixed_t, r)
+
+        def wait(rr):
+            rr = rr.replace(app_r=rr.app_r.at[1].set(nxt.astype(_I64)))
+            return timer(rr, now + t), _I32(-1)
+
+        return jax.lax.cond(t > 0, wait, lambda rr: (rr, nxt), r)
+
+    def do_end(r):
+        met = jnp.zeros((), jnp.bool_)
+        met |= (nd[COL_A] > 0) & (r.app_r[2] >= nd[COL_A])
+        met |= (nd[COL_B] > 0) & (now - r.app_r[4] >= nd[COL_B])
+        met |= (nd[COL_C] > 0) & (r.app_r[3] >= nd[COL_C])
+
+        def stop(rr):
+            rr = rr.replace(
+                app_r=rr.app_r.at[1].set(_I64(-1)),
+                stats=rr.stats.at[ST_APP_DONE].add(1))
+            return rr, _I32(-1)
+
+        return jax.lax.cond(met, stop, lambda rr: (rr, nxt), r)
+
+    return jax.lax.switch(jnp.clip(kind, 0, 3).astype(_I32),
+                          [do_start, do_transfer, do_pause, do_end], row)
+
+
+def _run_chain(row, hp, sh, now, start):
+    """Execute nodes until one blocks (the chain is bounded: every cycle
+    in a well-formed graph contains a blocking pause/transfer)."""
+
+    def cond(c):
+        _, cur = c
+        return cur >= 0
+
+    def body(c):
+        r, cur = c
+        return _exec_node(r, hp, sh, now, cur)
+
+    row, _ = jax.lax.while_loop(cond, body,
+                                (row, jnp.asarray(start, _I32)))
+    return row
+
+
+def _finish_transfer(row, hp, sh, now, sock):
+    """A transfer completed on `sock`: account it and walk on."""
+    nd = sh.tgen_nodes[jnp.clip(row.app_r[1].astype(_I32), 0,
+                                sh.tgen_nodes.shape[0] - 1)]
+    row = tcp_close_call(row, now, sock)
+    row = row.replace(
+        app_r=row.app_r.at[2].add(1).at[3].add(nd[COL_B]).at[0].set(-1),
+        stats=row.stats.at[ST_XFER_DONE].add(1))
+    return _run_chain(row, hp, sh, now, nd[COL_NEXT].astype(_I32))
+
 
 def app_tgen(row, hp, sh, now, wake):
-    return row
+    reason = wake[P.ACK]
+    slot = wake[P.SEQ]
+    start_node = hp.app_cfg[0].astype(_I32)
+
+    def on_start(r):
+        nd = sh.tgen_nodes[jnp.clip(start_node, 0,
+                                    sh.tgen_nodes.shape[0] - 1)]
+        port = nd[COL_A]
+
+        def listen(rr):
+            rr, lslot, ok = tcp_listen(rr, port.astype(_I32))
+            return rr
+
+        r = jax.lax.cond(port > 0, listen, lambda rr: rr, r)
+        r = r.replace(app_r=r.app_r.at[4].set(_I64(now)).at[0].set(-1))
+        return _run_chain(r, hp, sh, now, start_node)
+
+    def on_timer(r):
+        return _run_chain(r, hp, sh, now, r.app_r[1].astype(_I32))
+
+    def on_connected(r):
+        # our client socket connected; PUT writes now, GET just waits
+        tag = r.sk_syn_tag[slot]
+        is_put = (tag & TAG_PUT) != 0
+        size = (tag & TAG_SIZE_MASK).astype(_I64)
+
+        def put(rr):
+            rr = tcp_write(rr, now, slot, size)
+            return tcp_close_call(rr, now, slot)
+
+        return jax.lax.cond(is_put & (slot == r.app_r[0].astype(_I32)),
+                            put, lambda rr: rr, r)
+
+    def on_accept(r):
+        # server child established: serve the request in its SYN tag
+        tag = r.sk_syn_tag[slot]
+        is_get = (tag & TAG_PUT) == 0
+        size = (tag & TAG_SIZE_MASK).astype(_I64)
+
+        def serve_get(rr):
+            rr = tcp_write(rr, now, slot, size)
+            return tcp_close_call(rr, now, slot)
+
+        return jax.lax.cond(is_get, serve_get, lambda rr: rr, r)
+
+    def on_eof(r):
+        is_client = slot == r.app_r[0].astype(_I32)
+
+        def client_done(rr):
+            return _finish_transfer(rr, hp, sh, now, slot)
+
+        def other(rr):
+            # Count only a PUT-receiving child's stream end as a
+            # server-side transfer; EOFs on served-GET children (the
+            # client's own close) and on already-finished client
+            # sockets are teardown noise.
+            is_put_child = (rr.sk_used[slot] & (rr.sk_parent[slot] >= 0) &
+                            ((rr.sk_syn_tag[slot] & TAG_PUT) != 0))
+
+            def done_put(r2):
+                r2 = tcp_close_call(r2, now, slot)
+                return r2.replace(stats=r2.stats.at[ST_XFER_DONE].add(1))
+
+            return jax.lax.cond(is_put_child, done_put, lambda r2: r2, rr)
+
+        return jax.lax.cond(is_client, client_done, other, r)
+
+    def on_sent(r):
+        # all written bytes acked. For a client PUT this completes the
+        # transfer; server GET children already have close_after set.
+        is_client = slot == r.app_r[0].astype(_I32)
+        return jax.lax.cond(is_client,
+                            lambda rr: _finish_transfer(rr, hp, sh, now,
+                                                        slot),
+                            lambda rr: rr, r)
+
+    def nop(r):
+        return r
+
+    # START=0 TIMER=1 SOCKET=2 CONNECTED=3 EOF=4 ACCEPT=5 SENT=6
+    return jax.lax.switch(
+        jnp.clip(reason, 0, 6),
+        [on_start, on_timer, nop, on_connected, on_eof, on_accept, on_sent],
+        row)

@@ -1,0 +1,53 @@
+"""AOT-compiled jit wrapper.
+
+This build's jax/XLA dispatch fast path mis-executes large programs
+when several variants of structurally-similar functions are compiled
+interleaved with execution: a later call runs against the wrong
+executable and dies with "Execution supplied N buffers but compiled
+program expected M buffers" (reproduced on both the cpu and TPU
+backends; see tests/conftest.py notes). The ahead-of-time path —
+``jit(f).lower(*args).compile()`` then calling the Compiled object —
+does not go through that dispatch cache and is immune.
+
+:class:`AotJit` wraps a function in exactly that: one Compiled object
+per argument-signature (shapes/dtypes/weak-types), cached. It costs a
+small per-call key computation over the arg pytree.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class AotJit:
+    def __init__(self, fn, **jit_kwargs):
+        self._jit = jax.jit(fn, **jit_kwargs)
+        self._compiled = {}
+
+    @staticmethod
+    def _sig(args):
+        leaves, treedef = jax.tree.flatten(args)
+
+        def leaf_sig(x):
+            aval = jax.api_util.shaped_abstractify(x)
+            return (aval.shape, str(aval.dtype),
+                    getattr(aval, "weak_type", False))
+
+        return treedef, tuple(leaf_sig(x) for x in leaves)
+
+    def __call__(self, *args):
+        key = self._sig(args)
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = self._jit.lower(*args).compile()
+            self._compiled[key] = fn
+        return fn(*args)
+
+
+def aot_jit(fn=None, **jit_kwargs):
+    """Decorator/factory: like jax.jit but always executes through the
+    AOT Compiled path. Static arguments are not supported — close over
+    them and cache one AotJit per static configuration instead."""
+    if fn is None:
+        return lambda f: AotJit(f, **jit_kwargs)
+    return AotJit(fn, **jit_kwargs)

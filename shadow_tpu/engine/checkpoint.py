@@ -1,0 +1,63 @@
+"""Checkpoint/resume: snapshot the simulation state arrays.
+
+The reference has no checkpointing (SURVEY §5 calls it out as absent);
+on TPU the whole simulation is a pytree of dense arrays, so a snapshot
+is one device->host copy + npz write, and resume is exact: the restored
+run produces the same results as an uninterrupted one (asserted by
+tests/test_checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def scenario_fingerprint(scenario, cfg, seed: int) -> str:
+    """Stable hash binding a checkpoint to its scenario + engine shape."""
+    text = json.dumps({
+        "scenario": repr(scenario),
+        "cfg": repr(cfg),
+        "seed": seed,
+    }, sort_keys=True)
+    return hashlib.sha256(text.encode()).hexdigest()[:16]
+
+
+def save(path: str, hosts, wstart, wend, windows: int, fingerprint: str):
+    leaves, treedef = jax.tree.flatten(hosts)
+    np.savez_compressed(
+        path,
+        __fingerprint__=np.frombuffer(
+            fingerprint.encode(), dtype=np.uint8),
+        __wstart__=np.int64(int(wstart)),
+        __wend__=np.int64(int(wend)),
+        __windows__=np.int64(windows),
+        **{f"leaf{i}": np.asarray(x) for i, x in enumerate(leaves)},
+    )
+
+
+def load(path: str, hosts_template, fingerprint: str):
+    """-> (hosts, wstart, wend, windows). `hosts_template` supplies the
+    pytree structure (a freshly built Hosts)."""
+    z = np.load(path)
+    got = bytes(z["__fingerprint__"]).decode()
+    if got != fingerprint:
+        raise ValueError(
+            f"checkpoint fingerprint {got} does not match scenario "
+            f"{fingerprint}: refusing to resume into a different "
+            "simulation")
+    leaves, treedef = jax.tree.flatten(hosts_template)
+    n = len(leaves)
+    new_leaves = [jnp.asarray(z[f"leaf{i}"]) for i in range(n)]
+    for tpl, new in zip(leaves, new_leaves):
+        if tpl.shape != new.shape or tpl.dtype != new.dtype:
+            raise ValueError("checkpoint layout mismatch "
+                             f"({new.shape}/{new.dtype} vs "
+                             f"{tpl.shape}/{tpl.dtype})")
+    hosts = jax.tree.unflatten(treedef, new_leaves)
+    return (hosts, int(z["__wstart__"]), int(z["__wend__"]),
+            int(z["__windows__"]))

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from ..core.simtime import SIMTIME_MAX
 from .defs import EV_NULL, ST_EQ_FULL_LOCAL
 
-_I32_MAX = jnp.int32(2**31 - 1)
+_I32_MAX = 2**31 - 1  # python int: device consts would be hoisted as const_args (see core.jitcache)
 
 
 def q_push(row, t, kind, pkt):
@@ -46,6 +46,14 @@ def q_push(row, t, kind, pkt):
         eq_ctr=row.eq_ctr + 1,
         stats=row.stats.at[ST_EQ_FULL_LOCAL].add(jnp.where(has_free, 0, 1)),
     )
+
+
+def q_has_free(row):
+    """True if a push right now would land (used by the NIC/timer
+    bookkeeping: their 'one event in flight' flags must only be set
+    when the event actually entered the queue, or a full queue turns
+    into a permanently frozen NIC/timer — a lost wakeup)."""
+    return jnp.any(row.eq_time == SIMTIME_MAX)
 
 
 def q_min(row):

@@ -40,6 +40,7 @@ class SimReport:
     sim_time_ns: int
     wall_seconds: float
     windows: int
+    heartbeats: list = field(default_factory=list)
 
     def total(self, stat: int) -> int:
         return int(self.stats[:, stat].sum())
@@ -130,6 +131,7 @@ class Simulation:
                  engine_cfg: EngineConfig = None, seed: int = None):
         self.scenario = scenario
         seed = scenario.seed if seed is None else seed
+        self.seed = seed
 
         src = topology or scenario.topology_graphml or scenario.topology_path
         self.topo = src if isinstance(src, Topology) else build_topology(src)
@@ -157,23 +159,61 @@ class Simulation:
         app_cfg = np.zeros((H, 8), dtype=np.int64)
         start_times = np.zeros((H,), dtype=np.int64)
         has_app = np.zeros(H, dtype=bool)
+        pcap_on = np.zeros(H, dtype=bool)
 
+        from ..apps.tgen import TgenTables
+        tgen_tables = TgenTables()
+        hosted_specs = []
         for idx, name, spec in scenario.expand_hosts():
             v = vertex[idx]
             bw_up[idx] = spec.bandwidth_up or self.topo.v_bw_up_bytes[v] or 1 << 40
             bw_down[idx] = spec.bandwidth_down or self.topo.v_bw_down_bytes[v] or 1 << 40
             if spec.interface_buffer:
                 nic_buf[idx] = spec.interface_buffer
+            pcap_on[idx] = spec.pcap
             if spec.processes:
                 # TPU app tier: one process per host for now (multi-process
                 # hosts arrive with the hosting milestone)
                 proc = spec.processes[0]
                 kind, cfg_words = compile_app(proc.plugin, proc.arguments,
-                                              self.dns, H)
+                                              self.dns, H,
+                                              tgen_tables=tgen_tables)
                 app_kind[idx] = kind
                 app_cfg[idx] = cfg_words
                 start_times[idx] = proc.start_time
                 has_app[idx] = True
+                if proc.plugin.startswith("hosted:"):
+                    hosted_specs.append(
+                        (idx, name, proc.plugin[len("hosted:"):],
+                         proc.arguments))
+        tg_nodes, tg_peers, tg_pool = tgen_tables.arrays()
+
+        # Dead-branch pruning (see EngineConfig): record which app kinds
+        # exist and whether TCP can be opened at all.
+        if self.cfg.app_kinds is None:
+            import dataclasses as _dc
+            from ..apps.base import (APP_TGEN, APP_BULK, APP_BULK_SERVER,
+                                     APP_HOSTED)
+            kinds = tuple(sorted(set(int(k) for k in app_kind.tolist())))
+            tcp_kinds = {APP_TGEN, APP_BULK, APP_BULK_SERVER, APP_HOSTED}
+            self.cfg = _dc.replace(
+                self.cfg, app_kinds=kinds,
+                uses_tcp=bool(tcp_kinds & set(kinds)))
+
+        # CPU-hosted apps (hosting/): real app code bridged per window
+        self.hosting = None
+        if hosted_specs:
+            from ..hosting.api import lookup
+            from ..hosting.runtime import HostingRuntime
+            apps = {idx: lookup(app_name)(args)
+                    for idx, _, app_name, args in hosted_specs}
+            hnames = {idx: hname for idx, hname, _, _ in hosted_specs}
+            self.hosting = HostingRuntime(apps, hnames, self.dns, seed)
+            if self.cfg.hostedcap < 32:
+                # concurrent wakes within one window (e.g. several
+                # accepts) must all fit the ring or callbacks are lost
+                import dataclasses as _dc
+                self.cfg = _dc.replace(self.cfg, hostedcap=32)
 
         self.hp = HostParams(
             hid=jnp.arange(H, dtype=jnp.int32),
@@ -183,12 +223,24 @@ class Simulation:
             app_kind=jnp.asarray(app_kind),
             app_cfg=jnp.asarray(app_cfg),
             nic_buf=jnp.asarray(nic_buf),
+            pcap_on=jnp.asarray(pcap_on),
         )
+
+        # pcap capture needs the trace ring sized for a window chunk
+        if pcap_on.any() and self.cfg.tracecap == 0:
+            import dataclasses as _dc
+            self.cfg = _dc.replace(
+                self.cfg,
+                tracecap=self.cfg.chunk_windows *
+                (self.cfg.obcap + self.cfg.incap))
 
         min_jump = self.topo.min_latency_ns or DEFAULT_MIN_TIME_JUMP
         self.sh = make_shared(self.topo.latency_ns, self.topo.reliability,
                               R.root_key(seed), scenario.stop_time, min_jump,
-                              cc_kind=self.cfg.cc_kind)
+                              cc_kind=self.cfg.cc_kind,
+                              tgen_nodes=tg_nodes, tgen_peers=tg_peers,
+                              tgen_pool=tg_pool,
+                              host_vertex=vertex)
 
         # --- initial events: process starts (reference process_schedule) ---
         hosts = alloc_hosts(self.cfg)
@@ -208,34 +260,164 @@ class Simulation:
 
         self._ran = False
 
-    def run(self, verbose: bool = False) -> SimReport:
+    def _pad_for_mesh(self, n_shards: int):
+        """Pad the host dimension to a multiple of the shard count with
+        inert hosts (empty queues, no app). Inert rows never emit or
+        receive, so stats[:H] are bit-identical to the unpadded run."""
+        import dataclasses as _dc
+
+        H = self.cfg.num_hosts
+        Hp = ((H + n_shards - 1) // n_shards) * n_shards
+        if Hp == H:
+            return self.hosts, self.hp, self.sh, self.cfg
+        cfg = _dc.replace(self.cfg, num_hosts=Hp)
+        fresh = alloc_hosts(cfg)
+        hosts = jax.tree.map(
+            lambda a, b: jnp.concatenate([a, b[H:]], axis=0),
+            self.hosts, fresh)
+        pad = Hp - H
+        hp = HostParams(
+            hid=jnp.concatenate([self.hp.hid,
+                                 jnp.arange(H, Hp, dtype=jnp.int32)]),
+            vertex=jnp.concatenate([self.hp.vertex,
+                                    jnp.zeros(pad, jnp.int32)]),
+            bw_up=jnp.concatenate([self.hp.bw_up,
+                                   jnp.ones(pad, jnp.int64)]),
+            bw_down=jnp.concatenate([self.hp.bw_down,
+                                     jnp.ones(pad, jnp.int64)]),
+            app_kind=jnp.concatenate([self.hp.app_kind,
+                                      jnp.zeros(pad, jnp.int32)]),
+            app_cfg=jnp.concatenate([self.hp.app_cfg,
+                                     jnp.zeros((pad, 8), jnp.int64)]),
+            nic_buf=jnp.concatenate([self.hp.nic_buf,
+                                     jnp.ones(pad, jnp.int64)]),
+            pcap_on=jnp.concatenate([self.hp.pcap_on,
+                                     jnp.zeros(pad, jnp.bool_)]),
+        )
+        sh = self.sh.replace(host_vertex=jnp.concatenate(
+            [self.sh.host_vertex, jnp.zeros(pad, jnp.int32)]))
+        return hosts, hp, sh, cfg
+
+    def run(self, verbose: bool = False, mesh=None, heartbeat_s: float = 0,
+            logger=None, checkpoint_path: str = None,
+            checkpoint_every_s: float = 0,
+            resume_from: str = None, pcap_dir: str = None) -> SimReport:
+        """Run to the stop time. With `mesh` (a 1-D jax Mesh over a
+        "hosts" axis) the window program runs under shard_map with the
+        host dimension block-sharded — same results, N chips.
+        `heartbeat_s` > 0 emits tracker heartbeats on that sim-time
+        interval (obs.tracker). `checkpoint_path` + `checkpoint_every_s`
+        snapshot state periodically; `resume_from` restores one.
+        """
         assert not self._ran, "Simulation objects are single-use"
         self._ran = True
-        hosts, cfg, hp, sh = self.hosts, self.cfg, self.hp, self.sh
+        H = self.cfg.num_hosts
+
+        tracker = None
+        if heartbeat_s:
+            from ..obs.tracker import Tracker
+            tracker = Tracker(int(heartbeat_s * 10**9), self.host_names,
+                              logger)
+
+        pcap = None
+        if self.cfg.tracecap and pcap_dir is not None:
+            from ..obs.pcap import PcapWriter
+            traced = np.flatnonzero(np.asarray(self.hp.pcap_on))
+            pcap = PcapWriter(pcap_dir, self.host_names,
+                              self.dns.ip_array(H), traced)
+
+        from . import checkpoint as ckpt
+        fingerprint = ckpt.scenario_fingerprint(self.scenario, self.cfg,
+                                                self.seed)
+
+        if mesh is None:
+            hosts, cfg, hp, sh = self.hosts, self.cfg, self.hp, self.sh
+            # hosted apps need the CPU between every window
+            chunk = 1 if self.hosting else cfg.chunk_windows
+
+            def step(hosts, ws, we):
+                return run_windows(hosts, hp, sh, ws, we, cfg, chunk)
+        elif self.hosting:
+            raise NotImplementedError(
+                "hosted apps + mesh sharding not supported yet")
+        else:
+            from ..parallel.shard import (AXIS, device_put_sharded,
+                                          run_windows_sharded)
+            n = mesh.shape[AXIS]
+            hosts, hp, sh, cfg = self._pad_for_mesh(n)
+            hosts, hp, sh = device_put_sharded(hosts, hp, sh, mesh)
+
+            def step(hosts, ws, we):
+                return run_windows_sharded(hosts, hp, sh, ws, we, cfg,
+                                           cfg.chunk_windows, mesh)
 
         t0 = jnp.min(hosts.eq_time)
         wstart = t0
         wend = jnp.where(t0 == SIMTIME_MAX, t0, t0 + sh.min_jump)
 
         total_windows = 0
+        if resume_from:
+            if self.hosting is not None:
+                raise NotImplementedError(
+                    "resume with hosted apps is not supported: the "
+                    "snapshot holds device state only, not the hosted "
+                    "processes' Python state")
+            hosts, ws0, we0, total_windows = ckpt.load(
+                resume_from, hosts, fingerprint)
+            wstart = jnp.int64(ws0)
+            wend = jnp.int64(we0)
+            if mesh is not None:
+                from ..parallel.shard import device_put_sharded as _dps
+                hosts, _, _ = _dps(hosts, hp, sh, mesh)
+
+        next_ckpt = (int(checkpoint_every_s * 10**9)
+                     if checkpoint_every_s else 0)
+        ckpt_at = int(wstart) + next_ckpt if next_ckpt else None
         wall0 = _time.perf_counter()
         while True:
-            hosts, wstart, wend, n = run_windows(
-                hosts, hp, sh, wstart, wend, cfg, cfg.chunk_windows)
+            hosts, wstart, wend, n = step(hosts, wstart, wend)
             total_windows += int(n)
             ws = int(wstart)
+            if self.hosting is not None:
+                now = min(ws, int(sh.stop_time))
+                hosts = self.hosting.step(hosts, hp, sh, now)
+                dropped = int(np.asarray(hosts.hw_drop).sum())
+                if dropped:
+                    raise RuntimeError(
+                        f"{dropped} hosted-app wakes lost to wake-ring "
+                        "overflow; raise EngineConfig.hostedcap")
+                # ops may have queued events earlier than the next
+                # window the engine computed — re-derive the window
+                nt = jnp.min(hosts.eq_time)
+                wstart = nt
+                wend = jnp.where(nt == SIMTIME_MAX, nt, nt + sh.min_jump)
+                ws = int(wstart)
+            if pcap is not None:
+                pcap.drain(hosts.tr_time, hosts.tr_pkt, hosts.tr_cnt)
+                hosts = hosts.replace(
+                    tr_cnt=jnp.zeros_like(hosts.tr_cnt))
+            if tracker is not None:
+                tracker.maybe_heartbeat(min(ws, int(sh.stop_time)),
+                                        np.asarray(hosts.stats)[:H])
+            if checkpoint_path and ckpt_at is not None and ws >= ckpt_at:
+                ckpt.save(checkpoint_path, hosts, ws, int(wend),
+                          total_windows, fingerprint)
+                ckpt_at += next_ckpt
             if verbose:
                 print(f"  t={ws / SIMTIME_ONE_SECOND:.3f}s "
                       f"windows={total_windows}")
             if ws >= int(sh.stop_time) or ws >= SIMTIME_MAX:
                 break
-        stats = np.asarray(hosts.stats)
+        if pcap is not None:
+            pcap.close()
+        stats = np.asarray(hosts.stats)[:H]
         wall = _time.perf_counter() - wall0
         self.final_hosts = hosts
         sim_ns = min(int(sh.stop_time), ws) if ws < SIMTIME_MAX else int(sh.stop_time)
         return SimReport(stats=stats, host_names=self.host_names,
                          sim_time_ns=sim_ns, wall_seconds=wall,
-                         windows=total_windows)
+                         windows=total_windows,
+                         heartbeats=(tracker.lines if tracker else []))
 
 
 def run_scenario(scenario: Scenario, **kw) -> SimReport:

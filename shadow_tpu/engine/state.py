@@ -43,6 +43,17 @@ class EngineConfig:
     txqcap: int = 16        # NIC transmit-ring slots per host
     chunk_windows: int = 16  # windows executed per jit invocation
     cc_kind: int = 2        # 0=aimd 1=reno 2=cubic (reference default cubic)
+    hostedcap: int = 1      # hosted-app wake-ring slots per host (hosting/)
+    # Dead-branch pruning: which app kinds exist in this scenario, and
+    # whether any host can open a TCP socket. The Simulation fills these
+    # from the compiled process specs; the window program only traces
+    # branches that can run — at 1 app kind the compile is a fraction
+    # of the all-apps program (no behavioral effect: a pruned branch is
+    # unreachable by construction).
+    app_kinds: tuple = None  # e.g. (0, 3) — must include 0 (APP_NULL)
+    uses_tcp: bool = True
+    tracecap: int = 0       # packet-trace ring slots per host (obs.pcap;
+    #   0 disables tracing entirely — the exchange compiles no trace code)
 
 
 @chex.dataclass
@@ -82,6 +93,15 @@ class Hosts:
     sk_snd_max: jnp.ndarray  # i64 highest offset ever transmitted
     sk_snd_end: jnp.ndarray  # i64 total bytes app has written
     sk_rcv_nxt: jnp.ndarray  # i64 next in-order offset expected
+    # single-hole SACK emulation (the reference's scoreboard,
+    # shd-tcp-scoreboard.c, collapsed to one out-of-order range — the
+    # dominant single-loss case; multi-hole degrades to go-back-N)
+    sk_ooo_start: jnp.ndarray  # i64 receiver out-of-order range start (-1)
+    sk_ooo_end: jnp.ndarray    # i64 .. end (exclusive)
+    sk_hole_end: jnp.ndarray   # i64 sender: retransmit only [una, hole_end)
+    sk_rex_nxt: jnp.ndarray   # i64 sender: resume after skip from here
+    #   (end of the peer's sacked range; later data may have been lost
+    #   too, so transmission resumes there, not at snd_max)
     sk_peer_fin: jnp.ndarray  # i64 peer's FIN stream offset (-1 = none seen)
     sk_fin_acked: jnp.ndarray  # bool our FIN was acked
     sk_close_after: jnp.ndarray  # bool app closed: FIN after snd_end drains
@@ -113,6 +133,17 @@ class Hosts:
     ob_pkt: jnp.ndarray    # [H, O, PKT_WORDS] i32
     ob_time: jnp.ndarray   # [H, O] i64 send (wire-entry) time
     ob_cnt: jnp.ndarray    # [H] i32
+    # --- hosted-app wake ring (hosting.bridge; drained per window) ---
+    hw_time: jnp.ndarray   # [H, HW] i64 wake event times
+    hw_pkt: jnp.ndarray    # [H, HW, PKT_WORDS] i32 wake payloads
+    hw_cnt: jnp.ndarray    # [H] i32
+    hw_drop: jnp.ndarray   # [H] i32 wakes lost to ring overflow
+    # --- packet-trace ring (obs.pcap; drained per chunk) ---
+    tr_time: jnp.ndarray   # [H, TC] i64
+    tr_pkt: jnp.ndarray    # [H, TC, PKT_WORDS] i32
+    tr_dir: jnp.ndarray    # [H, TC] i32: 0 rx, 1 tx
+    tr_cnt: jnp.ndarray    # [H] i32
+    tr_drop: jnp.ndarray   # [H] i32 records lost to ring overflow
     # --- observability ---
     stats: jnp.ndarray     # [H, N_STATS] i64
 
@@ -127,6 +158,8 @@ class HostParams:
     app_kind: jnp.ndarray   # [H] i32 which app runs here (apps registry)
     app_cfg: jnp.ndarray    # [H, 8] i64 app static params
     nic_buf: jnp.ndarray    # [H] i64 NIC input buffer bytes
+    pcap_on: jnp.ndarray    # [H] bool: record this host's packets
+    #   (reference <host logpcap=...>, shd-network-interface.c:186-223)
 
 
 @chex.dataclass
@@ -136,6 +169,9 @@ class Shared:
     stored here."""
     lat_ns: jnp.ndarray    # [V, V] i64 path latency
     rel: jnp.ndarray       # [V, V] f32 path reliability
+    host_vertex: jnp.ndarray  # [H] i32 host -> topology vertex (replicated
+    #   copy of HostParams.vertex: routing needs the vertex of REMOTE
+    #   destination hosts, which a host-sharded table cannot provide)
     rng_root: jnp.ndarray  # PRNG key
     stop_time: jnp.ndarray  # i64 scalar
     min_jump: jnp.ndarray   # i64 scalar: lookahead window width
@@ -144,6 +180,10 @@ class Shared:
     cc_kind: jnp.ndarray       # i32: 0=aimd 1=reno 2=cubic
     tcp_init_wnd: jnp.ndarray  # f32 initial cwnd, packets (default 10)
     tcp_ssthresh0: jnp.ndarray  # f32 initial ssthresh (0 = discover)
+    # tgen behavior-graph tables (apps.tgen; 1-row dummies when unused)
+    tgen_nodes: jnp.ndarray    # [N, 8] i64 node table
+    tgen_peers: jnp.ndarray    # [M, 2] i32 (host, port) pool
+    tgen_pool: jnp.ndarray     # [K] i64 pause-choice pool (ns)
 
 
 def alloc_hosts(cfg: EngineConfig) -> Hosts:
@@ -181,6 +221,10 @@ def alloc_hosts(cfg: EngineConfig) -> Hosts:
         sk_snd_max=full((H, S), 0, jnp.int64),
         sk_snd_end=full((H, S), 0, jnp.int64),
         sk_rcv_nxt=full((H, S), 0, jnp.int64),
+        sk_ooo_start=full((H, S), -1, jnp.int64),
+        sk_ooo_end=full((H, S), -1, jnp.int64),
+        sk_hole_end=full((H, S), 0, jnp.int64),
+        sk_rex_nxt=full((H, S), 0, jnp.int64),
         sk_peer_fin=full((H, S), -1, jnp.int64),
         sk_fin_acked=full((H, S), False, jnp.bool_),
         sk_close_after=full((H, S), False, jnp.bool_),
@@ -209,6 +253,15 @@ def alloc_hosts(cfg: EngineConfig) -> Hosts:
         ob_pkt=full((H, O, PKT_WORDS), 0, jnp.int32),
         ob_time=full((H, O), 0, jnp.int64),
         ob_cnt=full((H,), 0, jnp.int32),
+        hw_time=full((H, max(cfg.hostedcap, 1)), 0, jnp.int64),
+        hw_pkt=full((H, max(cfg.hostedcap, 1), PKT_WORDS), 0, jnp.int32),
+        hw_cnt=full((H,), 0, jnp.int32),
+        hw_drop=full((H,), 0, jnp.int32),
+        tr_time=full((H, max(cfg.tracecap, 1)), 0, jnp.int64),
+        tr_pkt=full((H, max(cfg.tracecap, 1), PKT_WORDS), 0, jnp.int32),
+        tr_dir=full((H, max(cfg.tracecap, 1)), 0, jnp.int32),
+        tr_cnt=full((H,), 0, jnp.int32),
+        tr_drop=full((H,), 0, jnp.int32),
         stats=full((H, N_STATS), 0, jnp.int64),
     )
 
@@ -216,14 +269,30 @@ def alloc_hosts(cfg: EngineConfig) -> Hosts:
 def make_shared(topo_lat_ns: np.ndarray, topo_rel: np.ndarray, rng_root,
                 stop_time: int, min_jump: int, cc_kind: int = 2,
                 tcp_init_wnd: float = 10.0,
-                tcp_ssthresh0: float = 0.0) -> Shared:
+                tcp_ssthresh0: float = 0.0,
+                tgen_nodes: np.ndarray = None,
+                tgen_peers: np.ndarray = None,
+                tgen_pool: np.ndarray = None,
+                host_vertex: np.ndarray = None) -> Shared:
+    if host_vertex is None:
+        host_vertex = np.zeros((1,), np.int32)
+    if tgen_nodes is None:
+        tgen_nodes = np.zeros((1, 8), np.int64)
+    if tgen_peers is None:
+        tgen_peers = np.zeros((1, 2), np.int32)
+    if tgen_pool is None:
+        tgen_pool = np.zeros((1,), np.int64)
     return Shared(
         lat_ns=jnp.asarray(topo_lat_ns, dtype=jnp.int64),
         rel=jnp.asarray(topo_rel, dtype=jnp.float32),
+        host_vertex=jnp.asarray(host_vertex, dtype=jnp.int32),
         rng_root=rng_root,
         stop_time=jnp.int64(stop_time),
         min_jump=jnp.int64(min_jump),
         cc_kind=jnp.int32(cc_kind),
         tcp_init_wnd=jnp.float32(tcp_init_wnd),
         tcp_ssthresh0=jnp.float32(tcp_ssthresh0),
+        tgen_nodes=jnp.asarray(tgen_nodes, dtype=jnp.int64),
+        tgen_peers=jnp.asarray(tgen_peers, dtype=jnp.int32),
+        tgen_pool=jnp.asarray(tgen_pool, dtype=jnp.int64),
     )

@@ -56,41 +56,53 @@ def _on_null(row, hp, sh, now, wend, pkt):
     return row
 
 
-def _on_app(row, hp, sh, now, wend, pkt):
-    return app_dispatch(row, hp, sh, now, pkt)
+def _make_handlers(cfg: EngineConfig):
+    """Build the event-kind switch for this scenario. Static pruning:
+    app kinds not present and (when uses_tcp is False) the whole TCP
+    machine compile to nothing."""
+
+    def _on_app(row, hp, sh, now, wend, pkt):
+        return app_dispatch(row, hp, sh, now, pkt,
+                            app_kinds=cfg.app_kinds)
+
+    def _on_pkt(row, hp, sh, now, wend, pkt):
+        """Packet arrival at the NIC: admission, demux, protocol
+        dispatch."""
+        row, keep = nic.rx_admit(row, hp, now, pkt)
+
+        def deliver(r):
+            r = r.replace(stats=r.stats.at[ST_PKTS_RECV].add(1))
+            proto = pkt[P.FLAGS] & P.PROTO_MASK
+
+            def tcp_path(rr):
+                slot = sock_demux(rr, pkt[P.SRC], pkt[P.SPORT],
+                                  pkt[P.DPORT], P.PROTO_TCP)
+                return jax.lax.cond(
+                    slot >= 0,
+                    lambda r2: tcp_rx(r2, hp, sh, now, slot, pkt),
+                    lambda r2: r2, rr)
+
+            def udp_path(rr):
+                slot = sock_demux(rr, pkt[P.SRC], pkt[P.SPORT],
+                                  pkt[P.DPORT], P.PROTO_UDP)
+                return jax.lax.cond(
+                    slot >= 0,
+                    lambda r2: udp_deliver(r2, hp, sh, now, slot, pkt),
+                    lambda r2: r2, rr)
+
+            if not cfg.uses_tcp:
+                return udp_path(r)
+            return jax.lax.cond(proto == P.PROTO_TCP, tcp_path, udp_path, r)
+
+        return jax.lax.cond(keep, deliver, lambda r: r, row)
+
+    if cfg.uses_tcp:
+        return [_on_null, _on_app, _on_pkt, nic.on_tx, on_tcp_timer,
+                on_tcp_close]
+    return [_on_null, _on_app, _on_pkt, nic.on_tx, _on_null, _on_null]
 
 
-def _on_pkt(row, hp, sh, now, wend, pkt):
-    """Packet arrival at the NIC: admission, demux, protocol dispatch."""
-    row, keep = nic.rx_admit(row, hp, now, pkt)
-
-    def deliver(r):
-        r = r.replace(stats=r.stats.at[ST_PKTS_RECV].add(1))
-        proto = pkt[P.FLAGS] & P.PROTO_MASK
-
-        def tcp_path(rr):
-            slot = sock_demux(rr, pkt[P.SRC], pkt[P.SPORT], pkt[P.DPORT],
-                              P.PROTO_TCP)
-            return jax.lax.cond(slot >= 0,
-                                lambda r2: tcp_rx(r2, hp, sh, now, slot, pkt),
-                                lambda r2: r2, rr)
-
-        def udp_path(rr):
-            slot = sock_demux(rr, pkt[P.SRC], pkt[P.SPORT], pkt[P.DPORT],
-                              P.PROTO_UDP)
-            return jax.lax.cond(slot >= 0,
-                                lambda r2: udp_deliver(r2, hp, sh, now, slot, pkt),
-                                lambda r2: r2, rr)
-
-        return jax.lax.cond(proto == P.PROTO_TCP, tcp_path, udp_path, r)
-
-    return jax.lax.cond(keep, deliver, lambda r: r, row)
-
-
-_HANDLERS = [_on_null, _on_app, _on_pkt, nic.on_tx, on_tcp_timer, on_tcp_close]
-
-
-def step_one_host(row, hp, sh, wend):
+def step_one_host(row, hp, sh, wend, cfg: EngineConfig):
     """Pop and execute this host's earliest event if inside the window."""
     slot, t = equeue.q_min(row)
     ready = t < wend
@@ -98,17 +110,39 @@ def step_one_host(row, hp, sh, wend):
     pkt = row.eq_pkt[slot]
     row = jax.lax.cond(ready, lambda r: equeue.q_clear_slot(r, slot),
                        lambda r: r, row)
-    row = jax.lax.switch(kind, _HANDLERS, row, hp, sh, t, wend, pkt)
+    row = jax.lax.switch(kind, _make_handlers(cfg), row, hp, sh, t, wend, pkt)
     return row.replace(
         stats=row.stats.at[ST_EVENTS].add(jnp.where(ready, 1, 0)))
 
 
-def step_all_hosts(hosts, hp, sh, wend):
-    return jax.vmap(step_one_host, in_axes=(0, 0, None, None))(
-        hosts, hp, sh, wend)
+def step_all_hosts(hosts, hp, sh, wend, cfg: EngineConfig):
+    # cfg is Python-static; close over it (vmap axes cover arrays only)
+    def f(row, hprow):
+        return step_one_host(row, hprow, sh, wend, cfg)
+
+    return jax.vmap(f)(hosts, hp)
 
 
 # --- Window-boundary packet exchange --------------------------------------
+
+def _trace_append(row, pkts, times, valid, dirv, on):
+    """Append up to len(times) records to this host's trace ring
+    (obs.pcap). Row-level under vmap; compiled only when tracing."""
+    TC = row.tr_time.shape[0]
+    take = valid & on
+    k = jnp.sum(take).astype(jnp.int32)
+    rank = jnp.cumsum(take) - 1
+    pos = row.tr_cnt + rank.astype(jnp.int32)
+    ok = take & (pos < TC)
+    tgt = jnp.where(ok, pos, TC)
+    return row.replace(
+        tr_time=row.tr_time.at[tgt].set(times, mode="drop"),
+        tr_pkt=row.tr_pkt.at[tgt].set(pkts, mode="drop"),
+        tr_dir=row.tr_dir.at[tgt].set(jnp.int32(dirv), mode="drop"),
+        tr_cnt=jnp.minimum(row.tr_cnt + k, TC),
+        tr_drop=row.tr_drop + jnp.maximum(row.tr_cnt + k - TC, 0),
+    )
+
 
 def exchange(hosts, hp, sh, cfg: EngineConfig):
     """Route, loss-roll and deliver all outbox packets into destination
@@ -122,8 +156,8 @@ def exchange(hosts, hp, sh, cfg: EngineConfig):
 
     src = jnp.clip(pkts[:, P.SRC], 0, H - 1)
     dst = jnp.clip(pkts[:, P.DST], 0, H - 1)
-    sv = hp.vertex[src]
-    dv = hp.vertex[dst]
+    sv = sh.host_vertex[src]
+    dv = sh.host_vertex[dst]
     lat = sh.lat_ns[sv, dv]
     rel = sh.rel[sv, dv]
     arrival = stimes + lat
@@ -164,22 +198,40 @@ def exchange(hosts, hp, sh, cfg: EngineConfig):
         jnp.where(q_dropped, 1, 0).astype(jnp.int64))
     hosts = hosts.replace(stats=stats)
 
-    # merge inbound packets into per-host queue free slots
+    if cfg.tracecap:
+        # tx records: each source's outbox rows (cross-host traffic;
+        # loopback delivery bypasses the exchange and is not traced)
+        ob_valid = jnp.arange(O)[None, :] < hosts.ob_cnt[:, None]
+        hosts = jax.vmap(_trace_append, in_axes=(0, 0, 0, 0, None, 0))(
+            hosts, hosts.ob_pkt, hosts.ob_time, ob_valid, 1, hp.pcap_on)
+        # rx records: what lands on each destination this window
+        hosts = jax.vmap(_trace_append, in_axes=(0, 0, 0, 0, None, 0))(
+            hosts, in_pkt.reshape(H, IN, P.PKT_WORDS),
+            in_time.reshape(H, IN),
+            in_time.reshape(H, IN) != SIMTIME_MAX, 0, hp.pcap_on)
+
+    # merge inbound packets into per-host queue free slots, keeping a
+    # reserve so protocol-internal pushes (NIC events, timers, app
+    # wakes) cannot be starved by an arrival burst — a full queue
+    # would silently drop those and freeze the host's NIC
+    reserve = min(8, cfg.qcap // 4)
+
     def merge(row, ipkt, itime):
         k = jnp.sum(itime != SIMTIME_MAX).astype(jnp.int32)
         free = row.eq_time == SIMTIME_MAX
-        frank = jnp.cumsum(free) - 1
-        take = free & (frank < k)
-        j = jnp.clip(frank, 0, IN - 1)
         nfree = jnp.sum(free).astype(jnp.int32)
-        overflow = jnp.maximum(k - nfree, 0)
+        k2 = jnp.minimum(k, jnp.maximum(nfree - reserve, 0))
+        frank = jnp.cumsum(free) - 1
+        take = free & (frank < k2)
+        j = jnp.clip(frank, 0, IN - 1)
+        overflow = k - k2
         return row.replace(
             eq_time=jnp.where(take, itime[j], row.eq_time),
             eq_kind=jnp.where(take, EV_PKT, row.eq_kind),
             eq_seq=jnp.where(take, row.eq_ctr + frank.astype(jnp.int32),
                              row.eq_seq),
             eq_pkt=jnp.where(take[:, None], ipkt[j], row.eq_pkt),
-            eq_ctr=row.eq_ctr + k,
+            eq_ctr=row.eq_ctr + k2,
             stats=row.stats.at[ST_PKTS_DROP_Q].add(jnp.int64(overflow)),
         )
 
@@ -196,7 +248,13 @@ def next_event_time(hosts):
     return jnp.min(hosts.eq_time)
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_windows"), donate_argnums=(0,))
+# One AOT-compiled instance per (cfg, max_windows): this build's jit
+# dispatch fast path runs the wrong executable when multiple big
+# variants exist in one process ("supplied 87 buffers but expected 90");
+# the ahead-of-time Compiled path sidesteps it (core.jitcache).
+_RW_INSTANCES = {}
+
+
 def run_windows(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
                 max_windows: int):
     """Execute up to `max_windows` lookahead windows on device.
@@ -204,7 +262,24 @@ def run_windows(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
     Returns (hosts, wstart', wend', windows_run). The caller loops until
     wstart' >= stop_time or wstart' == SIMTIME_MAX (no events left).
     """
+    from ..core.jitcache import AotJit
 
+    key = (cfg, max_windows)
+    fn = _RW_INSTANCES.get(key)
+    if fn is None:
+        def impl(hosts, hp, sh, wstart, wend):
+            return _run_windows_impl(hosts, hp, sh, wstart, wend, cfg,
+                                     max_windows)
+
+        impl.__name__ = f"run_windows_v{len(_RW_INSTANCES)}"
+        impl.__qualname__ = impl.__name__
+        fn = AotJit(impl, donate_argnums=(0,))
+        _RW_INSTANCES[key] = fn
+    return fn(hosts, hp, sh, wstart, wend)
+
+
+def _run_windows_impl(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
+                      max_windows: int):
     def win_cond(carry):
         _, ws, _, i = carry
         return (i < max_windows) & (ws < sh.stop_time) & (ws < SIMTIME_MAX)
@@ -219,7 +294,7 @@ def run_windows(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
             return next_event_time(h) < we_eff
 
         def ev_body(h):
-            return step_all_hosts(h, hp, sh, we_eff)
+            return step_all_hosts(h, hp, sh, we_eff, cfg)
 
         hosts = jax.lax.while_loop(ev_cond, ev_body, hosts)
         hosts = exchange(hosts, hp, sh, cfg)

@@ -1,1 +1,13 @@
-"""hosting subpackage."""
+"""App hosting: real application code on CPU, transport on device.
+
+The TPU-native replacement for the reference's plugin machinery
+(LD_PRELOAD interposition + elf-loader namespaces + rpth green threads,
+SURVEY §2.4/2.5): hosted apps implement :class:`HostedApp` callbacks
+against a :class:`HostOS` syscall surface; the engine delivers wakes
+and applies syscall batches at lookahead-window boundaries
+(hosting.bridge / hosting.runtime).
+"""
+
+from .api import HostOS, HostedApp, Sock, register, lookup
+
+__all__ = ["HostOS", "HostedApp", "Sock", "register", "lookup"]

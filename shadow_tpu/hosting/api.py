@@ -1,0 +1,186 @@
+"""CPU-side hosted-application API.
+
+This is what replaces writing a C plugin against libc + LD_PRELOAD in
+the reference (SURVEY §2.4/2.5): a hosted app is real Python code
+driven by the same wake reasons on-device apps get, issuing syscalls
+against a per-host :class:`HostOS` handle. Syscalls are batched and
+applied to device state between lookahead windows (hosting.bridge), so
+apps see the engine's real TCP/UDP stack.
+
+Determinism: apps must derive randomness from ``os.random()`` (seeded
+per host from the scenario seed) and time from ``os.now()`` (simulated
+nanoseconds) — mirroring how the reference virtualizes /dev/random and
+clock_gettime for plugins (shd-process.c:4329-4650).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class Sock:
+    """Handle for a device-side socket slot. Resolves after the op
+    batch that created it is applied; hosted apps only dereference it
+    in later callbacks, by which time it is bound."""
+
+    __slots__ = ("slot",)
+
+    def __init__(self):
+        self.slot = None
+
+    def __index__(self):
+        if self.slot is None:
+            raise RuntimeError("Sock used before its open op applied")
+        return self.slot
+
+    def __repr__(self):
+        return f"Sock({self.slot})"
+
+
+@dataclass
+class _PendingOp:
+    code: int
+    a: int = 0
+    b: int = 0
+    c: int = 0
+    d: int = 0
+    t: int = 0          # sim time the op was issued (its wake's time)
+    out: Sock = None
+
+
+class HostOS:
+    """Per-host syscall surface handed to hosted app callbacks.
+
+    The call set mirrors the host_* syscall backend of the reference
+    (shd-host.c:598-1556) at the same granularity the on-device apps
+    use: byte-counted streams and tagged datagrams.
+    """
+
+    def __init__(self, host_id: int, name: str, rng, dns, clock):
+        self.host_id = host_id
+        self.name = name
+        self._rng = rng
+        self._dns = dns
+        self._clock = clock          # callable -> current sim time ns
+        self._ops: list = []
+        self._socks: dict = {}       # slot -> Sock
+
+    # --- environment ---
+    def now(self) -> int:
+        """Simulated time, nanoseconds."""
+        return self._clock()
+
+    def random(self) -> float:
+        """Deterministic per-host uniform [0, 1)."""
+        return float(self._rng.random())
+
+    def resolve(self, name: str) -> int:
+        """Virtual DNS lookup -> host id."""
+        return self._dns.resolve(name)
+
+    # --- sockets ---
+    def udp_open(self, port: int = 0) -> Sock:
+        return self._push_open(1, a=port)
+
+    def tcp_listen(self, port: int) -> Sock:
+        return self._push_open(2, a=port)
+
+    def tcp_connect(self, dst, port: int, tag: int = 0) -> Sock:
+        dst = self.resolve(dst) if isinstance(dst, str) else int(dst)
+        return self._push_open(3, a=dst, b=port, c=tag)
+
+    def write(self, sock, nbytes: int):
+        self._push(_PendingOp(4, a=self._slot(sock), b=int(nbytes)))
+
+    def sendto(self, sock, dst, port: int, nbytes: int, aux: int = 0):
+        dst = self.resolve(dst) if isinstance(dst, str) else int(dst)
+        self._push(_PendingOp(
+            5, a=self._slot(sock), b=dst,
+            c=(int(port) << 32) | (int(aux) & 0xFFFFFFFF), d=int(nbytes)))
+
+    def close(self, sock):
+        self._push(_PendingOp(6, a=self._slot(sock)))
+
+    def timer(self, delay_ns: int, tag: int = 0):
+        self._push(_PendingOp(7, a=self.now() + int(delay_ns),
+                              b=int(tag)))
+
+    # --- internals ---
+    def _push(self, op: _PendingOp):
+        op.t = self.now()
+        self._ops.append(op)
+
+    def _push_open(self, code, a=0, b=0, c=0) -> Sock:
+        s = Sock()
+        self._push(_PendingOp(code, a=a, b=b, c=c, out=s))
+        return s
+
+    def _slot(self, sock):
+        """A slot operand: an int, a resolved Sock, or an unresolved
+        Sock created earlier in this same batch (the runtime encodes
+        the latter as a same-batch result reference, resolved on
+        device — so `sock = os.udp_open(); os.sendto(sock, ...)` works
+        within one callback)."""
+        if isinstance(sock, Sock):
+            return sock if sock.slot is None else sock.slot
+        return int(sock)
+
+    def sock_for(self, slot: int) -> Sock:
+        """Sock handle for a raw wake slot (server-accepted children
+        get their first handle here)."""
+        s = self._socks.get(slot)
+        if s is None:
+            s = Sock()
+            s.slot = slot
+            self._socks[slot] = s
+        return s
+
+    def _bind(self, sock: Sock, slot: int):
+        sock.slot = slot
+        if slot >= 0:
+            self._socks[slot] = sock
+
+
+class HostedApp:
+    """Base class for hosted applications. Override the callbacks you
+    need; each receives the HostOS handle first."""
+
+    def on_start(self, os: HostOS):
+        pass
+
+    def on_timer(self, os: HostOS, tag: int):
+        pass
+
+    def on_connected(self, os: HostOS, sock: Sock):
+        pass
+
+    def on_accept(self, os: HostOS, sock: Sock, tag: int):
+        pass
+
+    def on_eof(self, os: HostOS, sock: Sock):
+        pass
+
+    def on_sent(self, os: HostOS, sock: Sock):
+        pass
+
+    def on_dgram(self, os: HostOS, sock: Sock, src: int, sport: int,
+                 nbytes: int, aux: int):
+        pass
+
+
+# --- hosted-plugin registry (the analogue of <plugin id path>) ---
+
+_REGISTRY: dict = {}
+
+
+def register(name: str, factory):
+    """Register a hosted app factory: factory(args_str) -> HostedApp."""
+    _REGISTRY[name] = factory
+
+
+def lookup(name: str):
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"no hosted app {name!r} registered "
+            f"(have: {sorted(_REGISTRY)}); call hosting.register first")
+    return _REGISTRY[name]

@@ -1,0 +1,171 @@
+"""Device side of the app-hosting bridge.
+
+The reference hosts real, unmodified applications by interposing libc
+and re-entering blocked green threads from the epoll notify task
+(/root/reference/src/main/host/shd-process.c,
+src/preload/shd-interposer.c; reentry shd-epoll.c:597-658). The TPU
+redesign keeps the same seam — apps outside the engine, the entire
+virtual network stack inside — but inverts the mechanics:
+
+- every wake that would re-enter a hosted process is appended to a
+  device-resident **wake ring** (Hosts.hw_*), drained to the CPU at
+  window boundaries;
+- every syscall the hosted app makes in response is encoded as a fixed
+  op word and applied to device state by :func:`apply_ops` — one
+  compiled program that replays the batch through the same row-level
+  socket/TCP/UDP calls the on-device apps use.
+
+So hosted apps get the real transport stack (handshakes, cwnd, RTO,
+loss) with CPU-side application logic; the cost is one host round trip
+per lookahead window, which is the price the reference also pays at its
+process boundary (context switches into pth threads per event). See
+hosting.runtime for the CPU half.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..engine import equeue
+from ..engine.defs import EV_APP, WAKE_TIMER, ST_EQ_FULL_LOCAL
+from ..net import nic
+from ..net import packet as P
+from ..net.tcp import tcp_connect, tcp_listen, tcp_write, tcp_close_call
+from ..net.udp import udp_sendto
+
+_I32 = jnp.int32
+_I64 = jnp.int64
+
+# --- op encoding (int64 words) ---
+# [host, opcode, a, b, c, d, t]  (t = sim time the app issued the op,
+# i.e. its wake's event time — ops apply at app time, not window time)
+OP_WORDS = 7
+OP_NOP = 0
+OP_UDP_OPEN = 1      # a=port (0 = ephemeral)           -> slot
+OP_TCP_LISTEN = 2    # a=port                           -> slot
+OP_TCP_CONNECT = 3   # a=dst host, b=dst port, c=tag    -> slot
+OP_TCP_WRITE = 4     # a=slot, b=nbytes
+OP_UDP_SENDTO = 5    # a=slot, b=dst host, c=(port<<32)|aux, d=nbytes
+OP_CLOSE = 6         # a=slot
+OP_TIMER = 7         # a=deadline ns (absolute), b=tag
+
+
+def hosted_wake(row, hp, sh, now, pkt):
+    """EV_APP handler for hosted hosts: record the wake for the CPU
+    tier instead of running an on-device state machine."""
+    cnt = row.hw_cnt
+    cap = row.hw_time.shape[0]
+    ok = cnt < cap
+    at = jnp.clip(cnt, 0, cap - 1)
+    return row.replace(
+        hw_time=row.hw_time.at[at].set(jnp.where(ok, now, row.hw_time[at])),
+        hw_pkt=row.hw_pkt.at[at].set(jnp.where(ok, pkt, row.hw_pkt[at])),
+        hw_cnt=cnt + jnp.where(ok, 1, 0),
+        hw_drop=row.hw_drop + jnp.where(ok, 0, 1),
+    )
+
+
+def _apply_one(hosts, hp, sh, op, results):
+    """Apply one op word to the addressed host row at the op's own
+    timestamp. Returns (hosts, result). Operands < -1 are same-batch
+    result references (-(k+2) = result of op k), letting an app use a
+    socket in the same callback that opened it."""
+    h = jnp.clip(op[0].astype(_I32), 0, hp.hid.shape[0] - 1)
+    code = op[1].astype(_I32)
+    now = op[6]
+    row = jax.tree.map(lambda a: a[h], hosts)
+    hrow = jax.tree.map(lambda a: a[h], hp)
+
+    K = results.shape[0]
+
+    def deref(x):
+        """Resolve a possibly-referencing operand to a concrete value."""
+        j = jnp.clip(-x - 2, 0, K - 1).astype(_I32)
+        return jnp.where(x >= -1, x, results[j].astype(jnp.int64))
+
+    op = jnp.stack([op[0], op[1], deref(op[2]), deref(op[3]),
+                    deref(op[4]), deref(op[5]), op[6]])
+
+    def op_nop(r):
+        return r, _I32(-1)
+
+    def op_udp_open(r):
+        r, slot, ok = _udp_open_bridge(r, op[2].astype(_I32))
+        return r, jnp.where(ok, slot, -1).astype(_I32)
+
+    def op_listen(r):
+        r, slot, ok = tcp_listen(r, op[2].astype(_I32))
+        return r, jnp.where(ok, slot, -1).astype(_I32)
+
+    def op_connect(r):
+        r, slot, ok = tcp_connect(r, hrow, sh, now,
+                                  dst_host=op[2].astype(_I32),
+                                  dst_port=op[3].astype(_I32),
+                                  tag=op[4].astype(_I32))
+        return r, jnp.where(ok, slot, -1).astype(_I32)
+
+    def op_write(r):
+        r = tcp_write(r, now, op[2].astype(_I32), op[3])
+        return r, _I32(0)
+
+    def op_sendto(r):
+        r = udp_sendto(r, hrow, now, op[2].astype(_I32),
+                       dst_host=op[3].astype(_I32),
+                       dst_port=(op[4] >> 32).astype(_I32),
+                       nbytes=op[5],
+                       aux=(op[4] & 0xFFFFFFFF).astype(_I32))
+        return r, _I32(0)
+
+    def op_close(r):
+        r = tcp_close_call(r, now, op[2].astype(_I32))
+        return r, _I32(0)
+
+    def op_timer(r):
+        wake = (jnp.zeros((P.PKT_WORDS,), _I32)
+                .at[P.ACK].set(_I32(WAKE_TIMER))
+                .at[P.SEQ].set(_I32(-1))
+                .at[P.AUX].set(op[3].astype(_I32)))
+        r = equeue.q_push(r, op[2], EV_APP, wake)
+        return r, _I32(0)
+
+    row, result = jax.lax.switch(
+        jnp.clip(code, 0, 7),
+        [op_nop, op_udp_open, op_listen, op_connect, op_write, op_sendto,
+         op_close, op_timer], row)
+    hosts = jax.tree.map(lambda a, v: a.at[h].set(v), hosts, row)
+    return hosts, result
+
+
+def _udp_open_bridge(row, port):
+    """udp_open with a traced port scalar (0 = pick ephemeral) — the
+    net.udp version branches on a Python-level `port=None` instead."""
+    from ..net.socket import sock_alloc, alloc_eport
+    row, slot, ok = sock_alloc(row, P.PROTO_UDP)
+    row, ep = alloc_eport(row)
+    p = jnp.where(port > 0, port, ep)
+    row = row.replace(sk_lport=row.sk_lport.at[slot].set(
+        jnp.where(ok, p, row.sk_lport[slot])))
+    return row, slot, ok
+
+
+def apply_ops(hosts, hp, sh, ops):
+    """Apply a padded [K, OP_WORDS] int64 op batch sequentially (ops on
+    the same host must compose), then clear the wake rings. Returns
+    (hosts, results[K] int32)."""
+
+    def body(i, carry):
+        hosts, results = carry
+        hosts, res = _apply_one(hosts, hp, sh, ops[i], results)
+        return hosts, results.at[i].set(res)
+
+    K = ops.shape[0]
+    results = jnp.full((K,), -1, _I32)
+    hosts, results = jax.lax.fori_loop(0, K, body, (hosts, results))
+    hosts = hosts.replace(hw_cnt=jnp.zeros_like(hosts.hw_cnt))
+    return hosts, results
+
+
+from ..core.jitcache import AotJit  # noqa: E402  (see jitcache docstring)
+
+apply_ops_jit = AotJit(apply_ops, donate_argnums=(0,))

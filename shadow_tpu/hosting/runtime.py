@@ -1,0 +1,130 @@
+"""CPU half of the hosting bridge: drain wakes, run app code, batch ops.
+
+Drives hosted apps between lookahead windows. The dispatch order is
+deterministic: wake records sort by (time, host, ring index) before
+delivery, and per-host RNG streams are seeded from the scenario seed —
+the same guarantees the reference's deterministic scheduler provides to
+plugins (SURVEY §4 determinism tests).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..engine.defs import (WAKE_START, WAKE_TIMER, WAKE_SOCKET,
+                           WAKE_CONNECTED, WAKE_EOF, WAKE_ACCEPT, WAKE_SENT)
+from ..net import packet as P
+from .api import HostOS
+from .bridge import OP_WORDS, apply_ops_jit
+
+
+class HostingRuntime:
+    """Owns the hosted app instances and the window-boundary exchange."""
+
+    def __init__(self, apps: dict, names: dict, dns, seed: int,
+                 batch_cap: int = 256):
+        # apps: host_id -> HostedApp; names: host_id -> hostname
+        self.apps = apps
+        self.batch_cap = batch_cap
+        self._now = 0
+        self.os = {
+            hid: HostOS(hid, names.get(hid, f"host{hid}"),
+                        np.random.default_rng((seed, hid)), dns,
+                        lambda: self._now)
+            for hid in apps
+        }
+
+    def has_hosts(self) -> bool:
+        return bool(self.apps)
+
+    def step(self, hosts, hp, sh, now_ns: int):
+        """Drain wake rings, dispatch app callbacks, apply the op batch.
+        Returns updated hosts."""
+        hw_cnt = np.asarray(hosts.hw_cnt)
+        if not hw_cnt.any():
+            return hosts
+        hw_time = np.asarray(hosts.hw_time)
+        hw_pkt = np.asarray(hosts.hw_pkt)
+
+        # deterministic delivery order: (time, host, ring index)
+        recs = []
+        for hid in np.flatnonzero(hw_cnt):
+            for i in range(int(hw_cnt[hid])):
+                recs.append((int(hw_time[hid, i]), int(hid), i))
+        recs.sort()
+
+        for t, hid, i in recs:
+            app = self.apps.get(hid)
+            if app is None:
+                continue
+            os = self.os[hid]
+            self._now = t
+            wake = hw_pkt[hid, i]
+            reason = int(wake[P.ACK])
+            slot = int(wake[P.SEQ])
+            sock = os.sock_for(slot) if slot >= 0 else None
+            if reason == WAKE_START:
+                app.on_start(os)
+            elif reason == WAKE_TIMER:
+                app.on_timer(os, int(wake[P.AUX]))
+            elif reason == WAKE_CONNECTED:
+                app.on_connected(os, sock)
+            elif reason == WAKE_ACCEPT:
+                app.on_accept(os, sock, int(wake[P.APP]))
+            elif reason == WAKE_EOF:
+                app.on_eof(os, sock)
+            elif reason == WAKE_SENT:
+                app.on_sent(os, sock)
+            elif reason == WAKE_SOCKET:
+                app.on_dgram(os, sock, int(wake[P.SRC]), int(wake[P.SPORT]),
+                             int(wake[P.LEN]), int(wake[P.AUX]))
+
+        self._now = now_ns
+        return self._flush(hosts, hp, sh, now_ns)
+
+    def _flush(self, hosts, hp, sh, now_ns: int):
+        """Apply all pending ops as one batch and bind returned socket
+        slots to their Sock handles. Operands that are still-unresolved
+        Socks from this batch are encoded as result references
+        (-(k+2) for op k), decoded on device — create-before-use holds
+        because each host's ops keep insertion order."""
+        import jax.numpy as jnp
+        from .api import Sock
+
+        pending = []  # (hid, os, op) in deterministic host order
+        for hid in sorted(self.os):
+            os = self.os[hid]
+            for op in os._ops:
+                pending.append((hid, os, op))
+            os._ops = []
+
+        if not pending:
+            # nothing to apply: just clear the drained wake rings
+            return hosts.replace(hw_cnt=jnp.zeros_like(hosts.hw_cnt))
+
+        # one batch, padded up to a multiple of 64 (a handful of
+        # distinct batch shapes keeps recompiles rare)
+        K = -(-len(pending) // 64) * 64
+        ops = np.zeros((K, OP_WORDS), dtype=np.int64)
+        ref_of = {}  # Sock object -> creating op index
+        for k, (hid, os, op) in enumerate(pending):
+            if op.out is not None:
+                ref_of[id(op.out)] = k
+
+            def enc(x):
+                if isinstance(x, Sock):
+                    j = ref_of.get(id(x))
+                    if j is None:
+                        raise RuntimeError(
+                            "Sock used before any op created it")
+                    return -(j + 2)
+                return int(x)
+
+            ops[k] = (hid, op.code, enc(op.a), enc(op.b), enc(op.c),
+                      enc(op.d), op.t)
+        hosts, results = apply_ops_jit(hosts, hp, sh, jnp.asarray(ops))
+        res = np.asarray(results)
+        for k, (hid, os, op) in enumerate(pending):
+            if op.out is not None:
+                os._bind(op.out, int(res[k]))
+        return hosts

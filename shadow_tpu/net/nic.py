@@ -87,9 +87,12 @@ def kick(row, now):
     need = has_work(row) & ~row.nic_sched
 
     def sched(r):
+        ok = equeue.q_has_free(r)
         t = jnp.maximum(now, r.nic_busy)
         r = equeue.q_push(r, t, EV_NIC_TX, jnp.zeros((P.PKT_WORDS,), jnp.int32))
-        return r.replace(nic_sched=jnp.bool_(True))
+        # only mark scheduled if the push landed — a full queue must
+        # leave the NIC kickable or it freezes forever (lost wakeup)
+        return r.replace(nic_sched=ok)
 
     return jax.lax.cond(need, sched, lambda r: r, row)
 
@@ -108,9 +111,10 @@ def on_tx(row, hp, sh, now, wend, pkt):
     no_room = row.ob_cnt >= row.ob_time.shape[0]
 
     def defer(r):
+        ok = equeue.q_has_free(r)
         r = equeue.q_push(r, jnp.maximum(wend, now + 1), EV_NIC_TX,
                           jnp.zeros((P.PKT_WORDS,), jnp.int32))
-        return r.replace(nic_sched=jnp.bool_(True))
+        return r.replace(nic_sched=ok)
 
     return jax.lax.cond(no_room, defer,
                         lambda r: _tx_pull(r, hp, sh, now), row)
@@ -159,9 +163,10 @@ def _tx_pull(row, hp, sh, now):
     more = has_work(row) & has_pkt
 
     def resched(r):
+        ok = equeue.q_has_free(r)
         r = equeue.q_push(r, busy_end, EV_NIC_TX,
                           jnp.zeros((P.PKT_WORDS,), jnp.int32))
-        return r.replace(nic_sched=jnp.bool_(True))
+        return r.replace(nic_sched=ok)
 
     return jax.lax.cond(more, resched, lambda r: r, row)
 

@@ -58,6 +58,10 @@ def sock_alloc(row, proto):
         sk_snd_max=setf(row.sk_snd_max, 0, jnp.int64),
         sk_snd_end=setf(row.sk_snd_end, 0, jnp.int64),
         sk_rcv_nxt=setf(row.sk_rcv_nxt, 0, jnp.int64),
+        sk_ooo_start=setf(row.sk_ooo_start, -1, jnp.int64),
+        sk_ooo_end=setf(row.sk_ooo_end, -1, jnp.int64),
+        sk_hole_end=setf(row.sk_hole_end, 0, jnp.int64),
+        sk_rex_nxt=setf(row.sk_rex_nxt, 0, jnp.int64),
         sk_peer_fin=setf(row.sk_peer_fin, -1, jnp.int64),
         sk_fin_acked=setf(row.sk_fin_acked, False, jnp.bool_),
         sk_close_after=setf(row.sk_close_after, False, jnp.bool_),

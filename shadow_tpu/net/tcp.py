@@ -88,11 +88,13 @@ def _arm_timer(row, slot, now):
     need_event = ~row.sk_timer_on[slot]
 
     def push(r):
+        ok = equeue.q_has_free(r)
         ev = (jnp.zeros((P.PKT_WORDS,), _I32)
               .at[P.SEQ].set(_I32(slot))
               .at[P.ACK].set(r.sk_timer_gen[slot]))
         r = equeue.q_push(r, deadline, EV_TCP_TIMER, ev)
-        return _set(r, slot, sk_timer_on=jnp.bool_(True))
+        # only mark armed if the push landed (full queue = lost wakeup)
+        return _set(r, slot, sk_timer_on=ok)
 
     row = _set(row, slot, sk_rto_deadline=deadline)
     return jax.lax.cond(need_event, push, lambda r: r, row)
@@ -179,24 +181,48 @@ def _fin_wait_states(state):
             (state == TCPS_LAST_ACK))
 
 
+def _data_tx_states(state):
+    """States in which (re)transmission of stream data is permitted:
+    the open states plus the FIN-sent states — after an RTO rewinds
+    snd_nxt, data below the FIN must still be deliverable or the
+    connection deadlocks in FIN_WAIT_1."""
+    return ((state == TCPS_ESTABLISHED) | (state == TCPS_CLOSE_WAIT) |
+            (state == TCPS_FIN_WAIT_1) | (state == TCPS_CLOSING) |
+            (state == TCPS_LAST_ACK))
+
+
 def tcp_want_tx(row):
-    """[S] bool: sockets owing the wire a data segment or a first FIN.
-    (Control-flag work is covered by sk_ctl != 0 in nic.tx_want.)"""
+    """[S] bool: sockets owing the wire a data segment, a pending
+    fast-retransmission, or a first FIN. (Control-flag work is covered
+    by sk_ctl != 0 in nic.tx_want.)"""
     open_tx = ((row.sk_state == TCPS_ESTABLISHED) |
                (row.sk_state == TCPS_CLOSE_WAIT))
+    data_tx = _data_tx_states(row.sk_state)
     cw = row.sk_cwnd.astype(_I64) * TCP_MSS
     win = jnp.minimum(cw, jnp.maximum(row.sk_peer_rwnd, 1))
-    data_ok = (open_tx & (row.sk_snd_nxt < row.sk_snd_end) &
+    rex_ok = data_tx & (row.sk_hole_end > 0) & (row.sk_rex_nxt <
+                                                row.sk_hole_end)
+    data_ok = (data_tx & (row.sk_snd_nxt < row.sk_snd_end) &
                (row.sk_snd_nxt < row.sk_snd_una + win))
     fin_due = (open_tx & row.sk_close_after &
                (row.sk_snd_nxt == row.sk_snd_end))
-    return (row.sk_proto == P.PROTO_TCP) & (data_ok | fin_due)
+    return (row.sk_proto == P.PROTO_TCP) & (rex_ok | data_ok | fin_due)
 
 
 def _finack_aux(row, slot):
     pf = row.sk_peer_fin[slot]
     got_fin = (pf >= 0) & (row.sk_rcv_nxt[slot] >= pf)
-    return jnp.where(got_fin, AUX_FINACK, 0).astype(_I32)
+    aux = jnp.where(got_fin, AUX_FINACK, 0).astype(_I32)
+    # SACK block (single-hole scoreboard): bits 1-15 = hole size in MSS
+    # units (gap between rcv_nxt and the out-of-order range), bits
+    # 16-30 = sacked length in MSS units. Zero length = no block.
+    ooo_s = row.sk_ooo_start[slot]
+    ooo_e = row.sk_ooo_end[slot]
+    has = ooo_s >= 0
+    rel = jnp.clip((ooo_s - row.sk_rcv_nxt[slot]) // TCP_MSS, 0, 0x7FFF)
+    lnm = jnp.clip((ooo_e - ooo_s + TCP_MSS - 1) // TCP_MSS, 1, 0x7FFF)
+    sack = ((rel.astype(_I32) << 1) | (lnm.astype(_I32) << 16))
+    return aux | jnp.where(has, sack, 0)
 
 
 def tcp_pull(row, hp, sh, now, slot):
@@ -210,7 +236,15 @@ def tcp_pull(row, hp, sh, now, slot):
     snd_nxt = row.sk_snd_nxt[slot]
     snd_end = row.sk_snd_end[slot]
     limit = row.sk_snd_una[slot] + _win_bytes(row, slot)
-    can_data = open_tx & (snd_nxt < snd_end) & (snd_nxt < limit)
+    # fast retransmission runs on its own cursor (the reference's
+    # scoreboard next-retransmit selection, shd-tcp-scoreboard.c:271):
+    # snd_nxt is NOT rewound, so recovery resends only the hole
+    data_tx = _data_tx_states(state)
+    hole_end = row.sk_hole_end[slot]
+    rex_nxt = row.sk_rex_nxt[slot]
+    rex_pending = data_tx & (hole_end > 0) & (rex_nxt < hole_end)
+    can_new = data_tx & (snd_nxt < snd_end) & (snd_nxt < limit)
+    can_data = rex_pending | can_new
 
     fin_first = (open_tx & row.sk_close_after[slot] & (snd_nxt == snd_end))
     fin_rexmit = ((ctl & CTL_FIN) != 0) & _fin_wait_states(state)
@@ -236,10 +270,14 @@ def tcp_pull(row, hp, sh, now, slot):
     aux = _finack_aux(row, slot)
 
     ln = jnp.where(sel == 3,
-                   jnp.minimum(_I64(TCP_MSS),
-                               jnp.minimum(snd_end, limit) - snd_nxt),
+                   jnp.where(rex_pending,
+                             jnp.minimum(_I64(TCP_MSS),
+                                         hole_end - rex_nxt),
+                             jnp.minimum(_I64(TCP_MSS),
+                                         jnp.minimum(snd_end, limit) -
+                                         snd_nxt)),
                    _I64(0)).astype(_I32)
-    seq = jnp.where(sel == 3, snd_nxt,
+    seq = jnp.where(sel == 3, jnp.where(rex_pending, rex_nxt, snd_nxt),
           jnp.where(sel == 4, snd_end, _I64(0))).astype(_I32)
     flags = base_flags
     flags = flags | jnp.where((sel == 1) | (sel == 2), P.F_SYN, 0)
@@ -263,20 +301,24 @@ def tcp_pull(row, hp, sh, now, slot):
     clr = clr | jnp.where(acked_too, CTL_ACKNOW, 0)
     row = _set(row, slot, sk_ctl=ctl & ~clr)
 
-    # data accounting: first-transmission vs retransmission, RTT timing
+    # data accounting: fresh transmission vs retransmission, RTT timing
     is_data = sel == 3
+    is_rex = is_data & rex_pending
     snd_max = row.sk_snd_max[slot]
     new_nxt = snd_nxt + ln.astype(_I64)
-    advance = is_data & (new_nxt > snd_max)
-    rexmit = is_data & (snd_nxt < snd_max)
+    advance = is_data & ~is_rex & (new_nxt > snd_max)
+    # go-back-N after RTO also resends through snd_nxt < snd_max
+    gbn = is_data & ~is_rex & (snd_nxt < snd_max)
     fresh_bytes = jnp.where(advance, new_nxt - jnp.maximum(snd_max, snd_nxt),
                             0)
     row = row.replace(stats=row.stats
                       .at[ST_BYTES_SENT].add(fresh_bytes)
-                      .at[ST_RETRANSMIT].add(jnp.where(rexmit, 1, 0)))
-    time_it = is_data & (row.sk_rtt_seq[slot] < 0) & ~rexmit
+                      .at[ST_RETRANSMIT].add(jnp.where(is_rex | gbn, 1, 0)))
+    time_it = is_data & ~is_rex & ~gbn & (row.sk_rtt_seq[slot] < 0)
     row = _set(row, slot,
-               sk_snd_nxt=jnp.where(is_data, new_nxt, snd_nxt),
+               sk_snd_nxt=jnp.where(is_data & ~is_rex, new_nxt, snd_nxt),
+               sk_rex_nxt=jnp.where(is_rex, rex_nxt + ln.astype(_I64),
+                                    rex_nxt),
                sk_snd_max=jnp.where(advance, new_nxt, snd_max),
                sk_rtt_seq=jnp.where(time_it, new_nxt,
                                     row.sk_rtt_seq[slot]),
@@ -353,6 +395,9 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
     ackno = pkt[P.ACK].astype(_I64)
     ln = pkt[P.LEN].astype(_I64)
     finack = (pkt[P.AUX] & AUX_FINACK) != 0
+    # SACK block from the peer (see _finack_aux encoding)
+    sack_rel = ((pkt[P.AUX] >> 1) & 0x7FFF).astype(_I64)
+    sack_len = ((pkt[P.AUX] >> 16) & 0x7FFF).astype(_I64)
 
     state0 = row.sk_state[slot]
 
@@ -430,9 +475,25 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
         sk_cc_epoch=jnp.where(fast_rx, ep_l,
                               jnp.where(new_ack, ep_a, ep0)),
         sk_cc_k=jnp.where(new_ack & ~fast_rx, k_a, k0),
-        # go-back-N retransmit entry (reference enters recovery and
-        # retransmits from the last cumulative ack, shd-tcp.c:1044-1066)
-        sk_snd_nxt=jnp.where(fast_rx, snd_una1, row.sk_snd_nxt[slot]),
+        # Recovery: retransmit exactly the hole the peer's SACK block
+        # reports, on a separate cursor — snd_nxt is NOT rewound (the
+        # reference's scoreboard-driven recovery, shd-tcp.c:1044-1066 +
+        # shd-tcp-scoreboard.c). The episode ends when the cumulative
+        # ack covers the hole; a partial ack advances the cursor.
+        sk_hole_end=jnp.where(
+            fast_rx,
+            jnp.where(sack_len > 0,
+                      jnp.minimum(ackno + sack_rel * TCP_MSS,
+                                  row.sk_snd_max[slot]),
+                      jnp.minimum(ackno + TCP_MSS,
+                                  row.sk_snd_max[slot])),
+            jnp.where(new_ack & (ackno >= row.sk_hole_end[slot]),
+                      _I64(0), row.sk_hole_end[slot])),
+        sk_rex_nxt=jnp.where(fast_rx, ackno,
+                             jnp.where(new_ack,
+                                       jnp.maximum(row.sk_rex_nxt[slot],
+                                                   ackno),
+                                       row.sk_rex_nxt[slot])),
     )
 
     # our FIN acked?
@@ -460,28 +521,63 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
                        lambda r: r, row)
 
     # --- C. data ---
+    # Out-of-order segments are held as ONE [ooo_start, ooo_end) range
+    # (single-hole scoreboard; a second simultaneous hole falls back to
+    # retransmission). In-order arrival that reaches the range's start
+    # delivers the whole buffered run at once.
     can_rx = ((state2 == TCPS_ESTABLISHED) | (state2 == TCPS_FIN_WAIT_1) |
               (state2 == TCPS_FIN_WAIT_2))
     has_data = (ln > 0) & can_rx
     rcv0 = row.sk_rcv_nxt[slot]
-    in_order = has_data & (seq == rcv0)
-    rcv1 = jnp.where(in_order, rcv0 + ln, rcv0)
+    ooo_s0 = row.sk_ooo_start[slot]
+    ooo_e0 = row.sk_ooo_end[slot]
+    seg_end = seq + ln
+
+    in_order = has_data & (seq <= rcv0) & (seg_end > rcv0)
+    adv = jnp.where(in_order, seg_end, rcv0)
+    fill = in_order & (ooo_s0 >= 0) & (adv >= ooo_s0)
+    rcv1 = jnp.where(fill, jnp.maximum(adv, ooo_e0), adv)
+    ooo_s1 = jnp.where(fill, _I64(-1), ooo_s0)
+    ooo_e1 = jnp.where(fill, _I64(-1), ooo_e0)
+
+    is_ooo = has_data & (seq > rcv1)
+    joins = (ooo_s1 >= 0) & (seq <= ooo_e1) & (seg_end >= ooo_s1)
+    ooo_s2 = jnp.where(is_ooo,
+                       jnp.where(ooo_s1 < 0, seq,
+                                 jnp.where(joins,
+                                           jnp.minimum(ooo_s1, seq),
+                                           ooo_s1)),
+                       ooo_s1)
+    ooo_e2 = jnp.where(is_ooo,
+                       jnp.where(ooo_e1 < 0, seg_end,
+                                 jnp.where(joins,
+                                           jnp.maximum(ooo_e1, seg_end),
+                                           ooo_e1)),
+                       ooo_e1)
+
+    delivered = rcv1 - rcv0
     row = _set(row, slot,
                sk_rcv_nxt=rcv1,
+               sk_ooo_start=ooo_s2,
+               sk_ooo_end=ooo_e2,
                sk_ctl=row.sk_ctl[slot] |
                jnp.where((ln > 0) | fin, CTL_ACKNOW, 0))
-    row = row.replace(stats=row.stats.at[ST_BYTES_RECV].add(
-        jnp.where(in_order, ln, 0)))
+    row = row.replace(stats=row.stats.at[ST_BYTES_RECV].add(delivered))
     row = jax.lax.cond(
-        in_order,
+        delivered > 0,
         lambda r: _wake(r, now, WAKE_SOCKET, slot, pkt=pkt,
-                        ln=ln.astype(_I32), aux=pkt[P.AUX]),
+                        ln=delivered.astype(_I32), aux=pkt[P.AUX]),
         lambda r: r, row)
 
     # --- D. peer FIN ---
+    # The FIN may arrive while a data hole is still open; record its
+    # offset once and re-evaluate completion on EVERY segment, so the
+    # retransmission that fills the hole also delivers the EOF (state
+    # transitions make the wake fire exactly once).
     fin_valid = fin & (state2 >= TCPS_ESTABLISHED)
-    peer_fin1 = jnp.where(fin_valid, seq, row.sk_peer_fin[slot])
-    fin_complete = fin_valid & (rcv1 >= peer_fin1)
+    peer_fin1 = jnp.where(fin_valid & (row.sk_peer_fin[slot] < 0), seq,
+                          row.sk_peer_fin[slot])
+    fin_complete = (peer_fin1 >= 0) & (rcv1 >= peer_fin1)
     eof_now = fin_complete & ((state2 == TCPS_ESTABLISHED) |
                               (state2 == TCPS_FIN_WAIT_1) |
                               (state2 == TCPS_FIN_WAIT_2))
@@ -588,6 +684,7 @@ def on_tcp_timer(row, hp, sh, now, wend, ev):
                                      rr.sk_cc_wmax[slot]),
                 sk_cc_epoch=jnp.where(had_flight, ep_l,
                                       rr.sk_cc_epoch[slot]),
+                sk_hole_end=_I64(0),  # RTO: full go-back-N, no skip
                 sk_rtt_seq=_I64(-1),  # Karn
                 sk_timer_on=jnp.bool_(False),
             )

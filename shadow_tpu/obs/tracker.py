@@ -1,0 +1,81 @@
+"""Heartbeat tracker: periodic per-host and global metrics.
+
+The reference's Tracker emits `[shadow-heartbeat] [node|socket|ram]`
+CSV-ish lines per host on a configurable interval
+(/root/reference/src/main/host/shd-tracker.c:405-592) plus a slave-level
+getrusage heartbeat (shd-slave.c:374-395). The TPU engine already keeps
+every metric as device-side counters (Hosts.stats); the tracker drains
+them at window-chunk boundaries, computes interval deltas, and emits the
+same style of lines — no device-side cost beyond the stats the engine
+maintains anyway.
+"""
+
+from __future__ import annotations
+
+import resource
+
+import numpy as np
+
+from ..engine import defs
+
+
+HEADER = ("time,host,events,pkts-sent,pkts-recv,bytes-sent,bytes-recv,"
+          "retransmits,drop-net,drop-buf,transfers-done")
+
+
+class Tracker:
+    def __init__(self, interval_ns: int, host_names, logger=None,
+                 per_host: bool = True):
+        self.interval = int(interval_ns)
+        self.names = list(host_names)
+        self.logger = logger
+        self.per_host = per_host
+        self.next_ns = self.interval
+        self._prev = None
+        self.lines = []          # retained for tools/tests
+
+    def _emit(self, line: str):
+        self.lines.append(line)
+        if self.logger is not None:
+            self.logger.message(self.next_ns, "tracker", line)
+
+    def maybe_heartbeat(self, sim_ns: int, stats: np.ndarray):
+        """Called after each window chunk with current cumulative stats;
+        emits one heartbeat per elapsed interval boundary."""
+        if self.interval <= 0:
+            return
+        while sim_ns >= self.next_ns:
+            cur = stats.astype(np.int64)
+            prev = (self._prev if self._prev is not None
+                    else np.zeros_like(cur))
+            d = cur - prev
+            self._prev = cur.copy()
+            t = self.next_ns // 10**9
+
+            if self.per_host:
+                for i, name in enumerate(self.names):
+                    if d[i, defs.ST_EVENTS] == 0:
+                        continue
+                    self._emit(
+                        f"[shadow-heartbeat] [node] {t},{name},"
+                        f"{d[i, defs.ST_EVENTS]},"
+                        f"{d[i, defs.ST_PKTS_SENT]},"
+                        f"{d[i, defs.ST_PKTS_RECV]},"
+                        f"{d[i, defs.ST_BYTES_SENT]},"
+                        f"{d[i, defs.ST_BYTES_RECV]},"
+                        f"{d[i, defs.ST_RETRANSMIT]},"
+                        f"{d[i, defs.ST_PKTS_DROP_NET]},"
+                        f"{d[i, defs.ST_PKTS_DROP_BUF]},"
+                        f"{d[i, defs.ST_XFER_DONE]}")
+
+            ru = resource.getrusage(resource.RUSAGE_SELF)
+            tot = d.sum(axis=0)
+            self._emit(
+                f"[shadow-heartbeat] [summary] {t},"
+                f"events={tot[defs.ST_EVENTS]},"
+                f"pkts={tot[defs.ST_PKTS_SENT]}/{tot[defs.ST_PKTS_RECV]},"
+                f"bytes={tot[defs.ST_BYTES_SENT]}/{tot[defs.ST_BYTES_RECV]},"
+                f"maxrss-gib={ru.ru_maxrss / (1 << 20):.3f},"
+                f"utime-min={ru.ru_utime / 60:.3f},"
+                f"stime-min={ru.ru_stime / 60:.3f}")
+            self.next_ns += self.interval

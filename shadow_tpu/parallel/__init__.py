@@ -1,1 +1,5 @@
-"""parallel subpackage."""
+"""Multi-chip parallel execution (shard_map window loop)."""
+
+from .shard import make_mesh, run_windows_sharded, device_put_sharded
+
+__all__ = ["make_mesh", "run_windows_sharded", "device_put_sharded"]

@@ -1,0 +1,94 @@
+"""Native (C++) routing oracle: build-on-first-use + ctypes binding.
+
+The native path replaces the reference's igraph dependency (SURVEY
+§2.8). It is used automatically for large graphs and can be forced or
+disabled with SHADOW_TPU_NATIVE_ORACLE=1/0.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "oracle.cpp")
+_SO = os.path.join(_DIR, "liboracle.so")
+
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+               "-o", _SO, _SRC]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception as e:  # missing toolchain: fall back to scipy path
+        sys.stderr.write(f"shadow_tpu: native oracle build failed ({e}); "
+                         "using scipy fallback\n")
+        return False
+
+
+def load():
+    """Return the loaded library or None (scipy fallback)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("SHADOW_TPU_NATIVE_ORACLE") == "0":
+        return None
+    if (not os.path.exists(_SO)
+            or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+        if not _build():
+            return None
+    lib = ctypes.CDLL(_SO)
+    lib.shadow_apsp.restype = ctypes.c_int
+    lib.shadow_apsp.argtypes = [
+        ctypes.c_int, ctypes.c_int,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+    ]
+    lib.shadow_count_unreachable.restype = ctypes.c_int64
+    lib.shadow_count_unreachable.argtypes = [
+        ctypes.c_int,
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+    ]
+    _lib = lib
+    return _lib
+
+
+def apsp(V: int, src: np.ndarray, dst: np.ndarray, lat: np.ndarray,
+         loss: np.ndarray, vloss: np.ndarray):
+    """All-pairs (lat_ms[V,V], rel[V,V], unreachable[V,V]) via the
+    native oracle. Caller guarantees deduped directed edges."""
+    lib = load()
+    assert lib is not None
+    E = len(src)
+    out_lat = np.zeros((V, V), dtype=np.float64)
+    out_rel = np.zeros((V, V), dtype=np.float64)
+    rc = lib.shadow_apsp(
+        V, E,
+        np.ascontiguousarray(src, np.int32),
+        np.ascontiguousarray(dst, np.int32),
+        np.ascontiguousarray(lat, np.float64),
+        np.ascontiguousarray(loss, np.float64),
+        np.ascontiguousarray(vloss, np.float64),
+        out_lat, out_rel)
+    if rc != 0:
+        raise RuntimeError(f"shadow_apsp failed rc={rc}")
+    unreachable = (out_rel <= 0.0) & (out_lat <= 0.0)
+    return out_lat, out_rel, unreachable
+
+
+def available() -> bool:
+    return load() is not None

@@ -1,0 +1,146 @@
+// Native all-pairs routing oracle.
+//
+// Replaces the reference's igraph dependency (GraphML topology ->
+// igraph_get_shortest_paths_dijkstra per source,
+// /root/reference/src/main/routing/shd-topology.c:552-905) with a
+// self-contained C++ all-pairs pass producing the dense [V,V]
+// latency/reliability tables the device engine gathers from.
+//
+// Semantics mirror shadow_tpu.routing.topology.compute_all_pairs (the
+// scipy path), which itself mirrors the reference
+// (_topology_computeSourcePathsHelper, shd-topology.c:663-772):
+//  - path latency = sum of edge `latency` (ms) along the Dijkstra path;
+//  - reliability = (1 - src vloss) * prod(1 - edge loss) * (1 - dst
+//    vloss, distinct vertices only), accumulated along the same tree;
+//  - same-vertex pairs use the self-loop edge if present else 1 ms;
+//  - unreachable pairs report latency 0 / reliability 0;
+//  - reachable zero latency clamps up to 1 ms.
+//
+// Inputs are the deduplicated directed adjacency (min-latency parallel
+// edge already chosen, self-loops included).
+//
+// Build: routing/native/build.py (g++ -O3 -shared); bound via ctypes.
+
+#include <cstddef>
+#include <cstdint>
+#include <queue>
+#include <vector>
+
+using std::size_t;
+
+namespace {
+
+struct HeapItem {
+  double dist;
+  int vertex;
+  bool operator>(const HeapItem& o) const {
+    if (dist != o.dist) return dist > o.dist;
+    return vertex > o.vertex;  // deterministic tie order: lower id first
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Single-source Dijkstra with reliability accumulated along the tree.
+// Returns 0 on success.
+int shadow_sssp(int V, const int32_t* off, const int32_t* nbr,
+                const double* wlat, const double* wloss,
+                const double* vloss, int s, double* dist, double* rel) {
+  std::vector<char> done(V, 0);
+  for (int v = 0; v < V; ++v) {
+    dist[v] = -1.0;  // -1 = unreached
+    rel[v] = 0.0;
+  }
+  dist[s] = 0.0;
+  rel[s] = 1.0 - vloss[s];
+  std::priority_queue<HeapItem, std::vector<HeapItem>,
+                      std::greater<HeapItem>> pq;
+  pq.push({0.0, s});
+  while (!pq.empty()) {
+    HeapItem it = pq.top();
+    pq.pop();
+    int u = it.vertex;
+    if (done[u]) continue;
+    done[u] = 1;
+    for (int32_t k = off[u]; k < off[u + 1]; ++k) {
+      int v = nbr[k];
+      if (v == u) continue;  // self-loops handled by the caller
+      double nd = it.dist + wlat[k];
+      if (dist[v] < 0.0 || nd < dist[v]) {
+        dist[v] = nd;
+        rel[v] = rel[u] * (1.0 - wloss[k]);
+        pq.push({nd, v});
+      }
+    }
+  }
+  return 0;
+}
+
+// Dense all-pairs tables with the reference's path semantics.
+// esrc/edst/elat/eloss: deduped directed edges (self-loops included).
+// out_lat/out_rel: row-major [V, V].
+int shadow_apsp(int V, int E, const int32_t* esrc, const int32_t* edst,
+                const double* elat, const double* eloss,
+                const double* vloss, double* out_lat, double* out_rel) {
+  // CSR
+  std::vector<int32_t> off(V + 1, 0), nbr(E);
+  std::vector<double> wlat(E), wloss(E);
+  for (int e = 0; e < E; ++e) off[esrc[e] + 1]++;
+  for (int v = 0; v < V; ++v) off[v + 1] += off[v];
+  {
+    std::vector<int32_t> cur(off.begin(), off.end() - 1);
+    for (int e = 0; e < E; ++e) {
+      int32_t at = cur[esrc[e]]++;
+      nbr[at] = edst[e];
+      wlat[at] = elat[e];
+      wloss[at] = eloss[e];
+    }
+  }
+  // self-loop lookup
+  std::vector<double> self_lat(V, -1.0), self_loss(V, 0.0);
+  for (int e = 0; e < E; ++e) {
+    if (esrc[e] == edst[e]) {
+      self_lat[esrc[e]] = elat[e];
+      self_loss[esrc[e]] = eloss[e];
+    }
+  }
+
+  std::vector<double> dist(V), rel(V);
+  for (int s = 0; s < V; ++s) {
+    shadow_sssp(V, off.data(), nbr.data(), wlat.data(), wloss.data(),
+                vloss, s, dist.data(), rel.data());
+    double* L = out_lat + (size_t)s * V;
+    double* R = out_rel + (size_t)s * V;
+    for (int v = 0; v < V; ++v) {
+      if (v == s) continue;
+      if (dist[v] < 0.0) {  // unreachable
+        L[v] = 0.0;
+        R[v] = 0.0;
+      } else {
+        L[v] = dist[v] > 0.0 ? dist[v] : 1.0;  // 1 ms clamp
+        R[v] = rel[v] * (1.0 - vloss[v]);      // dst vertex loss once
+      }
+    }
+    if (self_lat[s] >= 0.0) {
+      L[s] = self_lat[s] > 0.0 ? self_lat[s] : 1.0;
+      R[s] = (1.0 - vloss[s]) * (1.0 - self_loss[s]);
+    } else {
+      L[s] = 1.0;  // reference's empty-path fallback
+      R[s] = 1.0 - vloss[s];
+    }
+  }
+  return 0;
+}
+
+// Count unreachable ordered pairs (strong-connectivity validation,
+// reference shd-topology.c:232-474). out_lat as from shadow_apsp.
+int64_t shadow_count_unreachable(int V, const double* out_rel) {
+  int64_t n = 0;
+  for (size_t i = 0; i < (size_t)V * V; ++i)
+    if (out_rel[i] <= 0.0) ++n;
+  return n;
+}
+
+}  // extern "C"

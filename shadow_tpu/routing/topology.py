@@ -74,10 +74,35 @@ def _build_adjacency(g: Graph):
     return src[first], dst[first], lat[first], loss[first]
 
 
-def compute_all_pairs(g: Graph):
-    """All-pairs (latency_ms, reliability) with reference semantics."""
+def compute_all_pairs(g: Graph, native: bool = None):
+    """All-pairs (latency_ms, reliability) with reference semantics.
+
+    `native` selects the C++ oracle (routing.native, the igraph
+    replacement): None = auto (use it for larger graphs when it
+    builds), True = require, False = scipy/numpy path. Both paths
+    produce identical tables on graphs without equal-cost multipaths
+    (asserted by tests/test_native_oracle.py).
+    """
+    import os as _os
+
     V = g.num_vertices
     src, dst, lat, loss = _build_adjacency(g)
+
+    env = _os.environ.get("SHADOW_TPU_NATIVE_ORACLE")
+    if native is None:
+        if env == "1":
+            native = True
+        elif env == "0":
+            native = False
+        else:
+            native = V >= 256  # Python reliability loop is O(V^2)
+    if native:
+        from . import native as native_mod
+        if native_mod.available():
+            return native_mod.apsp(V, src, dst, lat, loss, g.v_packetloss)
+        if env == "1":
+            raise RuntimeError("SHADOW_TPU_NATIVE_ORACLE=1 but the "
+                               "native oracle failed to build")
     off = src != dst
     adj = csr_matrix((lat[off], (src[off], dst[off])), shape=(V, V))
 

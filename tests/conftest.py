@@ -7,11 +7,29 @@ without real chips.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the CPU mesh even when the ambient environment preselects a
+# real accelerator platform (e.g. JAX_PLATFORMS=axon): the test suite
+# validates sharding semantics on 8 virtual devices, not chip perf.
+# Set SHADOW_TPU_TEST_PLATFORM to override (e.g. to run on real TPU).
+_platform = os.environ.get("SHADOW_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The env var alone is not enough here: the ambient axon TPU plugin
+# overrides JAX_PLATFORMS during its entry-point initialization, so pin
+# the platform through the config API as well (wins over the plugin).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)
+
+# NOTE: the persistent compilation cache (jax_compilation_cache_dir) is
+# deliberately NOT enabled: this environment's XLA:CPU AOT loader
+# rejects/mismatches its own cache entries (machine-feature drift), and
+# stale entries have produced wrong-buffer-count executions. Dead-branch
+# pruning (EngineConfig.app_kinds/uses_tcp) keeps compiles fast instead.
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,63 @@
+"""Checkpoint/resume and CLI smoke tests."""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario
+from shadow_tpu.engine.sim import Simulation
+from shadow_tpu.engine.state import EngineConfig
+
+from test_phold import MESH_TOPO
+
+
+def scen(stop=6):
+    return Scenario(
+        stop_time=stop * 10**9,
+        topology_graphml=MESH_TOPO,
+        hosts=[HostSpec(id="node", quantity=8, processes=[
+            ProcessSpec(plugin="phold", start_time=10**9,
+                        arguments="port=9000 mean=300ms size=64 init=1")])],
+    )
+
+
+CFG = dict(qcap=16, scap=4, obcap=8, incap=16, chunk_windows=8)
+
+
+def test_checkpoint_resume_exact(tmp_path):
+    path = str(tmp_path / "ck.npz")
+
+    # uninterrupted run
+    full = Simulation(scen(), engine_cfg=EngineConfig(num_hosts=8, **CFG)).run()
+
+    # checkpoint mid-run (every simulated 2s), then resume the latest
+    first = Simulation(scen(), engine_cfg=EngineConfig(num_hosts=8, **CFG))
+    first.run(checkpoint_path=path, checkpoint_every_s=2)
+
+    resumed = Simulation(scen(), engine_cfg=EngineConfig(num_hosts=8, **CFG))
+    report = resumed.run(resume_from=path)
+    assert np.array_equal(report.stats, full.stats)
+
+
+def test_checkpoint_rejects_other_scenario(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    sim = Simulation(scen(), engine_cfg=EngineConfig(num_hosts=8, **CFG))
+    sim.run(checkpoint_path=path, checkpoint_every_s=2)
+
+    other = Simulation(scen(stop=9),
+                       engine_cfg=EngineConfig(num_hosts=8, **CFG))
+    with pytest.raises(ValueError, match="fingerprint"):
+        other.run(resume_from=path)
+
+
+def test_cli_test_scenario_smoke(capsys):
+    """`python -m shadow_tpu --test` at reduced scale."""
+    from shadow_tpu.__main__ import main
+
+    rc = main(["--test", "--test-clients", "4", "--stop-time", "12s",
+               "--heartbeat-frequency", "5", "--summary-json"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    import json
+    summary = json.loads(out.strip().splitlines()[-1])
+    assert summary["transfers_done"] > 0
+    assert "[shadow-heartbeat]" in out

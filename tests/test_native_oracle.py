@@ -1,0 +1,97 @@
+"""Differential test: C++ routing oracle vs the scipy/numpy path.
+
+The native oracle replaces the reference's igraph (SURVEY §2.8); both
+implementations must produce identical all-pairs tables (graphs are
+generated with irrational-ish random weights so no equal-cost
+multipaths exist to make predecessor choice ambiguous).
+"""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.routing import native
+from shadow_tpu.routing.graphml import Graph
+from shadow_tpu.routing.topology import compute_all_pairs, build_topology
+
+
+def random_graph(V, extra_edges, seed, with_loss=True, self_loops=True):
+    rng = np.random.RandomState(seed)
+    # random spanning tree (connected) + extra random edges
+    src, dst = [], []
+    for v in range(1, V):
+        src.append(rng.randint(v))
+        dst.append(v)
+    for _ in range(extra_edges):
+        a, b = rng.randint(V), rng.randint(V)
+        if a != b:
+            src.append(a)
+            dst.append(b)
+    if self_loops:
+        for v in range(0, V, 3):
+            src.append(v)
+            dst.append(v)
+    E = len(src)
+    return Graph(
+        vertex_ids=[f"v{i}" for i in range(V)],
+        directed=False,
+        v_ip=[""] * V,
+        v_geocode=[""] * V,
+        v_type=[""] * V,
+        v_packetloss=(rng.rand(V) * 0.05 if with_loss
+                      else np.zeros(V)),
+        v_bw_up=np.full(V, 1024.0),
+        v_bw_down=np.full(V, 1024.0),
+        e_src=np.array(src, dtype=np.int64),
+        e_dst=np.array(dst, dtype=np.int64),
+        e_latency_ms=rng.rand(E) * 100 + 0.5,
+        e_jitter_ms=np.zeros(E),
+        e_packetloss=(rng.rand(E) * 0.1 if with_loss
+                      else np.zeros(E)),
+    )
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason="native oracle unavailable (no g++?)")
+@pytest.mark.parametrize("V,extra,seed", [(8, 10, 0), (40, 120, 1),
+                                          (100, 50, 2)])
+def test_native_matches_python(V, extra, seed):
+    g = random_graph(V, extra, seed)
+    lat_py, rel_py, un_py = compute_all_pairs(g, native=False)
+    lat_cc, rel_cc, un_cc = compute_all_pairs(g, native=True)
+    np.testing.assert_allclose(lat_cc, lat_py, rtol=0, atol=1e-9)
+    np.testing.assert_allclose(rel_cc, rel_py, rtol=0, atol=1e-9)
+    assert (un_cc == un_py).all()
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason="native oracle unavailable (no g++?)")
+def test_native_disconnected_pairs():
+    # two components: cross-pairs unreachable in both implementations
+    g = random_graph(6, 0, 3, self_loops=False)
+    # sever: rebuild edges to make vertex 5 isolated
+    keep = (g.e_src != 5) & (g.e_dst != 5)
+    g.e_src, g.e_dst = g.e_src[keep], g.e_dst[keep]
+    g.e_latency_ms = g.e_latency_ms[keep]
+    g.e_jitter_ms = g.e_jitter_ms[keep]
+    g.e_packetloss = g.e_packetloss[keep]
+    lat_py, rel_py, un_py = compute_all_pairs(g, native=False)
+    lat_cc, rel_cc, un_cc = compute_all_pairs(g, native=True)
+    assert un_cc[0, 5] and un_cc[5, 0]
+    np.testing.assert_allclose(rel_cc, rel_py, atol=1e-9)
+    np.testing.assert_allclose(lat_cc, lat_py, atol=1e-9)
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason="native oracle unavailable (no g++?)")
+def test_native_perf_1k_vertices():
+    """The native oracle must handle reference-scale PoI graphs (the
+    bundled PlanetLab topology has ~1k vertices) in seconds."""
+    import time
+
+    g = random_graph(1000, 4000, 4)
+    t0 = time.perf_counter()
+    lat, rel, un = compute_all_pairs(g, native=True)
+    dt = time.perf_counter() - t0
+    assert lat.shape == (1000, 1000)
+    assert not un.all()
+    assert dt < 30, f"native APSP took {dt:.1f}s"

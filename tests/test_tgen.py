@@ -1,0 +1,147 @@
+"""tgen behavior-graph tests.
+
+Mirrors the reference's canonical example workload
+(resource/examples/shadow.config.xml: tgen servers + web/bulk clients
+walking GraphML behavior graphs) at reduced scale.
+"""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario
+from shadow_tpu.engine import defs
+from shadow_tpu.engine.sim import Simulation
+from shadow_tpu.apps.tgen import (TgenTables, parse_size, NK_START,
+                                  NK_TRANSFER, NK_PAUSE, NK_END,
+                                  COL_KIND, COL_A, COL_B, COL_NEXT)
+
+SERVER_GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="serverport" attr.type="string" for="node" id="d0" />
+  <graph edgedefault="directed">
+    <node id="start"><data key="d0">30080</data></node>
+  </graph>
+</graphml>"""
+
+# web-style client: GET 50 KiB, short random pause, 3 rounds
+WEB_GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="count" attr.type="string" for="node" id="d6" />
+  <key attr.name="size" attr.type="string" for="node" id="d5" />
+  <key attr.name="type" attr.type="string" for="node" id="d4" />
+  <key attr.name="protocol" attr.type="string" for="node" id="d3" />
+  <key attr.name="time" attr.type="string" for="node" id="d2" />
+  <key attr.name="peers" attr.type="string" for="node" id="d0" />
+  <graph edgedefault="directed">
+    <node id="start">
+      <data key="d0">server1:30080,server2:30080</data>
+    </node>
+    <node id="pause"><data key="d2">1,2</data></node>
+    <node id="transfer">
+      <data key="d3">tcp</data><data key="d4">get</data>
+      <data key="d5">50 KiB</data>
+    </node>
+    <node id="end"><data key="d6">3</data></node>
+    <edge source="start" target="transfer" />
+    <edge source="end" target="pause" />
+    <edge source="pause" target="start" />
+    <edge source="transfer" target="end" />
+  </graph>
+</graphml>"""
+
+# bulk-style client: PUT 200 KiB back-to-back, 2 rounds
+BULK_GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="count" attr.type="string" for="node" id="d5" />
+  <key attr.name="size" attr.type="string" for="node" id="d4" />
+  <key attr.name="type" attr.type="string" for="node" id="d3" />
+  <key attr.name="peers" attr.type="string" for="node" id="d0" />
+  <graph edgedefault="directed">
+    <node id="start">
+      <data key="d0">server1:30080,server2:30080</data>
+    </node>
+    <node id="transfer">
+      <data key="d3">put</data><data key="d4">200 KiB</data>
+    </node>
+    <node id="end"><data key="d5">2</data></node>
+    <edge source="start" target="transfer" />
+    <edge source="transfer" target="end" />
+    <edge source="end" target="start" />
+  </graph>
+</graphml>"""
+
+
+def tgen_scenario(topology, n_web=2, n_bulk=1, stop=60):
+    return Scenario(
+        stop_time=stop * 10**9,
+        topology_graphml=topology,
+        hosts=[
+            HostSpec(id="server", quantity=2, processes=[
+                ProcessSpec(plugin="tgen", start_time=10**9,
+                            arguments=SERVER_GRAPH)]),
+            HostSpec(id="web", quantity=n_web, processes=[
+                ProcessSpec(plugin="tgen", start_time=2 * 10**9,
+                            arguments=WEB_GRAPH)]),
+            HostSpec(id="bulk", quantity=n_bulk, processes=[
+                ProcessSpec(plugin="tgen", start_time=2 * 10**9,
+                            arguments=BULK_GRAPH)]),
+        ],
+    )
+
+
+def test_parse_size():
+    assert parse_size("100 KiB") == 102400
+    assert parse_size("1 MiB") == 1 << 20
+    assert parse_size("5242880") == 5242880
+    assert parse_size("1.5 KB") == 1500
+
+
+def test_graph_compile(simple_topology_xml):
+    from shadow_tpu.routing.dns import DNS
+    dns = DNS()
+    for i, name in enumerate(["server1", "server2"]):
+        dns.register(i, name, None)
+    tab = TgenTables()
+    start = tab.compile(WEB_GRAPH, dns)
+    nodes, peers, pool = tab.arrays()
+    assert nodes.shape == (4, 8)
+    assert nodes[start, COL_KIND] == NK_START
+    kinds = set(nodes[:, COL_KIND].tolist())
+    assert kinds == {NK_START, NK_TRANSFER, NK_PAUSE, NK_END}
+    # the cycle start -> transfer -> end -> pause -> start is closed
+    cur, seen = start, []
+    for _ in range(4):
+        seen.append(int(nodes[cur, COL_KIND]))
+        cur = int(nodes[cur, COL_NEXT])
+    assert cur == start
+    assert seen == [NK_START, NK_TRANSFER, NK_END, NK_PAUSE]
+    # peers resolved; 2-second pause pool
+    assert peers.shape == (2, 2)
+    assert (peers[:, 1] == 30080).all()
+    assert pool.tolist() == [10**9, 2 * 10**9]
+    # dedup: same source compiles once
+    assert tab.compile(WEB_GRAPH, dns) == start
+    assert len(tab.nodes) == 4
+
+
+def test_tgen_web_and_bulk_complete(simple_topology_xml):
+    sim = Simulation(tgen_scenario(simple_topology_xml))
+    report = sim.run()
+    s = report.summary()
+    stats = report.stats
+
+    # client transfers: 2 web x 3 GETs + 1 bulk x 2 PUTs = 8 completions
+    web = slice(2, 4)
+    bulk = slice(4, 5)
+    assert (stats[web, defs.ST_XFER_DONE] == 3).all(), stats[:, defs.ST_XFER_DONE]
+    assert (stats[bulk, defs.ST_XFER_DONE] == 2).all(), stats[:, defs.ST_XFER_DONE]
+    # every client reached its end node
+    assert (stats[2:, defs.ST_APP_DONE] >= 1).all()
+    # web clients actually received their GET payloads
+    assert (stats[web, defs.ST_BYTES_RECV] >= 3 * 50 * 1024).all()
+    # servers received the bulk PUT bytes
+    assert stats[0:2, defs.ST_BYTES_RECV].sum() >= 2 * 200 * 1024
+    assert s["drop_net"] == 0
+
+
+def test_tgen_deterministic(simple_topology_xml):
+    r1 = Simulation(tgen_scenario(simple_topology_xml)).run()
+    r2 = Simulation(tgen_scenario(simple_topology_xml)).run()
+    assert np.array_equal(r1.stats, r2.stats)

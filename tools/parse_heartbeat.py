@@ -1,0 +1,54 @@
+#!/usr/bin/env python3
+"""Parse shadow_tpu heartbeat logs into CSV (the analogue of the
+reference's src/tools/parse-shadow.py over [shadow-heartbeat] lines).
+
+Usage:
+  python tools/parse_heartbeat.py sim.log --out nodes.csv
+  python tools/parse_heartbeat.py sim.log --summary
+
+Node lines have the schema obs.tracker.HEADER:
+  time,host,events,pkts-sent,pkts-recv,bytes-sent,bytes-recv,
+  retransmits,drop-net,drop-buf,transfers-done
+"""
+
+import argparse
+import csv
+import re
+import sys
+
+NODE_RE = re.compile(r"\[shadow-heartbeat\] \[node\] (.+)$")
+SUMMARY_RE = re.compile(r"\[shadow-heartbeat\] \[summary\] (.+)$")
+
+FIELDS = ["time", "host", "events", "pkts_sent", "pkts_recv",
+          "bytes_sent", "bytes_recv", "retransmits", "drop_net",
+          "drop_buf", "transfers_done"]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("log")
+    ap.add_argument("--out", default="-")
+    ap.add_argument("--summary", action="store_true",
+                    help="print summary lines instead of node CSV")
+    args = ap.parse_args()
+
+    out = sys.stdout if args.out == "-" else open(args.out, "w", newline="")
+    with open(args.log) as f:
+        if args.summary:
+            for line in f:
+                m = SUMMARY_RE.search(line)
+                if m:
+                    out.write(m.group(1) + "\n")
+        else:
+            w = csv.writer(out)
+            w.writerow(FIELDS)
+            for line in f:
+                m = NODE_RE.search(line)
+                if m:
+                    w.writerow(m.group(1).split(","))
+    if out is not sys.stdout:
+        out.close()
+
+
+if __name__ == "__main__":
+    main()
