@@ -113,6 +113,9 @@ def main(argv=None):
     from .engine.sim import Simulation
     from .obs.logger import SimLogger
 
+    if args.checkpoint and not args.checkpoint_every:
+        p.error("--checkpoint requires --checkpoint-every SEC")
+
     if args.test:
         scenario = build_test_scenario(args.test_clients)
     elif args.config:

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import rng as R
+from ..core.rowops import rset
 from ..net import packet as P
 from ..engine import equeue
 from ..engine.defs import EV_APP, WAKE_TIMER
@@ -46,17 +47,19 @@ def app_null(row, hp, sh, now, wake):
 
 def draw(row, hp, sh):
     """Draw one uniform [0,1) float deterministically for this host.
-    Returns (row, u)."""
-    key = R.counter_key(R.host_key(sh.rng_root, hp.hid), row.rng_ctr)
-    return row.replace(rng_ctr=row.rng_ctr + 1), jax.random.uniform(key)
+    Returns (row, u). Uses the cheap counter PRNG (core.rng): the
+    per-host stream is precomputed in HostParams, so a draw is ~8 ALU
+    ops — threefry here dominated the whole window program."""
+    u = R.cheap_uniform(hp.rng_stream, row.rng_ctr)
+    return row.replace(rng_ctr=row.rng_ctr + 1), u
 
 
 def schedule_wake(row, t, reason, sock=-1, aux=0):
     """Push a future EV_APP (app timer) for this host."""
-    wake = (jnp.zeros((P.PKT_WORDS,), jnp.int32)
-            .at[P.ACK].set(jnp.int32(reason))
-            .at[P.SEQ].set(jnp.int32(sock))
-            .at[P.AUX].set(jnp.int32(aux)))
+    wake = jnp.zeros((P.PKT_WORDS,), jnp.int32)
+    wake = rset(wake, P.ACK, jnp.int32(reason))
+    wake = rset(wake, P.SEQ, jnp.int32(sock))
+    wake = rset(wake, P.AUX, jnp.int32(aux))
     return equeue.q_push(row, t, EV_APP, wake)
 
 

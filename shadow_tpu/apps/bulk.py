@@ -20,6 +20,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..core.rowops import radd, rset
 from ..engine.defs import (WAKE_START, WAKE_TIMER, WAKE_SOCKET,
                            WAKE_CONNECTED, WAKE_EOF, WAKE_ACCEPT, WAKE_SENT,
                            ST_XFER_DONE, ST_APP_DONE)
@@ -32,7 +33,7 @@ def _connect(row, hp, sh, now):
     row, slot, ok = tcp_connect(row, hp, sh, now,
                                 dst_host=hp.app_cfg[0],
                                 dst_port=hp.app_cfg[1])
-    return row.replace(app_r=row.app_r.at[0].set(slot.astype(jnp.int64)))
+    return row.replace(app_r=rset(row.app_r, 0, slot.astype(jnp.int64)))
 
 
 def app_bulk(row, hp, sh, now, wake):
@@ -49,12 +50,12 @@ def app_bulk(row, hp, sh, now, wake):
         # all bytes acked: transfer complete; close and maybe go again
         r = tcp_close_call(r, now, sock)
         r = r.replace(
-            app_r=r.app_r.at[1].add(1),
-            stats=r.stats.at[ST_XFER_DONE].add(1))
+            app_r=radd(r.app_r, 1, 1),
+            stats=radd(r.stats, ST_XFER_DONE, 1))
         done = (hp.app_cfg[3] > 0) & (r.app_r[1] >= hp.app_cfg[3])
         return jax.lax.cond(
             done,
-            lambda rr: rr.replace(stats=rr.stats.at[ST_APP_DONE].add(1)),
+            lambda rr: rr.replace(stats=radd(rr.stats, ST_APP_DONE, 1)),
             lambda rr: timer(rr, now + hp.app_cfg[4]), r)
 
     def on_timer(r):
@@ -75,14 +76,14 @@ def app_bulk_server(row, hp, sh, now, wake):
 
     def on_start(r):
         r, slot, ok = tcp_listen(r, hp.app_cfg[1])
-        return r.replace(app_r=r.app_r.at[0].set(slot.astype(jnp.int64)))
+        return r.replace(app_r=rset(r.app_r, 0, slot.astype(jnp.int64)))
 
     def on_eof(r):
         # client finished sending: close our side (LAST_ACK path) and
         # count the completed inbound transfer
         child = wake[P.SEQ]
         r = tcp_close_call(r, now, child)
-        return r.replace(stats=r.stats.at[ST_XFER_DONE].add(1))
+        return r.replace(stats=radd(r.stats, ST_XFER_DONE, 1))
 
     def nop(r):
         return r

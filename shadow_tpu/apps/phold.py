@@ -16,6 +16,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..core.rowops import radd, rset
 from ..engine.defs import WAKE_START, WAKE_TIMER, WAKE_SOCKET
 from ..net import packet as P
 from ..net.udp import udp_open, udp_sendto
@@ -39,7 +40,7 @@ def _send_to_random_peer(row, hp, sh, now):
     sock = row.app_r[0].astype(jnp.int32)
     row = udp_sendto(row, hp, now, sock, dst_host=peer,
                      dst_port=hp.app_cfg[1], nbytes=hp.app_cfg[3])
-    return row.replace(app_r=row.app_r.at[1].add(1))
+    return row.replace(app_r=radd(row.app_r, 1, 1))
 
 
 def app_phold(row, hp, sh, now, wake):
@@ -47,7 +48,7 @@ def app_phold(row, hp, sh, now, wake):
 
     def on_start(r):
         r, sock, ok = udp_open(r, port=hp.app_cfg[1])
-        r = r.replace(app_r=r.app_r.at[0].set(jnp.int64(sock)))
+        r = r.replace(app_r=rset(r.app_r, 0, jnp.int64(sock)))
 
         # Seed the system with c4 initial messages at exponential offsets.
         # The bound must be clamped: under vmap every host executes every

@@ -17,6 +17,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..core.rowops import radd, rset
 from ..core.simtime import SIMTIME_ONE_MICROSECOND
 from ..engine.defs import (WAKE_START, WAKE_TIMER, WAKE_SOCKET,
                            ST_RTT_SUM_US, ST_RTT_COUNT, ST_XFER_DONE, ST_APP_DONE)
@@ -39,7 +40,7 @@ def _send_ping(row, hp, now):
     row = udp_sendto(row, hp, now, sock,
                      dst_host=hp.app_cfg[0], dst_port=hp.app_cfg[1],
                      nbytes=hp.app_cfg[3], aux=_us31(now))
-    row = row.replace(app_r=row.app_r.at[1].add(1))
+    row = row.replace(app_r=radd(row.app_r, 1, 1))
     limit = hp.app_cfg[4]
     more = (limit == 0) | (row.app_r[1] < limit)
     return jax.lax.cond(more, lambda r: timer(r, now + hp.app_cfg[2]),
@@ -51,7 +52,7 @@ def app_ping(row, hp, sh, now, wake):
 
     def on_start(r):
         r, sock, ok = udp_open(r)
-        r = r.replace(app_r=r.app_r.at[0].set(jnp.int64(sock)))
+        r = r.replace(app_r=rset(r.app_r, 0, jnp.int64(sock)))
         return _send_ping(r, hp, now)
 
     def on_timer(r):
@@ -60,14 +61,13 @@ def app_ping(row, hp, sh, now, wake):
     def on_echo(r):
         rtt_us = (_us31(now) - jnp.int64(wake[P.AUX])) % _US_MOD
         r = r.replace(
-            app_r=r.app_r.at[2].add(1),
-            stats=r.stats.at[ST_RTT_SUM_US].add(rtt_us)
-                         .at[ST_RTT_COUNT].add(1)
-                         .at[ST_XFER_DONE].add(1))
+            app_r=radd(r.app_r, 2, 1),
+            stats=radd(radd(radd(r.stats, ST_RTT_SUM_US, rtt_us),
+                            ST_RTT_COUNT, 1), ST_XFER_DONE, 1))
         limit = hp.app_cfg[4]
         done = (limit > 0) & (r.app_r[2] >= limit)
-        return r.replace(stats=r.stats.at[ST_APP_DONE].add(
-            jnp.where(done, 1, 0)))
+        return r.replace(stats=radd(r.stats, ST_APP_DONE,
+                                    jnp.where(done, 1, 0)))
 
     return jax.lax.switch(
         jnp.clip(reason, 0, 2),
@@ -80,7 +80,7 @@ def app_ping_server(row, hp, sh, now, wake):
 
     def on_start(r):
         r, sock, ok = udp_open(r, port=hp.app_cfg[1])
-        return r.replace(app_r=r.app_r.at[0].set(jnp.int64(sock)))
+        return r.replace(app_r=rset(r.app_r, 0, jnp.int64(sock)))
 
     def on_dgram(r):
         # echo the payload back to the sender, preserving the AUX tag

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.rowops import radd, rset
 from ..core.simtime import SIMTIME_ONE_SECOND
 from ..engine.defs import (WAKE_START, WAKE_TIMER, WAKE_SOCKET,
                            WAKE_CONNECTED, WAKE_EOF, WAKE_ACCEPT, WAKE_SENT,
@@ -271,7 +272,7 @@ def _exec_node(row, hp, sh, now, cur):
         delay = nd[COL_B]
 
         def wait(rr):
-            rr = rr.replace(app_r=rr.app_r.at[1].set(nxt.astype(_I64)))
+            rr = rr.replace(app_r=rset(rr.app_r, 1, nxt.astype(_I64)))
             return timer(rr, now + delay), _I32(-1)
 
         return jax.lax.cond(delay > 0, wait, lambda rr: (rr, nxt), r)
@@ -290,8 +291,15 @@ def _exec_node(row, hp, sh, now, cur):
         tag = (size | jnp.where(ttype == 1, TAG_PUT, 0)).astype(_I32)
         r, slot, ok = tcp_connect(r, hp, sh, now, dst_host=peer_host,
                                   dst_port=peer_port, tag=tag)
-        r = r.replace(app_r=r.app_r.at[0].set(slot.astype(_I64))
-                                  .at[1].set(_I64(cur)))
+        r = r.replace(app_r=rset(rset(r.app_r, 0,
+                                      slot.astype(_I64)), 1, _I64(cur)))
+        # connect failure (socket table full): retry the transfer after
+        # a 1s backoff instead of blocking the walk forever
+        r = jax.lax.cond(ok, lambda rr: rr,
+                         lambda rr: timer(rr.replace(
+                             app_r=rset(rset(rr.app_r, 0, -1), 1,
+                                        _I64(cur))), now + SIMTIME_ONE_SECOND),
+                         r)
         return r, _I32(-1)
 
     def do_pause(r):
@@ -312,7 +320,7 @@ def _exec_node(row, hp, sh, now, cur):
         r, t = jax.lax.cond(fixed < 0, drawn, fixed_t, r)
 
         def wait(rr):
-            rr = rr.replace(app_r=rr.app_r.at[1].set(nxt.astype(_I64)))
+            rr = rr.replace(app_r=rset(rr.app_r, 1, nxt.astype(_I64)))
             return timer(rr, now + t), _I32(-1)
 
         return jax.lax.cond(t > 0, wait, lambda rr: (rr, nxt), r)
@@ -325,8 +333,8 @@ def _exec_node(row, hp, sh, now, cur):
 
         def stop(rr):
             rr = rr.replace(
-                app_r=rr.app_r.at[1].set(_I64(-1)),
-                stats=rr.stats.at[ST_APP_DONE].add(1))
+                app_r=rset(rr.app_r, 1, _I64(-1)),
+                stats=radd(rr.stats, ST_APP_DONE, 1))
             return rr, _I32(-1)
 
         return jax.lax.cond(met, stop, lambda rr: (rr, nxt), r)
@@ -358,8 +366,8 @@ def _finish_transfer(row, hp, sh, now, sock):
                                 sh.tgen_nodes.shape[0] - 1)]
     row = tcp_close_call(row, now, sock)
     row = row.replace(
-        app_r=row.app_r.at[2].add(1).at[3].add(nd[COL_B]).at[0].set(-1),
-        stats=row.stats.at[ST_XFER_DONE].add(1))
+        app_r=rset(radd(radd(row.app_r, 2, 1), 3, nd[COL_B]), 0, -1),
+        stats=radd(row.stats, ST_XFER_DONE, 1))
     return _run_chain(row, hp, sh, now, nd[COL_NEXT].astype(_I32))
 
 
@@ -378,7 +386,7 @@ def app_tgen(row, hp, sh, now, wake):
             return rr
 
         r = jax.lax.cond(port > 0, listen, lambda rr: rr, r)
-        r = r.replace(app_r=r.app_r.at[4].set(_I64(now)).at[0].set(-1))
+        r = r.replace(app_r=rset(rset(r.app_r, 4, _I64(now)), 0, -1))
         return _run_chain(r, hp, sh, now, start_node)
 
     def on_timer(r):
@@ -425,7 +433,7 @@ def app_tgen(row, hp, sh, now, wake):
 
             def done_put(r2):
                 r2 = tcp_close_call(r2, now, slot)
-                return r2.replace(stats=r2.stats.at[ST_XFER_DONE].add(1))
+                return r2.replace(stats=radd(r2.stats, ST_XFER_DONE, 1))
 
             return jax.lax.cond(is_put_child, done_put, lambda r2: r2, rr)
 

@@ -71,3 +71,50 @@ def bounded_int(key: jax.Array, lo: int, hi):
     """Uniform integer in [lo, hi) — used for ephemeral port picks and
     app-level random choices."""
     return jax.random.randint(key, (), lo, hi, dtype=jnp.int32)
+
+
+# --- Cheap counter PRNG for the per-event hot path --------------------------
+#
+# Profiling showed threefry dominating the window program: every
+# jax.random fold_in/uniform chain is multiple 20-round threefry
+# passes, executed for ALL hosts on EVERY lockstep iteration (masked
+# vmap). Simulation randomness needs determinism and decent statistics,
+# not cryptographic strength — the reference itself uses rand_r
+# (shd-random.c). This is a splitmix/murmur3-style avalanche over a
+# (stream, counter) pair: ~8 native u32 ALU ops total.
+#
+# Same tree shape as the threefry path: stream = f(seed, domain, id),
+# value = mix(stream, counter). Mirrored exactly (numpy uint32) by
+# engine.pyengine for the differential tests.
+
+_GOLDEN = 0x9E3779B9
+
+
+def _mix32(x):
+    """murmur3 finalizer (u32 avalanche)."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    return x ^ (x >> 16)
+
+
+def stream_of(seed, domain, ident):
+    """u32 stream id for (seed, domain, per-entity id)."""
+    s = (jnp.uint32(seed) * jnp.uint32(_GOLDEN)
+         ^ jnp.uint32(domain) * jnp.uint32(0x85EBCA6B)
+         ^ jnp.asarray(ident).astype(jnp.uint32) * jnp.uint32(0xC2B2AE35))
+    return _mix32(s)
+
+
+def cheap_bits(stream, counter):
+    """u32 random bits for (stream, counter)."""
+    return _mix32(jnp.asarray(stream).astype(jnp.uint32) ^
+                  (jnp.asarray(counter).astype(jnp.uint32) +
+                   jnp.uint32(_GOLDEN)))
+
+
+def cheap_uniform(stream, counter):
+    """f32 uniform in [0, 1) from 24 high bits."""
+    return (cheap_bits(stream, counter) >> jnp.uint32(8)).astype(
+        jnp.float32) * jnp.float32(1.0 / (1 << 24))

@@ -1,0 +1,61 @@
+"""Fusable row-slot access: one-hot selects instead of scatter/gather.
+
+Under ``vmap``, ``arr.at[slot].set(v)`` and ``arr[slot]`` (per-row
+dynamic index) lower to XLA scatter/gather ops, which cannot fuse with
+neighboring elementwise work on TPU — profiling showed the window
+program shattered into ~2000 ~10us kernels per lockstep iteration,
+making kernel overhead (not math) the entire cost of the engine.
+
+These helpers express the same operations as masked elementwise ops
+over the (small, static) slot dimension: they do W x more ALU work and
+zero extra kernels — everything fuses into the surrounding computation.
+Exact: the mask selects exactly one slot, so masked-sum gathers are
+bit-identical to indexing for every dtype used here (ints, bool, f32
+values stored per slot).
+
+All functions operate on one host's row slices (shapes [N] or
+[N, W]) with a scalar ``idx``; use under vmap.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def mask_of(arr, idx):
+    """[N] bool one-hot (False everywhere if idx out of range)."""
+    return jnp.arange(arr.shape[0]) == idx
+
+
+def rget(arr, idx):
+    """arr[idx] for scalar idx without a gather. Works for [N] and
+    [N, W] arrays; out-of-range idx returns zeros."""
+    m = mask_of(arr, idx)
+    if arr.ndim == 1:
+        if arr.dtype == jnp.bool_:
+            return jnp.any(m & arr)
+        return jnp.sum(jnp.where(m, arr, 0), dtype=arr.dtype)
+    return jnp.sum(jnp.where(m[:, None], arr, 0), axis=0, dtype=arr.dtype)
+
+
+def rset(arr, idx, val):
+    """arr.at[idx].set(val) without a scatter ([N] or [N, W])."""
+    m = mask_of(arr, idx)
+    if arr.ndim == 1:
+        return jnp.where(m, jnp.asarray(val, arr.dtype), arr)
+    return jnp.where(m[:, None], jnp.asarray(val, arr.dtype), arr)
+
+
+def radd(arr, idx, val):
+    """arr.at[idx].add(val) without a scatter ([N] only)."""
+    m = mask_of(arr, idx)
+    return arr + jnp.where(m, jnp.asarray(val, arr.dtype), 0)
+
+
+def rset_where(arr, idx, cond, val):
+    """arr.at[idx].set(where(cond, val, arr[idx])) — conditional slot
+    write with no gather/scatter."""
+    m = mask_of(arr, idx) & cond
+    if arr.ndim == 1:
+        return jnp.where(m, jnp.asarray(val, arr.dtype), arr)
+    return jnp.where(m[:, None], jnp.asarray(val, arr.dtype), arr)

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..core.rowops import radd, rset, rset_where
 from ..core.simtime import SIMTIME_MAX
 from .defs import EV_NULL, ST_EQ_FULL_LOCAL
 
@@ -30,21 +31,22 @@ def q_push(row, t, kind, pkt):
     Returns the updated row. If the queue is full the event is dropped
     and counted in ST_EQ_FULL_LOCAL — an explicit capacity budget where
     the reference would malloc (overflow is visible in stats, never
-    silent).
+    silent). One-hot writes (core.rowops) keep this fusable — it is
+    the single most executed operation in the engine.
     """
     free = row.eq_time == SIMTIME_MAX
     has_free = jnp.any(free)
     slot = jnp.argmax(free)  # first free slot
     seq = row.eq_ctr
 
-    t_eff = jnp.where(has_free, jnp.int64(t), SIMTIME_MAX)
     return row.replace(
-        eq_time=row.eq_time.at[slot].set(jnp.where(has_free, t_eff, row.eq_time[slot])),
-        eq_seq=row.eq_seq.at[slot].set(jnp.where(has_free, seq, row.eq_seq[slot])),
-        eq_kind=row.eq_kind.at[slot].set(jnp.where(has_free, jnp.int32(kind), row.eq_kind[slot])),
-        eq_pkt=row.eq_pkt.at[slot].set(jnp.where(has_free, pkt, row.eq_pkt[slot])),
+        eq_time=rset_where(row.eq_time, slot, has_free, jnp.int64(t)),
+        eq_seq=rset_where(row.eq_seq, slot, has_free, seq),
+        eq_kind=rset_where(row.eq_kind, slot, has_free, jnp.int32(kind)),
+        eq_pkt=rset_where(row.eq_pkt, slot, has_free, pkt),
         eq_ctr=row.eq_ctr + 1,
-        stats=row.stats.at[ST_EQ_FULL_LOCAL].add(jnp.where(has_free, 0, 1)),
+        stats=radd(row.stats, ST_EQ_FULL_LOCAL,
+                   jnp.where(has_free, 0, 1)),
     )
 
 
@@ -73,6 +75,6 @@ def q_next_time(row):
 def q_clear_slot(row, slot):
     """Free a slot after popping its event."""
     return row.replace(
-        eq_time=row.eq_time.at[slot].set(SIMTIME_MAX),
-        eq_kind=row.eq_kind.at[slot].set(EV_NULL),
+        eq_time=rset(row.eq_time, slot, SIMTIME_MAX),
+        eq_kind=rset(row.eq_kind, slot, EV_NULL),
     )

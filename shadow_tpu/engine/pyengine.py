@@ -1,0 +1,424 @@
+"""Obviously-correct pure-Python engine for differential testing.
+
+The reference's core testing idea is the dual-run pattern: every test
+binary runs natively AND under the simulator, and the results must
+agree (SURVEY §4; src/test/CMakeLists.txt). The TPU analogue: the same
+scenario runs under (a) the compiled array engine (engine.window) and
+(b) this straightforward heap-based Python engine, and the stats must
+be IDENTICAL bit for bit.
+
+This engine intentionally mirrors the array engine's semantics —
+per-host (time, seq) event order, NIC busy-horizon accounting,
+outbox/exchange with per-window budgets and queue-reserve merging, the
+counter-keyed loss rolls — but implements them with dicts, lists and a
+loop, so each behavior is easy to audit. RNG-derived quantities go
+through the same eager jax.random calls, making float rounding
+identical.
+
+Supported app kinds: the UDP tier (ping, pingserver, phold). TCP
+scenarios exercise vastly more state; the differential harness covers
+the engine substrate (queues, NIC, exchange, loss, RNG, windows) which
+TCP runs on top of.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng as R
+from ..core.constants import (HEADER_SIZE_UDPIPETH, MIN_RANDOM_PORT,
+                              MAX_PORT, UDP_MAX_PAYLOAD)
+from ..core.simtime import SIMTIME_MAX, SIMTIME_ONE_MICROSECOND, SIMTIME_ONE_SECOND
+from ..net import packet as P
+from . import defs
+from .defs import (EV_APP, EV_PKT, EV_NIC_TX, WAKE_START, WAKE_TIMER,
+                   WAKE_SOCKET)
+from ..apps.base import APP_NULL, APP_PING, APP_PING_SERVER, APP_PHOLD
+
+
+class _Host:
+    def __init__(self, hid, qcap, scap, txqcap, obcap):
+        self.hid = hid
+        self.qcap = qcap
+        self.events = {}      # slot -> (time, seq, kind, pkt)
+        self.eq_ctr = 0
+        self.rng_ctr = 0
+        self.nic_busy = 0
+        self.nic_sched = False
+        self.nic_rx_until = 0
+        self.txq = []
+        self.txqcap = txqcap
+        self.pkt_ctr = 0
+        self.next_eport = MIN_RANDOM_PORT
+        self.socks = [None] * scap   # None or dict(proto, lport, rhost, rport)
+        self.obcap = obcap
+        self.outbox = []             # (send_time, pkt)
+        self.app_r = [0] * 8
+        self.free_slots = list(range(qcap))
+
+
+class PyEngine:
+    """Runs a built Simulation's scenario with plain-Python semantics.
+
+    Usage: PyEngine(sim).run() -> stats ndarray comparable to
+    sim.run().stats (build two Simulations; each is single-use).
+    """
+
+    def __init__(self, sim):
+        cfg = sim.cfg
+        self.cfg = cfg
+        H = cfg.num_hosts
+        self.H = H
+        self.hp_vertex = np.asarray(sim.hp.vertex)
+        self.hp_bw_up = np.asarray(sim.hp.bw_up)
+        self.hp_bw_down = np.asarray(sim.hp.bw_down)
+        self.hp_app_kind = np.asarray(sim.hp.app_kind)
+        self.hp_app_cfg = np.asarray(sim.hp.app_cfg)
+        self.hp_nic_buf = np.asarray(sim.hp.nic_buf)
+        self.lat = np.asarray(sim.sh.lat_ns)
+        self.rel = np.asarray(sim.sh.rel)
+        self.stop = int(sim.sh.stop_time)
+        self.min_jump = int(sim.sh.min_jump)
+        self.root = sim.sh.rng_root
+        self.reserve = min(8, cfg.qcap // 4)
+
+        self.stats = np.zeros((H, defs.N_STATS), dtype=np.int64)
+        self.hosts = [_Host(h, cfg.qcap, cfg.scap, cfg.txqcap, cfg.obcap)
+                      for h in range(H)]
+
+        # initial events from the built Simulation state
+        eq_time = np.asarray(sim.hosts.eq_time)
+        eq_kind = np.asarray(sim.hosts.eq_kind)
+        eq_seq = np.asarray(sim.hosts.eq_seq)
+        eq_pkt = np.asarray(sim.hosts.eq_pkt)
+        eq_ctr = np.asarray(sim.hosts.eq_ctr)
+        for h in range(H):
+            host = self.hosts[h]
+            host.eq_ctr = int(eq_ctr[h])
+            for s in range(cfg.qcap):
+                if eq_time[h, s] != SIMTIME_MAX:
+                    host.free_slots.remove(s)
+                    host.events[s] = (int(eq_time[h, s]), int(eq_seq[h, s]),
+                                      int(eq_kind[h, s]),
+                                      eq_pkt[h, s].copy())
+
+        self.seed32 = int(sim.seed) & 0xFFFFFFFF
+
+    # --- RNG: exact Python-int mirror of core.rng's cheap PRNG ---
+    @staticmethod
+    def _mix32(x):
+        M = 0xFFFFFFFF
+        x &= M
+        x ^= x >> 16
+        x = (x * 0x85EBCA6B) & M
+        x ^= x >> 13
+        x = (x * 0xC2B2AE35) & M
+        return x ^ (x >> 16)
+
+    def _stream_of(self, domain, ident):
+        M = 0xFFFFFFFF
+        s = ((self.seed32 * 0x9E3779B9) ^ (domain * 0x85EBCA6B) ^
+             ((ident & M) * 0xC2B2AE35)) & M
+        return self._mix32(s)
+
+    def _cheap_uniform(self, stream, counter):
+        bits = self._mix32(stream ^ ((counter + 0x9E3779B9) & 0xFFFFFFFF))
+        return np.float32(bits >> 8) * np.float32(1.0 / (1 << 24))
+
+    def _draw(self, host):
+        stream = self._stream_of(R.DOMAIN_HOST, host.hid)
+        u = self._cheap_uniform(stream, host.rng_ctr)
+        host.rng_ctr += 1
+        return u  # np.float32, bit-identical to the device value
+
+    # --- event queue (first-free-slot + (time, seq) order) ---
+    def _q_push(self, host, t, kind, pkt):
+        if not host.free_slots:
+            self.stats[host.hid, defs.ST_EQ_FULL_LOCAL] += 1
+            host.eq_ctr += 1
+            return
+        slot = min(host.free_slots)
+        host.free_slots.remove(slot)
+        host.events[slot] = (int(t), host.eq_ctr, kind, pkt)
+        host.eq_ctr += 1
+
+    def _q_pop_min(self, host):
+        slot = min(host.events,
+                   key=lambda s: (host.events[s][0], host.events[s][1]))
+        ev = host.events.pop(slot)
+        host.free_slots.append(slot)
+        return ev
+
+    def _next_time(self, host):
+        if not host.events:
+            return SIMTIME_MAX
+        return min(t for t, _, _, _ in host.events.values())
+
+    # --- sockets (UDP only) ---
+    def _sock_alloc(self, host, proto):
+        for i, s in enumerate(host.socks):
+            if s is None:
+                host.socks[i] = {"proto": proto, "lport": 0,
+                                 "rhost": -1, "rport": 0}
+                return i
+        self.stats[host.hid, defs.ST_SOCK_FAIL] += 1
+        return -1
+
+    def _alloc_eport(self, host):
+        span = MAX_PORT - MIN_RANDOM_PORT
+        p = host.next_eport
+        for _ in range(4):
+            if any(s and s["lport"] == p for s in host.socks):
+                p = MIN_RANDOM_PORT + (p + 1 - MIN_RANDOM_PORT) % span
+        host.next_eport = MIN_RANDOM_PORT + (p + 1 - MIN_RANDOM_PORT) % span
+        return p
+
+    def _udp_open(self, host, port=None):
+        slot = self._sock_alloc(host, P.PROTO_UDP)
+        if slot < 0:
+            return slot
+        host.socks[slot]["lport"] = (self._alloc_eport(host)
+                                     if port is None else int(port))
+        return slot
+
+    def _demux(self, host, src, sport, dport):
+        exact = fb = -1
+        for i, s in enumerate(host.socks):
+            if not s or s["proto"] != P.PROTO_UDP or s["lport"] != dport:
+                continue
+            if s["rhost"] == src and s["rport"] == sport and exact < 0:
+                exact = i
+            if s["rhost"] == -1 and fb < 0:
+                fb = i
+        return exact if exact >= 0 else fb
+
+    # --- NIC ---
+    @staticmethod
+    def _tx_dur(nbytes, bw):
+        return (int(nbytes) * SIMTIME_ONE_SECOND) // max(int(bw), 1)
+
+    def _udp_sendto(self, host, now, slot, dst, dport, nbytes, aux=0):
+        length = min(int(nbytes), UDP_MAX_PAYLOAD)
+        pkt = np.zeros(P.PKT_WORDS, dtype=np.int32)
+        pkt[P.SRC] = host.hid
+        pkt[P.DST] = int(dst)
+        pkt[P.SPORT] = host.socks[slot]["lport"]
+        pkt[P.DPORT] = int(dport)
+        pkt[P.FLAGS] = P.PROTO_UDP
+        pkt[P.LEN] = length
+        pkt[P.AUX] = np.int32(np.int64(aux) & 0xFFFFFFFF)
+        if len(host.txq) < host.txqcap:
+            host.txq.append(pkt)
+        else:
+            self.stats[host.hid, defs.ST_TXQ_DROP] += 1
+        self._kick(host, now)
+
+    def _kick(self, host, now):
+        if host.txq and not host.nic_sched:
+            ok = bool(host.free_slots)
+            self._q_push(host, max(now, host.nic_busy), EV_NIC_TX,
+                         np.zeros(P.PKT_WORDS, np.int32))
+            host.nic_sched = ok
+
+    def _on_tx(self, host, now, wend):
+        host.nic_sched = False
+        if len(host.outbox) >= host.obcap:
+            ok = bool(host.free_slots)
+            self._q_push(host, max(wend, now + 1), EV_NIC_TX,
+                         np.zeros(P.PKT_WORDS, np.int32))
+            host.nic_sched = ok
+            return
+        has = bool(host.txq)
+        busy_end = now
+        if has:
+            pkt = host.txq.pop(0)
+            wire = int(pkt[P.LEN]) + HEADER_SIZE_UDPIPETH
+            busy_end = now + max(self._tx_dur(wire,
+                                              self.hp_bw_up[host.hid]), 1)
+            self._emit(host, now, pkt)
+        host.nic_busy = busy_end
+        if host.txq and has:
+            ok = bool(host.free_slots)
+            self._q_push(host, busy_end, EV_NIC_TX,
+                         np.zeros(P.PKT_WORDS, np.int32))
+            host.nic_sched = ok
+
+    def _emit(self, host, now, pkt):
+        pkt = pkt.copy()
+        pkt[P.UID] = host.pkt_ctr
+        if int(pkt[P.DST]) == host.hid:
+            self._q_push(host, now + 1, EV_PKT, pkt)  # loopback, 1ns
+        else:
+            if len(host.outbox) < host.obcap:
+                host.outbox.append((now, pkt))
+            else:
+                self.stats[host.hid, defs.ST_OUTBOX_DROP] += 1
+        self.stats[host.hid, defs.ST_PKTS_SENT] += 1
+        host.pkt_ctr += 1
+
+    def _on_pkt(self, host, now, pkt):
+        wire = int(pkt[P.LEN]) + HEADER_SIZE_UDPIPETH
+        bw = max(int(self.hp_bw_down[host.hid]), 1)
+        backlog_ns = max(host.nic_rx_until - now, 0)
+        backlog_bytes = (backlog_ns * bw) // SIMTIME_ONE_SECOND
+        if backlog_bytes + wire > int(self.hp_nic_buf[host.hid]):
+            self.stats[host.hid, defs.ST_PKTS_DROP_BUF] += 1
+            return
+        host.nic_rx_until = max(host.nic_rx_until, now) + \
+            self._tx_dur(wire, bw)
+        self.stats[host.hid, defs.ST_PKTS_RECV] += 1
+        slot = self._demux(host, int(pkt[P.SRC]), int(pkt[P.SPORT]),
+                           int(pkt[P.DPORT]))
+        if slot < 0:
+            return
+        self.stats[host.hid, defs.ST_BYTES_RECV] += int(pkt[P.LEN])
+        wake = pkt.copy()
+        wake[P.SEQ] = slot
+        wake[P.ACK] = WAKE_SOCKET
+        self._q_push(host, now + 1, EV_APP, wake)
+
+    # --- apps (UDP tier) ---
+    def _app(self, host, now, wake):
+        kind = int(self.hp_app_kind[host.hid])
+        if kind == APP_PING:
+            self._app_ping(host, now, wake)
+        elif kind == APP_PING_SERVER:
+            self._app_ping_server(host, now, wake)
+        elif kind == APP_PHOLD:
+            self._app_phold(host, now, wake)
+
+    def _timer(self, host, t, aux=0):
+        wake = np.zeros(P.PKT_WORDS, np.int32)
+        wake[P.ACK] = WAKE_TIMER
+        wake[P.SEQ] = -1
+        wake[P.AUX] = np.int32(np.int64(aux) & 0xFFFFFFFF)
+        self._q_push(host, t, EV_APP, wake)
+
+    @staticmethod
+    def _us31(t_ns):
+        return (t_ns // SIMTIME_ONE_MICROSECOND) % (2**31)
+
+    def _app_ping(self, host, now, wake):
+        cfg = self.hp_app_cfg[host.hid]
+        reason = min(max(int(wake[P.ACK]), 0), 2)
+        if reason == WAKE_START:
+            host.app_r[0] = self._udp_open(host)
+            self._ping_send(host, now)
+        elif reason == WAKE_TIMER:
+            self._ping_send(host, now)
+        else:  # echo
+            rtt = (self._us31(now) - int(np.int64(wake[P.AUX]))) % (2**31)
+            host.app_r[2] += 1
+            self.stats[host.hid, defs.ST_RTT_SUM_US] += rtt
+            self.stats[host.hid, defs.ST_RTT_COUNT] += 1
+            self.stats[host.hid, defs.ST_XFER_DONE] += 1
+            limit = int(cfg[4])
+            if limit > 0 and host.app_r[2] >= limit:
+                self.stats[host.hid, defs.ST_APP_DONE] += 1
+
+    def _ping_send(self, host, now):
+        cfg = self.hp_app_cfg[host.hid]
+        self._udp_sendto(host, now, host.app_r[0], cfg[0], cfg[1], cfg[3],
+                         aux=self._us31(now))
+        host.app_r[1] += 1
+        limit = int(cfg[4])
+        if limit == 0 or host.app_r[1] < limit:
+            self._timer(host, now + int(cfg[2]))
+
+    def _app_ping_server(self, host, now, wake):
+        cfg = self.hp_app_cfg[host.hid]
+        if int(wake[P.ACK]) == WAKE_START:
+            host.app_r[0] = self._udp_open(host, port=int(cfg[1]))
+        elif int(wake[P.ACK]) == WAKE_SOCKET:
+            self._udp_sendto(host, now, int(wake[P.SEQ]),
+                             int(wake[P.SRC]), int(wake[P.SPORT]),
+                             int(wake[P.LEN]), aux=int(wake[P.AUX]))
+
+    def _exp_delay(self, host):
+        u = self._draw(host)
+        mean = jnp.float32(float(self.hp_app_cfg[host.hid][2]))
+        d = int(jnp.maximum((-mean * jnp.log1p(-u)).astype(jnp.int64), 1))
+        return d
+
+    def _app_phold(self, host, now, wake):
+        cfg = self.hp_app_cfg[host.hid]
+        reason = min(max(int(wake[P.ACK]), 0), 2)
+        if reason == WAKE_START:
+            host.app_r[0] = self._udp_open(host, port=int(cfg[1]))
+            n0 = min(max(int(cfg[4]), 0), host.qcap)
+            for _ in range(n0):
+                self._timer(host, now + self._exp_delay(host))
+        elif reason == WAKE_TIMER:
+            u = self._draw(host)
+            n = int(cfg[0])
+            peer = int(jnp.minimum((u * n).astype(jnp.int64), n - 1))
+            if peer == host.hid:
+                peer = (peer + 1) % n
+            self._udp_sendto(host, now, host.app_r[0], peer, cfg[1], cfg[3])
+            host.app_r[1] += 1
+        else:
+            self._timer(host, now + self._exp_delay(host))
+
+    # --- exchange (identical math to engine.window.exchange) ---
+    def _exchange(self):
+        all_pkts = []  # (global outbox order) host-major
+        for host in self.hosts:
+            for stime, pkt in host.outbox:
+                all_pkts.append((host.hid, stime, pkt))
+            host.outbox = []
+        if not all_pkts:
+            return
+        delivered = {}  # dst -> list of (arrival, pkt) in source order
+        for src, stime, pkt in all_pkts:
+            dst = min(max(int(pkt[P.DST]), 0), self.H - 1)
+            sv, dv = self.hp_vertex[src], self.hp_vertex[dst]
+            rel = np.float32(self.rel[sv, dv])
+            arrival = stime + int(self.lat[sv, dv])
+            u = self._cheap_uniform(self._stream_of(R.DOMAIN_DROP, src),
+                                    int(pkt[P.UID]))
+            if rel > 0 and u <= rel:
+                delivered.setdefault(dst, []).append((arrival, pkt))
+            else:
+                self.stats[src, defs.ST_PKTS_DROP_NET] += 1
+        for dst, lst in delivered.items():
+            host = self.hosts[dst]
+            accepted = lst[: self.cfg.incap]
+            self.stats[dst, defs.ST_PKTS_DROP_Q] += len(lst) - len(accepted)
+            k = len(accepted)
+            nfree = len(host.free_slots)
+            k2 = min(k, max(nfree - self.reserve, 0))
+            self.stats[dst, defs.ST_PKTS_DROP_Q] += k - k2
+            for arrival, pkt in accepted[:k2]:
+                slot = min(host.free_slots)
+                host.free_slots.remove(slot)
+                host.events[slot] = (arrival, host.eq_ctr, EV_PKT,
+                                     pkt.copy())
+                host.eq_ctr += 1
+
+    # --- main loop ---
+    def run(self):
+        nt = min(self._next_time(h) for h in self.hosts)
+        windows = 0
+        while nt < self.stop and nt < SIMTIME_MAX:
+            wend = min(nt + self.min_jump, self.stop)
+            progressed = True
+            while progressed:
+                progressed = False
+                for host in self.hosts:
+                    while host.events and self._next_time(host) < wend:
+                        t, seq, kind, pkt = self._q_pop_min(host)
+                        self.stats[host.hid, defs.ST_EVENTS] += 1
+                        if kind == EV_APP:
+                            self._app(host, t, pkt)
+                        elif kind == EV_PKT:
+                            self._on_pkt(host, t, pkt)
+                        elif kind == EV_NIC_TX:
+                            self._on_tx(host, t, wend)
+                        progressed = True
+            self._exchange()
+            windows += 1
+            nt = min(self._next_time(h) for h in self.hosts)
+        self.windows = windows
+        return self.stats

@@ -217,6 +217,8 @@ class Simulation:
 
         self.hp = HostParams(
             hid=jnp.arange(H, dtype=jnp.int32),
+            rng_stream=R.stream_of(seed & 0xFFFFFFFF, R.DOMAIN_HOST,
+                                   jnp.arange(H, dtype=jnp.int32)),
             vertex=jnp.asarray(vertex, dtype=jnp.int32),
             bw_up=jnp.asarray(bw_up),
             bw_down=jnp.asarray(bw_down),
@@ -237,7 +239,7 @@ class Simulation:
         min_jump = self.topo.min_latency_ns or DEFAULT_MIN_TIME_JUMP
         self.sh = make_shared(self.topo.latency_ns, self.topo.reliability,
                               R.root_key(seed), scenario.stop_time, min_jump,
-                              cc_kind=self.cfg.cc_kind,
+                              seed=seed, cc_kind=self.cfg.cc_kind,
                               tgen_nodes=tg_nodes, tgen_peers=tg_peers,
                               tgen_pool=tg_pool,
                               host_vertex=vertex)
@@ -279,6 +281,8 @@ class Simulation:
         hp = HostParams(
             hid=jnp.concatenate([self.hp.hid,
                                  jnp.arange(H, Hp, dtype=jnp.int32)]),
+            rng_stream=jnp.concatenate([self.hp.rng_stream,
+                                        jnp.zeros(pad, jnp.uint32)]),
             vertex=jnp.concatenate([self.hp.vertex,
                                     jnp.zeros(pad, jnp.int32)]),
             bw_up=jnp.concatenate([self.hp.bw_up,
@@ -370,6 +374,10 @@ class Simulation:
                 from ..parallel.shard import device_put_sharded as _dps
                 hosts, _, _ = _dps(hosts, hp, sh, mesh)
 
+        if checkpoint_path and not checkpoint_every_s:
+            raise ValueError(
+                "checkpoint_path requires checkpoint_every_s > 0 "
+                "(otherwise no snapshot would ever be written)")
         next_ckpt = (int(checkpoint_every_s * 10**9)
                      if checkpoint_every_s else 0)
         ckpt_at = int(wstart) + next_ckpt if next_ckpt else None

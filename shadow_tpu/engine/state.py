@@ -152,6 +152,7 @@ class Hosts:
 class HostParams:
     """Read-only per-host configuration, leading dim H."""
     hid: jnp.ndarray        # [H] i32 own host id (global, shard-invariant)
+    rng_stream: jnp.ndarray  # [H] u32 per-host PRNG stream (core.rng)
     vertex: jnp.ndarray     # [H] i32 topology attachment
     bw_up: jnp.ndarray      # [H] i64 bytes/sec uplink
     bw_down: jnp.ndarray    # [H] i64 bytes/sec downlink
@@ -172,7 +173,8 @@ class Shared:
     host_vertex: jnp.ndarray  # [H] i32 host -> topology vertex (replicated
     #   copy of HostParams.vertex: routing needs the vertex of REMOTE
     #   destination hosts, which a host-sharded table cannot provide)
-    rng_root: jnp.ndarray  # PRNG key
+    rng_root: jnp.ndarray  # PRNG key (host-side / setup uses)
+    seed32: jnp.ndarray    # u32 scalar: root of the cheap counter PRNG
     stop_time: jnp.ndarray  # i64 scalar
     min_jump: jnp.ndarray   # i64 scalar: lookahead window width
     # TCP tuning scalars (reference --tcp-congestion-control /
@@ -267,7 +269,8 @@ def alloc_hosts(cfg: EngineConfig) -> Hosts:
 
 
 def make_shared(topo_lat_ns: np.ndarray, topo_rel: np.ndarray, rng_root,
-                stop_time: int, min_jump: int, cc_kind: int = 2,
+                stop_time: int, min_jump: int, seed: int = 1,
+                cc_kind: int = 2,
                 tcp_init_wnd: float = 10.0,
                 tcp_ssthresh0: float = 0.0,
                 tgen_nodes: np.ndarray = None,
@@ -287,6 +290,7 @@ def make_shared(topo_lat_ns: np.ndarray, topo_rel: np.ndarray, rng_root,
         rel=jnp.asarray(topo_rel, dtype=jnp.float32),
         host_vertex=jnp.asarray(host_vertex, dtype=jnp.int32),
         rng_root=rng_root,
+        seed32=jnp.uint32(seed & 0xFFFFFFFF),
         stop_time=jnp.int64(stop_time),
         min_jump=jnp.int64(min_jump),
         cc_kind=jnp.int32(cc_kind),

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import rng as R
+from ..core.rowops import radd, rget
 from ..core.simtime import SIMTIME_MAX
 from ..net import nic
 from ..net import packet as P
@@ -106,13 +107,13 @@ def step_one_host(row, hp, sh, wend, cfg: EngineConfig):
     """Pop and execute this host's earliest event if inside the window."""
     slot, t = equeue.q_min(row)
     ready = t < wend
-    kind = jnp.where(ready, row.eq_kind[slot], EV_NULL)
-    pkt = row.eq_pkt[slot]
+    kind = jnp.where(ready, rget(row.eq_kind, slot), EV_NULL)
+    pkt = rget(row.eq_pkt, slot)
     row = jax.lax.cond(ready, lambda r: equeue.q_clear_slot(r, slot),
                        lambda r: r, row)
     row = jax.lax.switch(kind, _make_handlers(cfg), row, hp, sh, t, wend, pkt)
     return row.replace(
-        stats=row.stats.at[ST_EVENTS].add(jnp.where(ready, 1, 0)))
+        stats=radd(row.stats, ST_EVENTS, jnp.where(ready, 1, 0)))
 
 
 def step_all_hosts(hosts, hp, sh, wend, cfg: EngineConfig):
@@ -165,55 +166,79 @@ def exchange(hosts, hp, sh, cfg: EngineConfig):
     # Deterministic per-packet drop roll keyed by the globally unique
     # (src, uid) stamped at NIC emit — the counter-based analogue of
     # worker_sendPacket's reliability test (shd-worker.c:238-244).
-    dk = R.domain_key(sh.rng_root, R.DOMAIN_DROP)
-    keys = jax.vmap(jax.random.fold_in, (None, 0))(dk, src)
-    keys = jax.vmap(jax.random.fold_in)(keys, pkts[:, P.UID])
-    u = jax.vmap(jax.random.uniform)(keys)
+    u = R.cheap_uniform(R.stream_of(sh.seed32, R.DOMAIN_DROP, src),
+                        pkts[:, P.UID])
 
     reachable = rel > 0
     deliver = valid & reachable & (u <= rel)
     net_dropped = valid & ~deliver
 
-    # group-by-destination via stable sort; rank within group
+    # group-by-destination: stable sort once, then build the dense
+    # [H, IN] inbound buffers entirely with GATHERS — the sorted order
+    # makes every per-destination run contiguous, so cell (d, r) is
+    # simply sorted position first_of[d] + r. (The previous
+    # scatter-based construction dominated the whole window cost:
+    # TPU scatters serialize.)
     sortkey = jnp.where(deliver, dst, H)
     order = jnp.argsort(sortkey, stable=True)
     sdst = sortkey[order]
-    first = jnp.searchsorted(sdst, sdst, side="left")
-    rank = jnp.arange(N) - first
-    accept = (sdst < H) & (rank < IN)
-    q_dropped = (sdst < H) & (rank >= IN)
+    hosts, in_pkt, in_time = _deliver_dense(
+        hosts, order, sdst, pkts, arrival, net_dropped, O, IN)
 
-    # scatter accepted packets into dense [H, IN] inbound buffers
-    tgt = jnp.where(accept, sdst * IN + rank, N * IN)  # OOB -> dropped
-    in_time = jnp.full((H * IN,), SIMTIME_MAX, jnp.int64)
-    in_time = in_time.at[tgt].set(arrival[order], mode="drop")
-    in_pkt = jnp.zeros((H * IN, P.PKT_WORDS), jnp.int32)
-    in_pkt = in_pkt.at[tgt].set(pkts[order], mode="drop")
+    hosts = trace_and_merge(hosts, hp, cfg, in_pkt, in_time)
+    return hosts.replace(ob_cnt=jnp.zeros_like(hosts.ob_cnt))
 
-    # stat scatters (to source for net drops, destination for queue drops)
+
+def _deliver_dense(hosts, order, sdst, pkts, arrival, net_dropped,
+                   O, IN, lo=0):
+    """Shared gather-based delivery construction for both exchanges.
+    `order`/`sdst` sort the (possibly gathered) global packet list by
+    destination; builds this block's [Hl, IN] inbound buffers for hosts
+    [lo, lo+Hl) plus the drop statistics (reshape-sums, no scatters).
+    `net_dropped` is this block's local outbox drop mask ([Hl*O])."""
+    N = sdst.shape[0]
+    Hl = hosts.stats.shape[0]
+    dsts = lo + jnp.arange(Hl, dtype=sdst.dtype)
+    first_of = jnp.searchsorted(sdst, dsts, side="left")
+    count_of = jnp.searchsorted(sdst, dsts, side="right") - first_of
+
+    r = jnp.arange(IN)
+    j = jnp.clip(first_of[:, None] + r[None, :], 0, N - 1)  # [Hl, IN]
+    oj = order[j]
+    cell_ok = r[None, :] < jnp.minimum(count_of, IN)[:, None]
+    in_time = jnp.where(cell_ok, arrival[oj], SIMTIME_MAX)
+    in_pkt = jnp.where(cell_ok[:, :, None], pkts[oj], jnp.int32(0))
+
     stats = hosts.stats
-    stats = stats.at[src, ST_PKTS_DROP_NET].add(
-        jnp.where(net_dropped, 1, 0).astype(jnp.int64))
-    stats = stats.at[jnp.clip(sdst, 0, H - 1), ST_PKTS_DROP_Q].add(
-        jnp.where(q_dropped, 1, 0).astype(jnp.int64))
-    hosts = hosts.replace(stats=stats)
+    net_per_src = jnp.sum(net_dropped.reshape(Hl, O), axis=1,
+                          dtype=jnp.int64)
+    q_per_dst = jnp.maximum(count_of - IN, 0).astype(jnp.int64)
+    stats = stats.at[:, ST_PKTS_DROP_NET].add(net_per_src)
+    stats = stats.at[:, ST_PKTS_DROP_Q].add(q_per_dst)
+    return hosts.replace(stats=stats), in_pkt, in_time
+
+
+def trace_and_merge(hosts, hp, cfg: EngineConfig, in_pkt, in_time):
+    """Shared tail of both exchanges (single-chip and sharded — ONE
+    implementation so the bit-equality contract between them cannot
+    drift): optional pcap trace records, then the inbound merge into
+    per-host queue free slots. A headroom reserve keeps
+    protocol-internal pushes (NIC events, timers, app wakes) from being
+    starved by an arrival burst — a full queue would silently drop
+    those and freeze the host's NIC."""
+    IN = in_time.shape[1]
+    O = cfg.obcap
 
     if cfg.tracecap:
         # tx records: each source's outbox rows (cross-host traffic;
-        # loopback delivery bypasses the exchange and is not traced)
+        # loopback delivery bypasses the exchange and is not traced);
+        # rx records: what lands on this host this window
         ob_valid = jnp.arange(O)[None, :] < hosts.ob_cnt[:, None]
         hosts = jax.vmap(_trace_append, in_axes=(0, 0, 0, 0, None, 0))(
             hosts, hosts.ob_pkt, hosts.ob_time, ob_valid, 1, hp.pcap_on)
-        # rx records: what lands on each destination this window
         hosts = jax.vmap(_trace_append, in_axes=(0, 0, 0, 0, None, 0))(
-            hosts, in_pkt.reshape(H, IN, P.PKT_WORDS),
-            in_time.reshape(H, IN),
-            in_time.reshape(H, IN) != SIMTIME_MAX, 0, hp.pcap_on)
+            hosts, in_pkt, in_time, in_time != SIMTIME_MAX, 0, hp.pcap_on)
 
-    # merge inbound packets into per-host queue free slots, keeping a
-    # reserve so protocol-internal pushes (NIC events, timers, app
-    # wakes) cannot be starved by an arrival burst — a full queue
-    # would silently drop those and freeze the host's NIC
     reserve = min(8, cfg.qcap // 4)
 
     def merge(row, ipkt, itime):
@@ -232,13 +257,10 @@ def exchange(hosts, hp, sh, cfg: EngineConfig):
                              row.eq_seq),
             eq_pkt=jnp.where(take[:, None], ipkt[j], row.eq_pkt),
             eq_ctr=row.eq_ctr + k2,
-            stats=row.stats.at[ST_PKTS_DROP_Q].add(jnp.int64(overflow)),
+            stats=radd(row.stats, ST_PKTS_DROP_Q, jnp.int64(overflow)),
         )
 
-    hosts = jax.vmap(merge)(hosts,
-                            in_pkt.reshape(H, IN, P.PKT_WORDS),
-                            in_time.reshape(H, IN))
-    return hosts.replace(ob_cnt=jnp.zeros_like(hosts.ob_cnt))
+    return jax.vmap(merge)(hosts, in_pkt, in_time)
 
 
 # --- Multi-window driver ---------------------------------------------------

@@ -27,6 +27,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..core.rowops import radd, rset, rset_where
 from ..engine import equeue
 from ..engine.defs import EV_APP, WAKE_TIMER, ST_EQ_FULL_LOCAL
 from ..net import nic
@@ -59,8 +60,8 @@ def hosted_wake(row, hp, sh, now, pkt):
     ok = cnt < cap
     at = jnp.clip(cnt, 0, cap - 1)
     return row.replace(
-        hw_time=row.hw_time.at[at].set(jnp.where(ok, now, row.hw_time[at])),
-        hw_pkt=row.hw_pkt.at[at].set(jnp.where(ok, pkt, row.hw_pkt[at])),
+        hw_time=rset_where(row.hw_time, at, ok, now),
+        hw_pkt=rset_where(row.hw_pkt, at, ok, pkt),
         hw_cnt=cnt + jnp.where(ok, 1, 0),
         hw_drop=row.hw_drop + jnp.where(ok, 0, 1),
     )
@@ -84,8 +85,15 @@ def _apply_one(hosts, hp, sh, op, results):
         j = jnp.clip(-x - 2, 0, K - 1).astype(_I32)
         return jnp.where(x >= -1, x, results[j].astype(jnp.int64))
 
-    op = jnp.stack([op[0], op[1], deref(op[2]), deref(op[3]),
-                    deref(op[4]), deref(op[5]), op[6]])
+    # Only SOCKET-SLOT operands may be same-batch references; derefing
+    # every word would corrupt legitimate negative scalars (e.g. an
+    # app-chosen negative timer tag). Slot operands by opcode: word 2
+    # for WRITE/SENDTO/CLOSE — opens return slots, they never take them.
+    slot_op = (code == OP_TCP_WRITE) | (code == OP_UDP_SENDTO) | \
+              (code == OP_CLOSE)
+    op = jnp.stack([op[0], op[1],
+                    jnp.where(slot_op, deref(op[2]), op[2]),
+                    op[3], op[4], op[5], op[6]])
 
     def op_nop(r):
         return r, _I32(-1)
@@ -122,10 +130,10 @@ def _apply_one(hosts, hp, sh, op, results):
         return r, _I32(0)
 
     def op_timer(r):
-        wake = (jnp.zeros((P.PKT_WORDS,), _I32)
-                .at[P.ACK].set(_I32(WAKE_TIMER))
-                .at[P.SEQ].set(_I32(-1))
-                .at[P.AUX].set(op[3].astype(_I32)))
+        wake = rset(rset(rset(jnp.zeros((P.PKT_WORDS,), _I32),
+                              P.ACK, _I32(WAKE_TIMER)),
+                         P.SEQ, _I32(-1)),
+                    P.AUX, op[3].astype(_I32))
         r = equeue.q_push(r, op[2], EV_APP, wake)
         return r, _I32(0)
 
@@ -144,8 +152,7 @@ def _udp_open_bridge(row, port):
     row, slot, ok = sock_alloc(row, P.PROTO_UDP)
     row, ep = alloc_eport(row)
     p = jnp.where(port > 0, port, ep)
-    row = row.replace(sk_lport=row.sk_lport.at[slot].set(
-        jnp.where(ok, p, row.sk_lport[slot])))
+    row = row.replace(sk_lport=rset_where(row.sk_lport, slot, ok, p))
     return row, slot, ok
 
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..core.rowops import radd, rget, rset, rset_where
 from ..core.simtime import SIMTIME_ONE_SECOND
 from ..engine import equeue
 from ..engine.defs import (EV_NIC_TX, EV_PKT, ST_PKTS_SENT, ST_PKTS_DROP_BUF,
@@ -49,9 +50,9 @@ def txq_push(row, pkt):
     ok = row.txq_cnt < T
     slot = (row.txq_head + row.txq_cnt) % T
     return row.replace(
-        txq_pkt=row.txq_pkt.at[slot].set(jnp.where(ok, pkt, row.txq_pkt[slot])),
+        txq_pkt=rset_where(row.txq_pkt, slot, ok, pkt),
         txq_cnt=row.txq_cnt + jnp.where(ok, 1, 0),
-        stats=row.stats.at[ST_TXQ_DROP].add(jnp.where(ok, 0, 1)),
+        stats=radd(row.stats, ST_TXQ_DROP, jnp.where(ok, 0, 1)),
     )
 
 
@@ -59,7 +60,7 @@ def emit(row, hp, now, pkt):
     """Hand a packet to the wire: loopback to own queue, or outbox for
     the window-boundary exchange. Stamps the per-source UID that keys
     the topology loss roll."""
-    pkt = pkt.at[P.UID].set(row.pkt_ctr)
+    pkt = rset(pkt, P.UID, row.pkt_ctr)
     is_loop = pkt[P.DST] == hp.hid
 
     def local(r):
@@ -70,14 +71,14 @@ def emit(row, hp, now, pkt):
         ok = cnt < r.ob_time.shape[0]
         slot = jnp.minimum(cnt, r.ob_time.shape[0] - 1)
         return r.replace(
-            ob_pkt=r.ob_pkt.at[slot].set(jnp.where(ok, pkt, r.ob_pkt[slot])),
-            ob_time=r.ob_time.at[slot].set(jnp.where(ok, now, r.ob_time[slot])),
+            ob_pkt=rset_where(r.ob_pkt, slot, ok, pkt),
+            ob_time=rset_where(r.ob_time, slot, ok, now),
             ob_cnt=cnt + jnp.where(ok, 1, 0),
-            stats=r.stats.at[ST_OUTBOX_DROP].add(jnp.where(ok, 0, 1)),
+            stats=radd(r.stats, ST_OUTBOX_DROP, jnp.where(ok, 0, 1)),
         )
 
     row = jax.lax.cond(is_loop, local, remote, row)
-    return row.replace(stats=row.stats.at[ST_PKTS_SENT].add(1),
+    return row.replace(stats=radd(row.stats, ST_PKTS_SENT, 1),
                        pkt_ctr=row.pkt_ctr + 1)
 
 
@@ -124,13 +125,15 @@ def _tx_pull(row, hp, sh, now):
     from .tcp import tcp_pull
     want = tx_want(row)
     S = want.shape[0]
-    order = (jnp.arange(S) + row.nic_rr) % S
-    sock = order[jnp.argmax(want[order])]
+    # round-robin pick: the wanting socket with the smallest rotated
+    # priority (elementwise + argmin; no gathers)
+    prio = (jnp.arange(S) - row.nic_rr) % S
+    sock = jnp.argmin(jnp.where(want, prio, S))
     ring_has = row.txq_cnt > 0
 
     def pull_ring(r):
         T = r.txq_pkt.shape[0]
-        out = r.txq_pkt[r.txq_head]
+        out = rget(r.txq_pkt, r.txq_head)
         r = r.replace(txq_head=(r.txq_head + 1) % T, txq_cnt=r.txq_cnt - 1)
         return r, out, jnp.bool_(True)
 
@@ -186,6 +189,6 @@ def rx_admit(row, hp, now, pkt):
     new_until = jnp.maximum(row.nic_rx_until, now) + tx_duration(wire, bw)
     row = row.replace(
         nic_rx_until=jnp.where(keep, new_until, row.nic_rx_until),
-        stats=row.stats.at[ST_PKTS_DROP_BUF].add(jnp.where(keep, 0, 1)),
+        stats=radd(row.stats, ST_PKTS_DROP_BUF, jnp.where(keep, 0, 1)),
     )
     return row, keep

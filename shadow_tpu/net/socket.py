@@ -12,6 +12,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..core.constants import MIN_RANDOM_PORT, MAX_PORT, SEND_BUFFER_SIZE, RECV_BUFFER_SIZE, TCP_RTO_INIT
+from ..core.rowops import radd, rset, rset_where
 from .packet import PROTO_TCP, PROTO_UDP
 
 # TCP states — same machine as the reference's 11 states (shd-tcp.c:10-15).
@@ -43,7 +44,7 @@ def sock_alloc(row, proto):
     slot = jnp.argmax(free)
 
     def setf(arr, val, dt):
-        return arr.at[slot].set(jnp.where(ok, jnp.asarray(val, dt), arr[slot]))
+        return rset_where(arr, slot, ok, jnp.asarray(val, dt))
 
     row = row.replace(
         sk_used=setf(row.sk_used, True, jnp.bool_),
@@ -72,7 +73,7 @@ def sock_alloc(row, proto):
         sk_rto=setf(row.sk_rto, TCP_RTO_INIT, jnp.int64),
         sk_rto_deadline=setf(row.sk_rto_deadline, 0, jnp.int64),
         sk_timer_on=setf(row.sk_timer_on, False, jnp.bool_),
-        sk_timer_gen=row.sk_timer_gen.at[slot].add(jnp.where(ok, 1, 0)),
+        sk_timer_gen=radd(row.sk_timer_gen, slot, jnp.where(ok, 1, 0)),
         sk_dupacks=setf(row.sk_dupacks, 0, jnp.int32),
         sk_rtt_seq=setf(row.sk_rtt_seq, -1, jnp.int64),
         sk_rtt_time=setf(row.sk_rtt_time, 0, jnp.int64),
@@ -92,13 +93,13 @@ def sock_alloc(row, proto):
 def sock_free(row, slot):
     """Release a socket row (descriptor close)."""
     return row.replace(
-        sk_used=row.sk_used.at[slot].set(False),
-        sk_proto=row.sk_proto.at[slot].set(0),
-        sk_state=row.sk_state.at[slot].set(TCPS_CLOSED),
-        sk_ctl=row.sk_ctl.at[slot].set(0),
-        sk_rto_deadline=row.sk_rto_deadline.at[slot].set(0),
-        sk_timer_on=row.sk_timer_on.at[slot].set(False),
-        sk_timer_gen=row.sk_timer_gen.at[slot].add(1),
+        sk_used=rset(row.sk_used, slot, False),
+        sk_proto=rset(row.sk_proto, slot, 0),
+        sk_state=rset(row.sk_state, slot, TCPS_CLOSED),
+        sk_ctl=rset(row.sk_ctl, slot, 0),
+        sk_rto_deadline=rset(row.sk_rto_deadline, slot, 0),
+        sk_timer_on=rset(row.sk_timer_on, slot, False),
+        sk_timer_gen=radd(row.sk_timer_gen, slot, 1),
     )
 
 

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 
 from ..core.constants import (TCP_MSS, TCP_RTO_MIN, TCP_RTO_MAX,
                               TCP_CLOSE_TIMER_DELAY)
+from ..core.rowops import radd, rget, rset
 from ..engine import equeue
 from ..engine.defs import (EV_APP, EV_TCP_TIMER, EV_TCP_CLOSE,
                            WAKE_CONNECTED, WAKE_ACCEPT, WAKE_SOCKET,
@@ -61,19 +62,21 @@ AUX_FINACK = 1
 
 
 def _set(row, slot, **kw):
-    """Set row.<field>[slot] = value for each kwarg."""
+    """Set row.<field>[slot] = value for each kwarg (one-hot writes:
+    scatters here shattered the window program into unfusable kernels,
+    see core.rowops)."""
     return row.replace(
-        **{f: getattr(row, f).at[slot].set(v) for f, v in kw.items()})
+        **{f: rset(getattr(row, f), slot, v) for f, v in kw.items()})
 
 
 def _wake(row, now, reason, slot, pkt=None, ln=0, aux=0):
     """Schedule an EV_APP notification — the vectorized analogue of the
     epoll-notify -> process_continue reentry (shd-epoll.c:597-658)."""
     w = jnp.zeros((P.PKT_WORDS,), _I32) if pkt is None else pkt
-    w = (w.at[P.ACK].set(_I32(reason))
-          .at[P.SEQ].set(_I32(slot))
-          .at[P.LEN].set(_I32(ln))
-          .at[P.AUX].set(_I32(aux)))
+    w = rset(w, P.ACK, _I32(reason))
+    w = rset(w, P.SEQ, _I32(slot))
+    w = rset(w, P.LEN, _I32(ln))
+    w = rset(w, P.AUX, _I32(aux))
     return equeue.q_push(row, now + 1, EV_APP, w)
 
 
@@ -84,14 +87,13 @@ def _arm_timer(row, slot, now):
     flight we only move the desired deadline and the handler re-chains
     (the reference's desiredTimerExpiration check, shd-tcp.c:1091-1100).
     """
-    deadline = now + row.sk_rto[slot]
-    need_event = ~row.sk_timer_on[slot]
+    deadline = now + rget(row.sk_rto, slot)
+    need_event = ~rget(row.sk_timer_on, slot)
 
     def push(r):
         ok = equeue.q_has_free(r)
-        ev = (jnp.zeros((P.PKT_WORDS,), _I32)
-              .at[P.SEQ].set(_I32(slot))
-              .at[P.ACK].set(r.sk_timer_gen[slot]))
+        ev = rset(rset(jnp.zeros((P.PKT_WORDS,), _I32), P.SEQ,
+                       _I32(slot)), P.ACK, rget(r.sk_timer_gen, slot))
         r = equeue.q_push(r, deadline, EV_TCP_TIMER, ev)
         # only mark armed if the push landed (full queue = lost wakeup)
         return _set(r, slot, sk_timer_on=ok)
@@ -110,8 +112,8 @@ def tcp_listen(row, port):
     """Create a listening socket on `port`. Returns (row, slot, ok)."""
     row, slot, ok = sock_alloc(row, P.PROTO_TCP)
     row = _set(row, slot,
-               sk_state=jnp.where(ok, TCPS_LISTEN, row.sk_state[slot]),
-               sk_lport=jnp.where(ok, _I32(port), row.sk_lport[slot]))
+               sk_state=jnp.where(ok, TCPS_LISTEN, rget(row.sk_state, slot)),
+               sk_lport=jnp.where(ok, _I32(port), rget(row.sk_lport, slot)))
     return row, slot, ok
 
 
@@ -139,7 +141,7 @@ def tcp_connect(row, hp, sh, now, dst_host, dst_port, tag=0):
 
     row = jax.lax.cond(ok, setup,
                        lambda r: r.replace(
-                           stats=r.stats.at[ST_SOCK_FAIL].add(1)), row)
+                           stats=radd(r.stats, ST_SOCK_FAIL, 1)), row)
     return row, slot, ok
 
 
@@ -147,14 +149,14 @@ def tcp_write(row, now, slot, nbytes):
     """App writes `nbytes` to the stream (payload is not materialized;
     only byte counts flow, as with all modeled apps)."""
     row = _set(row, slot,
-               sk_snd_end=row.sk_snd_end[slot] + _I64(nbytes))
+               sk_snd_end=rget(row.sk_snd_end, slot) + _I64(nbytes))
     return nic.kick(row, now)
 
 
 def tcp_close_call(row, now, slot):
     """App close: FIN after in-flight data drains (close_after), or
     immediate teardown for listeners/unconnected sockets."""
-    state = row.sk_state[slot]
+    state = rget(row.sk_state, slot)
     instant = ((state == TCPS_LISTEN) | (state == TCPS_CLOSED) |
                (state == TCPS_SYN_SENT) | (state == TCPS_SYN_RECEIVED))
 
@@ -172,8 +174,8 @@ def tcp_close_call(row, now, slot):
 
 def _win_bytes(row, slot):
     """Effective send window: min(cwnd, peer advertised window)."""
-    cw = (row.sk_cwnd[slot].astype(_I64)) * TCP_MSS
-    return jnp.minimum(cw, jnp.maximum(row.sk_peer_rwnd[slot], 1))
+    cw = (rget(row.sk_cwnd, slot).astype(_I64)) * TCP_MSS
+    return jnp.minimum(cw, jnp.maximum(rget(row.sk_peer_rwnd, slot), 1))
 
 
 def _fin_wait_states(state):
@@ -210,16 +212,16 @@ def tcp_want_tx(row):
 
 
 def _finack_aux(row, slot):
-    pf = row.sk_peer_fin[slot]
-    got_fin = (pf >= 0) & (row.sk_rcv_nxt[slot] >= pf)
+    pf = rget(row.sk_peer_fin, slot)
+    got_fin = (pf >= 0) & (rget(row.sk_rcv_nxt, slot) >= pf)
     aux = jnp.where(got_fin, AUX_FINACK, 0).astype(_I32)
     # SACK block (single-hole scoreboard): bits 1-15 = hole size in MSS
     # units (gap between rcv_nxt and the out-of-order range), bits
     # 16-30 = sacked length in MSS units. Zero length = no block.
-    ooo_s = row.sk_ooo_start[slot]
-    ooo_e = row.sk_ooo_end[slot]
+    ooo_s = rget(row.sk_ooo_start, slot)
+    ooo_e = rget(row.sk_ooo_end, slot)
     has = ooo_s >= 0
-    rel = jnp.clip((ooo_s - row.sk_rcv_nxt[slot]) // TCP_MSS, 0, 0x7FFF)
+    rel = jnp.clip((ooo_s - rget(row.sk_rcv_nxt, slot)) // TCP_MSS, 0, 0x7FFF)
     lnm = jnp.clip((ooo_e - ooo_s + TCP_MSS - 1) // TCP_MSS, 1, 0x7FFF)
     sack = ((rel.astype(_I32) << 1) | (lnm.astype(_I32) << 16))
     return aux | jnp.where(has, sack, 0)
@@ -229,24 +231,24 @@ def tcp_pull(row, hp, sh, now, slot):
     """NIC pull: produce this socket's next packet (one per TX event).
     Priority: RST > SYN > SYNACK > data > FIN > pure ACK.
     Returns (row, pkt, has_pkt)."""
-    state = row.sk_state[slot]
-    ctl = row.sk_ctl[slot]
+    state = rget(row.sk_state, slot)
+    ctl = rget(row.sk_ctl, slot)
     open_tx = (state == TCPS_ESTABLISHED) | (state == TCPS_CLOSE_WAIT)
 
-    snd_nxt = row.sk_snd_nxt[slot]
-    snd_end = row.sk_snd_end[slot]
-    limit = row.sk_snd_una[slot] + _win_bytes(row, slot)
+    snd_nxt = rget(row.sk_snd_nxt, slot)
+    snd_end = rget(row.sk_snd_end, slot)
+    limit = rget(row.sk_snd_una, slot) + _win_bytes(row, slot)
     # fast retransmission runs on its own cursor (the reference's
     # scoreboard next-retransmit selection, shd-tcp-scoreboard.c:271):
     # snd_nxt is NOT rewound, so recovery resends only the hole
     data_tx = _data_tx_states(state)
-    hole_end = row.sk_hole_end[slot]
-    rex_nxt = row.sk_rex_nxt[slot]
+    hole_end = rget(row.sk_hole_end, slot)
+    rex_nxt = rget(row.sk_rex_nxt, slot)
     rex_pending = data_tx & (hole_end > 0) & (rex_nxt < hole_end)
     can_new = data_tx & (snd_nxt < snd_end) & (snd_nxt < limit)
     can_data = rex_pending | can_new
 
-    fin_first = (open_tx & row.sk_close_after[slot] & (snd_nxt == snd_end))
+    fin_first = (open_tx & rget(row.sk_close_after, slot) & (snd_nxt == snd_end))
     fin_rexmit = ((ctl & CTL_FIN) != 0) & _fin_wait_states(state)
 
     p_rst = (ctl & CTL_RST) != 0
@@ -265,8 +267,8 @@ def tcp_pull(row, hp, sh, now, slot):
 
     # common header
     base_flags = _I32(P.PROTO_TCP)
-    ack_no = row.sk_rcv_nxt[slot].astype(_I32)
-    wnd = jnp.minimum(row.sk_rcvbuf[slot], _I64(2**31 - 1)).astype(_I32)
+    ack_no = rget(row.sk_rcv_nxt, slot).astype(_I32)
+    wnd = jnp.minimum(rget(row.sk_rcvbuf, slot), _I64(2**31 - 1)).astype(_I32)
     aux = _finack_aux(row, slot)
 
     ln = jnp.where(sel == 3,
@@ -285,11 +287,11 @@ def tcp_pull(row, hp, sh, now, slot):
     flags = flags | jnp.where(sel == 4, P.F_FIN, 0)
     flags = flags | jnp.where((sel == 2) | (sel >= 3), P.F_ACK, 0)
 
-    pkt = P.make(src=hp.hid, dst=row.sk_rhost[slot],
-                 sport=row.sk_lport[slot], dport=row.sk_rport[slot],
+    pkt = P.make(src=hp.hid, dst=rget(row.sk_rhost, slot),
+                 sport=rget(row.sk_lport, slot), dport=rget(row.sk_rport, slot),
                  flags=flags, seq=seq, ack=ack_no, wnd=wnd, length=ln,
                  aux=aux,
-                 app=jnp.where(sel == 1, row.sk_syn_tag[slot], 0))
+                 app=jnp.where(sel == 1, rget(row.sk_syn_tag, slot), 0))
 
     # --- state updates per selection ---
     # clear the control bit we served; any ACK-bearing send satisfies ACKNOW
@@ -304,26 +306,26 @@ def tcp_pull(row, hp, sh, now, slot):
     # data accounting: fresh transmission vs retransmission, RTT timing
     is_data = sel == 3
     is_rex = is_data & rex_pending
-    snd_max = row.sk_snd_max[slot]
+    snd_max = rget(row.sk_snd_max, slot)
     new_nxt = snd_nxt + ln.astype(_I64)
     advance = is_data & ~is_rex & (new_nxt > snd_max)
     # go-back-N after RTO also resends through snd_nxt < snd_max
     gbn = is_data & ~is_rex & (snd_nxt < snd_max)
     fresh_bytes = jnp.where(advance, new_nxt - jnp.maximum(snd_max, snd_nxt),
                             0)
-    row = row.replace(stats=row.stats
-                      .at[ST_BYTES_SENT].add(fresh_bytes)
-                      .at[ST_RETRANSMIT].add(jnp.where(is_rex | gbn, 1, 0)))
-    time_it = is_data & ~is_rex & ~gbn & (row.sk_rtt_seq[slot] < 0)
+    row = row.replace(stats=radd(radd(row.stats, ST_BYTES_SENT,
+                                      fresh_bytes), ST_RETRANSMIT,
+                                 jnp.where(is_rex | gbn, 1, 0)))
+    time_it = is_data & ~is_rex & ~gbn & (rget(row.sk_rtt_seq, slot) < 0)
     row = _set(row, slot,
                sk_snd_nxt=jnp.where(is_data & ~is_rex, new_nxt, snd_nxt),
                sk_rex_nxt=jnp.where(is_rex, rex_nxt + ln.astype(_I64),
                                     rex_nxt),
                sk_snd_max=jnp.where(advance, new_nxt, snd_max),
                sk_rtt_seq=jnp.where(time_it, new_nxt,
-                                    row.sk_rtt_seq[slot]),
+                                    rget(row.sk_rtt_seq, slot)),
                sk_rtt_time=jnp.where(time_it, now,
-                                     row.sk_rtt_time[slot]))
+                                     rget(row.sk_rtt_time, slot)))
 
     # FIN send transitions: EST -> FIN_WAIT_1, CLOSE_WAIT -> LAST_ACK
     is_fin = sel == 4
@@ -380,7 +382,7 @@ def _accept_syn(row, hp, sh, now, lslot, pkt):
 
     return jax.lax.cond(ok, setup,
                         lambda r: r.replace(
-                            stats=r.stats.at[ST_SOCK_FAIL].add(1)), row)
+                            stats=radd(r.stats, ST_SOCK_FAIL, 1)), row)
 
 
 def _rx_conn(row, hp, sh, now, slot, pkt):
@@ -399,7 +401,7 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
     sack_rel = ((pkt[P.AUX] >> 1) & 0x7FFF).astype(_I64)
     sack_len = ((pkt[P.AUX] >> 16) & 0x7FFF).astype(_I64)
 
-    state0 = row.sk_state[slot]
+    state0 = rget(row.sk_state, slot)
 
     # --- A. establishment ---
     estA = (state0 == TCPS_SYN_SENT) & syn & ackf       # our SYN answered
@@ -407,20 +409,20 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
     resyn = (state0 == TCPS_SYN_RECEIVED) & syn & ~ackf  # dup SYN: re-answer
     state1 = jnp.where(estA | estB, TCPS_ESTABLISHED, state0).astype(_I32)
 
-    hs_rtt = now - row.sk_hs_time[slot]
-    hs_srtt, hs_rttvar, hs_rto = _rfc6298(row.sk_srtt[slot],
-                                          row.sk_rttvar[slot], hs_rtt)
+    hs_rtt = now - rget(row.sk_hs_time, slot)
+    hs_srtt, hs_rttvar, hs_rto = _rfc6298(rget(row.sk_srtt, slot),
+                                          rget(row.sk_rttvar, slot), hs_rtt)
     est = estA | estB
     row = _set(row, slot,
                sk_state=state1,
-               sk_ctl=row.sk_ctl[slot]
+               sk_ctl=rget(row.sk_ctl, slot)
                | jnp.where(estA, CTL_ACKNOW, 0)
                | jnp.where(resyn, CTL_SYNACK, 0),
-               sk_srtt=jnp.where(est, hs_srtt, row.sk_srtt[slot]),
-               sk_rttvar=jnp.where(est, hs_rttvar, row.sk_rttvar[slot]),
-               sk_rto=jnp.where(est, hs_rto, row.sk_rto[slot]),
+               sk_srtt=jnp.where(est, hs_srtt, rget(row.sk_srtt, slot)),
+               sk_rttvar=jnp.where(est, hs_rttvar, rget(row.sk_rttvar, slot)),
+               sk_rto=jnp.where(est, hs_rto, rget(row.sk_rto, slot)),
                sk_rto_deadline=jnp.where(est, _I64(0),
-                                         row.sk_rto_deadline[slot]))
+                                         rget(row.sk_rto_deadline, slot)))
     row = jax.lax.cond(
         est,
         lambda r: _wake(r, now,
@@ -431,29 +433,29 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
     # --- B. ACK processing ---
     conn = state1 >= TCPS_ESTABLISHED
     valid_ack = ackf & conn
-    snd_una0 = row.sk_snd_una[slot]
-    snd_end = row.sk_snd_end[slot]
+    snd_una0 = rget(row.sk_snd_una, slot)
+    snd_end = rget(row.sk_snd_end, slot)
     new_ack = valid_ack & (ackno > snd_una0)
     acked_bytes = jnp.maximum(ackno - snd_una0, 0)
     npkts = (acked_bytes + TCP_MSS - 1) // TCP_MSS
     snd_una1 = jnp.where(new_ack, ackno, snd_una0)
 
     # RTT sample (Karn: only the timed offset, cleared on retransmit)
-    rtt_seq = row.sk_rtt_seq[slot]
+    rtt_seq = rget(row.sk_rtt_seq, slot)
     sample_ok = new_ack & (rtt_seq >= 0) & (ackno >= rtt_seq)
-    srtt1, rttvar1, rto1 = _rfc6298(row.sk_srtt[slot], row.sk_rttvar[slot],
-                                    jnp.maximum(now - row.sk_rtt_time[slot],
+    srtt1, rttvar1, rto1 = _rfc6298(rget(row.sk_srtt, slot), rget(row.sk_rttvar, slot),
+                                    jnp.maximum(now - rget(row.sk_rtt_time, slot),
                                                 1))
     # congestion: avoidance on new acks, loss on the 3rd dupack
     dup = (valid_ack & (ackno == snd_una0) & (ln == 0) & ~syn & ~fin &
-           (row.sk_snd_nxt[slot] > snd_una0))
+           (rget(row.sk_snd_nxt, slot) > snd_una0))
     dupacks1 = jnp.where(new_ack, 0,
-                         row.sk_dupacks[slot] + jnp.where(dup, 1, 0))
+                         rget(row.sk_dupacks, slot) + jnp.where(dup, 1, 0))
     fast_rx = dup & (dupacks1 == 3)
 
-    cw0, ss0 = row.sk_cwnd[slot], row.sk_ssthresh[slot]
-    wm0, ep0, k0 = (row.sk_cc_wmax[slot], row.sk_cc_epoch[slot],
-                    row.sk_cc_k[slot])
+    cw0, ss0 = rget(row.sk_cwnd, slot), rget(row.sk_ssthresh, slot)
+    wm0, ep0, k0 = (rget(row.sk_cc_wmax, slot), rget(row.sk_cc_epoch, slot),
+                    rget(row.sk_cc_k, slot))
     cw_a, ep_a, k_a = CC.on_ack(sh.cc_kind, cw0, ss0, wm0, ep0, k0,
                                 npkts, now)
     cw_l, ss_l, wm_l, ep_l = CC.on_loss(sh.cc_kind, cw0, ss0, wm0)
@@ -464,10 +466,10 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
         sk_dupacks=dupacks1.astype(_I32),
         sk_peer_rwnd=jnp.where(valid_ack,
                                jnp.maximum(pkt[P.WND].astype(_I64), 1),
-                               row.sk_peer_rwnd[slot]),
-        sk_srtt=jnp.where(sample_ok, srtt1, row.sk_srtt[slot]),
-        sk_rttvar=jnp.where(sample_ok, rttvar1, row.sk_rttvar[slot]),
-        sk_rto=jnp.where(sample_ok, rto1, row.sk_rto[slot]),
+                               rget(row.sk_peer_rwnd, slot)),
+        sk_srtt=jnp.where(sample_ok, srtt1, rget(row.sk_srtt, slot)),
+        sk_rttvar=jnp.where(sample_ok, rttvar1, rget(row.sk_rttvar, slot)),
+        sk_rto=jnp.where(sample_ok, rto1, rget(row.sk_rto, slot)),
         sk_rtt_seq=jnp.where(sample_ok, _I64(-1), rtt_seq),
         sk_cwnd=jnp.where(fast_rx, cw_l, jnp.where(new_ack, cw_a, cw0)),
         sk_ssthresh=jnp.where(fast_rx, ss_l, ss0),
@@ -484,21 +486,21 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
             fast_rx,
             jnp.where(sack_len > 0,
                       jnp.minimum(ackno + sack_rel * TCP_MSS,
-                                  row.sk_snd_max[slot]),
+                                  rget(row.sk_snd_max, slot)),
                       jnp.minimum(ackno + TCP_MSS,
-                                  row.sk_snd_max[slot])),
-            jnp.where(new_ack & (ackno >= row.sk_hole_end[slot]),
-                      _I64(0), row.sk_hole_end[slot])),
+                                  rget(row.sk_snd_max, slot))),
+            jnp.where(new_ack & (ackno >= rget(row.sk_hole_end, slot)),
+                      _I64(0), rget(row.sk_hole_end, slot))),
         sk_rex_nxt=jnp.where(fast_rx, ackno,
                              jnp.where(new_ack,
-                                       jnp.maximum(row.sk_rex_nxt[slot],
+                                       jnp.maximum(rget(row.sk_rex_nxt, slot),
                                                    ackno),
-                                       row.sk_rex_nxt[slot])),
+                                       rget(row.sk_rex_nxt, slot))),
     )
 
     # our FIN acked?
     fin_done = valid_ack & finack & (ackno >= snd_end)
-    fin_acked1 = row.sk_fin_acked[slot] | fin_done
+    fin_acked1 = rget(row.sk_fin_acked, slot) | fin_done
     state2 = jnp.where(fin_acked1 & (state1 == TCPS_FIN_WAIT_1),
                        TCPS_FIN_WAIT_2,
               jnp.where(fin_acked1 & (state1 == TCPS_CLOSING),
@@ -508,11 +510,11 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
     row = _set(row, slot, sk_fin_acked=fin_acked1, sk_state=state2)
 
     # restart/stop the retransmission timer on forward progress
-    flight = ((row.sk_snd_nxt[slot] > snd_una1) |
+    flight = ((rget(row.sk_snd_nxt, slot) > snd_una1) |
               (_fin_wait_states(state2) & ~fin_acked1))
     row = _set(row, slot, sk_rto_deadline=jnp.where(
-        valid_ack, jnp.where(flight, now + row.sk_rto[slot], _I64(0)),
-        row.sk_rto_deadline[slot]))
+        valid_ack, jnp.where(flight, now + rget(row.sk_rto, slot), _I64(0)),
+        rget(row.sk_rto_deadline, slot)))
 
     # all-written-bytes-acked notification
     sent_all = new_ack & (ackno >= snd_end) & (snd_end > 0)
@@ -528,9 +530,9 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
     can_rx = ((state2 == TCPS_ESTABLISHED) | (state2 == TCPS_FIN_WAIT_1) |
               (state2 == TCPS_FIN_WAIT_2))
     has_data = (ln > 0) & can_rx
-    rcv0 = row.sk_rcv_nxt[slot]
-    ooo_s0 = row.sk_ooo_start[slot]
-    ooo_e0 = row.sk_ooo_end[slot]
+    rcv0 = rget(row.sk_rcv_nxt, slot)
+    ooo_s0 = rget(row.sk_ooo_start, slot)
+    ooo_e0 = rget(row.sk_ooo_end, slot)
     seg_end = seq + ln
 
     in_order = has_data & (seq <= rcv0) & (seg_end > rcv0)
@@ -560,9 +562,9 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
                sk_rcv_nxt=rcv1,
                sk_ooo_start=ooo_s2,
                sk_ooo_end=ooo_e2,
-               sk_ctl=row.sk_ctl[slot] |
+               sk_ctl=rget(row.sk_ctl, slot) |
                jnp.where((ln > 0) | fin, CTL_ACKNOW, 0))
-    row = row.replace(stats=row.stats.at[ST_BYTES_RECV].add(delivered))
+    row = row.replace(stats=radd(row.stats, ST_BYTES_RECV, delivered))
     row = jax.lax.cond(
         delivered > 0,
         lambda r: _wake(r, now, WAKE_SOCKET, slot, pkt=pkt,
@@ -575,8 +577,8 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
     # retransmission that fills the hole also delivers the EOF (state
     # transitions make the wake fire exactly once).
     fin_valid = fin & (state2 >= TCPS_ESTABLISHED)
-    peer_fin1 = jnp.where(fin_valid & (row.sk_peer_fin[slot] < 0), seq,
-                          row.sk_peer_fin[slot])
+    peer_fin1 = jnp.where(fin_valid & (rget(row.sk_peer_fin, slot) < 0), seq,
+                          rget(row.sk_peer_fin, slot))
     fin_complete = (peer_fin1 >= 0) & (rcv1 >= peer_fin1)
     eof_now = fin_complete & ((state2 == TCPS_ESTABLISHED) |
                               (state2 == TCPS_FIN_WAIT_1) |
@@ -596,9 +598,8 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
     to_time_wait = (state3 == TCPS_TIME_WAIT) & (state0 != TCPS_TIME_WAIT)
 
     def sched_close(r):
-        ev = (jnp.zeros((P.PKT_WORDS,), _I32)
-              .at[P.SEQ].set(_I32(slot))
-              .at[P.ACK].set(r.sk_timer_gen[slot]))
+        ev = rset(rset(jnp.zeros((P.PKT_WORDS,), _I32), P.SEQ,
+                       _I32(slot)), P.ACK, rget(r.sk_timer_gen, slot))
         r = equeue.q_push(r, now + TCP_CLOSE_TIMER_DELAY, EV_TCP_CLOSE, ev)
         return _stop_timer(r, slot)
 
@@ -616,7 +617,7 @@ def tcp_rx(row, hp, sh, now, slot, pkt):
     syn = (flags & P.F_SYN) != 0
     ackf = (flags & P.F_ACK) != 0
     rst = (flags & P.F_RST) != 0
-    state = row.sk_state[slot]
+    state = rget(row.sk_state, slot)
 
     def on_rst(r):
         r = jax.lax.cond(state >= TCPS_ESTABLISHED,
@@ -642,48 +643,48 @@ def on_tcp_timer(row, hp, sh, now, wend, ev):
     re-chaining (one outstanding event per socket)."""
     slot = ev[P.SEQ]
     gen = ev[P.ACK]
-    valid = (row.sk_used[slot] & (gen == row.sk_timer_gen[slot]) &
-             (row.sk_proto[slot] == P.PROTO_TCP))
+    valid = (rget(row.sk_used, slot) & (gen == rget(row.sk_timer_gen, slot)) &
+             (rget(row.sk_proto, slot) == P.PROTO_TCP))
 
     def live(r):
-        deadline = r.sk_rto_deadline[slot]
+        deadline = rget(r.sk_rto_deadline, slot)
 
         def off(rr):
             return _set(rr, slot, sk_timer_on=jnp.bool_(False))
 
         def rechain(rr):
-            ev2 = (jnp.zeros((P.PKT_WORDS,), _I32)
-                   .at[P.SEQ].set(slot).at[P.ACK].set(gen))
+            ev2 = rset(rset(jnp.zeros((P.PKT_WORDS,), _I32), P.SEQ,
+                            slot), P.ACK, gen)
             return equeue.q_push(rr, deadline, EV_TCP_TIMER, ev2)
 
         def expired(rr):
-            state = rr.sk_state[slot]
+            state = rget(rr.sk_state, slot)
             # exponential backoff (rfc6298 5.5, shd-tcp.c:1104-1106)
-            rto2 = jnp.minimum(rr.sk_rto[slot] * 2, TCP_RTO_MAX)
+            rto2 = jnp.minimum(rget(rr.sk_rto, slot) * 2, TCP_RTO_MAX)
             # handshake control resends
-            ctl2 = (rr.sk_ctl[slot]
+            ctl2 = (rget(rr.sk_ctl, slot)
                     | jnp.where(state == TCPS_SYN_SENT, CTL_SYN, 0)
                     | jnp.where(state == TCPS_SYN_RECEIVED, CTL_SYNACK, 0)
                     | jnp.where(_fin_wait_states(state) &
-                                ~rr.sk_fin_acked[slot], CTL_FIN, 0))
+                                ~rget(rr.sk_fin_acked, slot), CTL_FIN, 0))
             # go-back-N: rewind to the oldest unacked offset
-            had_flight = rr.sk_snd_nxt[slot] > rr.sk_snd_una[slot]
+            had_flight = rget(rr.sk_snd_nxt, slot) > rget(rr.sk_snd_una, slot)
             cw_l, ss_l, wm_l, ep_l = CC.on_loss(
-                sh.cc_kind, rr.sk_cwnd[slot], rr.sk_ssthresh[slot],
-                rr.sk_cc_wmax[slot])
+                sh.cc_kind, rget(rr.sk_cwnd, slot), rget(rr.sk_ssthresh, slot),
+                rget(rr.sk_cc_wmax, slot))
             rr = _set(
                 rr, slot,
                 sk_rto=rto2,
                 sk_ctl=ctl2.astype(_I32),
-                sk_snd_nxt=jnp.where(had_flight, rr.sk_snd_una[slot],
-                                     rr.sk_snd_nxt[slot]),
-                sk_cwnd=jnp.where(had_flight, cw_l, rr.sk_cwnd[slot]),
+                sk_snd_nxt=jnp.where(had_flight, rget(rr.sk_snd_una, slot),
+                                     rget(rr.sk_snd_nxt, slot)),
+                sk_cwnd=jnp.where(had_flight, cw_l, rget(rr.sk_cwnd, slot)),
                 sk_ssthresh=jnp.where(had_flight, ss_l,
-                                      rr.sk_ssthresh[slot]),
+                                      rget(rr.sk_ssthresh, slot)),
                 sk_cc_wmax=jnp.where(had_flight, wm_l,
-                                     rr.sk_cc_wmax[slot]),
+                                     rget(rr.sk_cc_wmax, slot)),
                 sk_cc_epoch=jnp.where(had_flight, ep_l,
-                                      rr.sk_cc_epoch[slot]),
+                                      rget(rr.sk_cc_epoch, slot)),
                 sk_hole_end=_I64(0),  # RTO: full go-back-N, no skip
                 sk_rtt_seq=_I64(-1),  # Karn
                 sk_timer_on=jnp.bool_(False),
@@ -704,7 +705,7 @@ def on_tcp_close(row, hp, sh, now, wend, ev):
     (the reference's 60s close timer, shd-tcp.c:439-523)."""
     slot = ev[P.SEQ]
     gen = ev[P.ACK]
-    valid = (row.sk_used[slot] & (gen == row.sk_timer_gen[slot]) &
-             (row.sk_state[slot] == TCPS_TIME_WAIT))
+    valid = (rget(row.sk_used, slot) & (gen == rget(row.sk_timer_gen, slot)) &
+             (rget(row.sk_state, slot) == TCPS_TIME_WAIT))
     return jax.lax.cond(valid, lambda r: sock_free(r, slot),
                         lambda r: r, row)

@@ -14,6 +14,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..core.constants import UDP_MAX_PAYLOAD
+from ..core.rowops import radd, rget, rset, rset_where
 from ..engine import equeue
 from ..engine.defs import EV_APP, WAKE_SOCKET, ST_BYTES_RECV
 from . import nic
@@ -29,8 +30,7 @@ def udp_open(row, port=None):
         row, p = alloc_eport(row)
     else:
         p = jnp.int32(port)
-    row = row.replace(sk_lport=row.sk_lport.at[slot].set(
-        jnp.where(ok, p, row.sk_lport[slot])))
+    row = row.replace(sk_lport=rset_where(row.sk_lport, slot, ok, p))
     return row, slot, ok
 
 
@@ -45,9 +45,10 @@ def udp_sendto(row, hp, now, slot, dst_host, dst_port, nbytes, aux=0):
     (modeled apps send message-sized datagrams).
     """
     length = jnp.minimum(jnp.int64(nbytes), UDP_MAX_PAYLOAD).astype(jnp.int32)
-    pkt = P.make(src=hp.hid, dst=dst_host, sport=row.sk_lport[slot],
+    pkt = P.make(src=hp.hid, dst=dst_host, sport=rget(row.sk_lport, slot),
                  dport=dst_port, flags=P.PROTO_UDP, length=length, aux=aux)
-    row = row.replace(sk_snd_end=row.sk_snd_end.at[slot].add(jnp.int64(length)))
+    row = row.replace(sk_snd_end=radd(row.sk_snd_end, slot,
+                                      jnp.int64(length)))
     row = nic.txq_push(row, pkt)
     return nic.kick(row, now)
 
@@ -61,8 +62,8 @@ def udp_deliver(row, hp, sh, now, slot, pkt):
     process_continue reentry chain (shd-epoll.c:597-658)."""
     length = jnp.int64(pkt[P.LEN])
     row = row.replace(
-        sk_rcv_nxt=row.sk_rcv_nxt.at[slot].add(length),
-        stats=row.stats.at[ST_BYTES_RECV].add(length),
+        sk_rcv_nxt=radd(row.sk_rcv_nxt, slot, length),
+        stats=radd(row.stats, ST_BYTES_RECV, length),
     )
-    wake = pkt.at[P.SEQ].set(jnp.int32(slot)).at[P.ACK].set(WAKE_SOCKET)
+    wake = rset(rset(pkt, P.SEQ, jnp.int32(slot)), P.ACK, WAKE_SOCKET)
     return equeue.q_push(row, now + 1, EV_APP, wake)

@@ -84,19 +84,12 @@ def exchange_sharded(hosts, hp, sh, cfg: EngineConfig,
 
     # Loss roll at the source (keyed by the globally unique (src, uid),
     # so placement-independent — same rolls as the single-chip run).
-    dk = R.domain_key(sh.rng_root, R.DOMAIN_DROP)
-    keys = jax.vmap(jax.random.fold_in, (None, 0))(dk, src)
-    keys = jax.vmap(jax.random.fold_in)(keys, pkts[:, P.UID])
-    u = jax.vmap(jax.random.uniform)(keys)
+    u = R.cheap_uniform(R.stream_of(sh.seed32, R.DOMAIN_DROP, src),
+                        pkts[:, P.UID])
 
     reachable = rel > 0
     deliver = valid & reachable & (u <= rel)
     net_dropped = valid & ~deliver
-
-    stats = hosts.stats
-    stats = stats.at[src - lo, ST_PKTS_DROP_NET].add(
-        jnp.where(net_dropped, 1, 0).astype(jnp.int64), mode="drop")
-    hosts = hosts.replace(stats=stats)
 
     # --- cross-shard hop: gather all shards' surviving traffic ---
     sortkey_l = jnp.where(deliver, dst, H)
@@ -104,66 +97,17 @@ def exchange_sharded(hosts, hp, sh, cfg: EngineConfig,
     g_arr = jax.lax.all_gather(arrival, AXIS).reshape(n_shards * Nl)
     g_pkt = jax.lax.all_gather(pkts, AXIS).reshape(n_shards * Nl,
                                                    P.PKT_WORDS)
-    N = n_shards * Nl
 
-    # identical group-by-destination as the single-chip exchange
+    # identical group-by-destination + gather-based delivery as the
+    # single-chip exchange (engine.window._deliver_dense — ONE
+    # implementation keeps the bit-equality contract)
+    from ..engine.window import _deliver_dense, trace_and_merge
     order = jnp.argsort(g_key, stable=True)
     sdst = g_key[order]
-    first = jnp.searchsorted(sdst, sdst, side="left")
-    rank = jnp.arange(N) - first
-    accept = (sdst < H) & (rank < IN)
-    q_dropped = (sdst < H) & (rank >= IN)
+    hosts, in_pkt, in_time = _deliver_dense(
+        hosts, order, sdst, g_pkt, g_arr, net_dropped, O, IN, lo=lo)
 
-    # keep only packets destined to this shard's host block
-    mine = (sdst >= lo) & (sdst < lo + Hl)
-    tgt = jnp.where(accept & mine, (sdst - lo) * IN + rank, Hl * IN)
-    in_time = jnp.full((Hl * IN,), SIMTIME_MAX, jnp.int64)
-    in_time = in_time.at[tgt].set(g_arr[order], mode="drop")
-    in_pkt = jnp.zeros((Hl * IN, P.PKT_WORDS), jnp.int32)
-    in_pkt = in_pkt.at[tgt].set(g_pkt[order], mode="drop")
-
-    stats = hosts.stats
-    stats = stats.at[jnp.clip(sdst - lo, 0, Hl - 1), ST_PKTS_DROP_Q].add(
-        jnp.where(q_dropped & mine, 1, 0).astype(jnp.int64), mode="drop")
-    hosts = hosts.replace(stats=stats)
-
-    if cfg.tracecap:
-        # same pcap trace points as the single-chip exchange (tx = own
-        # outbox rows, rx = this shard's deliveries); all-local data
-        from ..engine.window import _trace_append
-        ob_valid = jnp.arange(O)[None, :] < hosts.ob_cnt[:, None]
-        hosts = jax.vmap(_trace_append, in_axes=(0, 0, 0, 0, None, 0))(
-            hosts, hosts.ob_pkt, hosts.ob_time, ob_valid, 1, hp.pcap_on)
-        hosts = jax.vmap(_trace_append, in_axes=(0, 0, 0, 0, None, 0))(
-            hosts, in_pkt.reshape(Hl, IN, P.PKT_WORDS),
-            in_time.reshape(Hl, IN),
-            in_time.reshape(Hl, IN) != SIMTIME_MAX, 0, hp.pcap_on)
-
-    # identical headroom reserve as the single-chip merge (bit-equality)
-    reserve = min(8, cfg.qcap // 4)
-
-    def merge(row, ipkt, itime):
-        k = jnp.sum(itime != SIMTIME_MAX).astype(jnp.int32)
-        free = row.eq_time == SIMTIME_MAX
-        nfree = jnp.sum(free).astype(jnp.int32)
-        k2 = jnp.minimum(k, jnp.maximum(nfree - reserve, 0))
-        frank = jnp.cumsum(free) - 1
-        take = free & (frank < k2)
-        j = jnp.clip(frank, 0, IN - 1)
-        overflow = k - k2
-        return row.replace(
-            eq_time=jnp.where(take, itime[j], row.eq_time),
-            eq_kind=jnp.where(take, EV_PKT, row.eq_kind),
-            eq_seq=jnp.where(take, row.eq_ctr + frank.astype(jnp.int32),
-                             row.eq_seq),
-            eq_pkt=jnp.where(take[:, None], ipkt[j], row.eq_pkt),
-            eq_ctr=row.eq_ctr + k2,
-            stats=row.stats.at[ST_PKTS_DROP_Q].add(jnp.int64(overflow)),
-        )
-
-    hosts = jax.vmap(merge)(hosts,
-                            in_pkt.reshape(Hl, IN, P.PKT_WORDS),
-                            in_time.reshape(Hl, IN))
+    hosts = trace_and_merge(hosts, hp, cfg, in_pkt, in_time)
     return hosts.replace(ob_cnt=jnp.zeros_like(hosts.ob_cnt))
 
 

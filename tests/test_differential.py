@@ -1,0 +1,84 @@
+"""Differential tests: compiled array engine vs pure-Python engine.
+
+The TPU realization of the reference's dual-run test pattern (SURVEY
+§4: every test runs natively AND under shadow; agreement = the
+emulation is faithful). Here the same scenario runs under the compiled
+window program and under engine.pyengine's auditable heap loop; the
+per-host stats must match BIT FOR BIT — queues, NIC accounting,
+exchange budgets, loss rolls, RNG streams and window advance all agree
+or some engine behavior diverged.
+"""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario
+from shadow_tpu.engine import defs
+from shadow_tpu.engine.pyengine import PyEngine
+from shadow_tpu.engine.sim import Simulation
+from shadow_tpu.engine.state import EngineConfig
+
+from test_phold import MESH_TOPO, phold_scenario
+
+LOSSY_TOPO = MESH_TOPO.replace('<data key="d9">0.0</data>',
+                               '<data key="d9">0.02</data>')
+
+CFG = dict(qcap=16, scap=4, obcap=8, incap=16, txqcap=8, chunk_windows=8)
+
+COMPARE = [defs.ST_EVENTS, defs.ST_PKTS_SENT, defs.ST_PKTS_RECV,
+           defs.ST_PKTS_DROP_NET, defs.ST_PKTS_DROP_BUF,
+           defs.ST_PKTS_DROP_Q, defs.ST_BYTES_RECV, defs.ST_OUTBOX_DROP,
+           defs.ST_EQ_FULL_LOCAL, defs.ST_TXQ_DROP, defs.ST_RTT_SUM_US,
+           defs.ST_RTT_COUNT, defs.ST_XFER_DONE, defs.ST_APP_DONE,
+           defs.ST_SOCK_FAIL]
+
+
+def _diff(scenario_fn, n_hosts):
+    jax_stats = Simulation(scenario_fn(),
+                           engine_cfg=EngineConfig(num_hosts=n_hosts,
+                                                   **CFG)).run().stats
+    py_stats = PyEngine(Simulation(scenario_fn(),
+                                   engine_cfg=EngineConfig(
+                                       num_hosts=n_hosts, **CFG))).run()
+    for st in COMPARE:
+        assert np.array_equal(jax_stats[:, st], py_stats[:, st]), (
+            f"stat {st} diverges:\n jax={jax_stats[:, st]}\n "
+            f"py={py_stats[:, st]}")
+
+
+def test_differential_ping(simple_topology_xml):
+    def scen():
+        return Scenario(
+            stop_time=8 * 10**9,
+            topology_graphml=simple_topology_xml,
+            hosts=[
+                HostSpec(id="srv", processes=[
+                    ProcessSpec(plugin="pingserver", start_time=10**9,
+                                arguments="port=8000")]),
+                HostSpec(id="cli", processes=[
+                    ProcessSpec(plugin="ping", start_time=2 * 10**9,
+                                arguments="peer=srv port=8000 "
+                                          "interval=700ms size=96 "
+                                          "count=6")]),
+            ],
+        )
+
+    _diff(scen, 2)
+
+
+def test_differential_phold():
+    _diff(lambda: phold_scenario(n=12, stop=4), 12)
+
+
+def test_differential_phold_lossy():
+    def scen():
+        return Scenario(
+            stop_time=4 * 10**9,
+            topology_graphml=LOSSY_TOPO,
+            hosts=[HostSpec(id="node", quantity=12, processes=[
+                ProcessSpec(plugin="phold", start_time=10**9,
+                            arguments="port=9000 mean=150ms size=64 "
+                                      "init=2")])],
+        )
+
+    _diff(scen, 12)
