@@ -1,0 +1,103 @@
+/* Native hosted plugin: UDP ping client in plain C.
+ *
+ * The counterpart of writing a Shadow plugin against libc
+ * (LD_PRELOAD-interposed) in the reference — here the plugin is built
+ * against the explicit shadow_os_api vtable (hosting/cplugin.py) and
+ * every host instance gets its own state struct (the role the
+ * reference's dlmopen linker namespaces played).
+ *
+ * Args: "peer=<hostname> port=<p> count=<n> interval_ms=<ms> size=<b>"
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct {
+    long long (*now)(void* os);
+    double    (*rnd)(void* os);
+    int  (*udp_open)(void* os, int port);
+    int  (*tcp_connect)(void* os, int dst_host, int port, int tag);
+    int  (*tcp_listen)(void* os, int port);
+    void (*send_to)(void* os, int sock, int dst_host, int port,
+                    long long nbytes, int aux);
+    void (*write_sk)(void* os, int sock, long long nbytes);
+    void (*close_sk)(void* os, int sock);
+    void (*timer)(void* os, long long delay_ns, int tag);
+    int  (*resolve)(void* os, const char* name);
+} shadow_os_api;
+
+typedef struct {
+    char peer[64];
+    int port, count, size;
+    long long interval_ns;
+    int sock;
+    int sent, echoed;
+} state_t;
+
+static const char* kv(const char* args, const char* key, char* out,
+                      int cap, const char* dflt) {
+    const char* p = strstr(args, key);
+    if (!p) { snprintf(out, cap, "%s", dflt); return out; }
+    p += strlen(key);
+    int i = 0;
+    while (*p && *p != ' ' && i < cap - 1) out[i++] = *p++;
+    out[i] = 0;
+    return out;
+}
+
+void* plugin_create(const char* args) {
+    state_t* st = (state_t*)calloc(1, sizeof(state_t));
+    char buf[64];
+    kv(args, "peer=", st->peer, sizeof(st->peer), "server");
+    st->port = atoi(kv(args, "port=", buf, sizeof(buf), "8000"));
+    st->count = atoi(kv(args, "count=", buf, sizeof(buf), "3"));
+    st->size = atoi(kv(args, "size=", buf, sizeof(buf), "64"));
+    st->interval_ns =
+        atoll(kv(args, "interval_ms=", buf, sizeof(buf), "1000")) *
+        1000000LL;
+    return st;
+}
+
+void plugin_destroy(void* p) { free(p); }
+
+static void send_ping(state_t* st, void* os, const shadow_os_api* api) {
+    int dst = api->resolve(os, st->peer);
+    api->send_to(os, st->sock, dst, st->port, st->size, 4242);
+    st->sent++;
+    if (st->sent < st->count)
+        api->timer(os, st->interval_ns, 0);
+}
+
+/* reasons: 0 start, 1 timer, 2 dgram, 3 connected, 4 eof, 5 accept,
+ * 6 sent */
+void plugin_on_wake(void* p, void* os, const shadow_os_api* api,
+                    int reason, int a, int b, long long c) {
+    state_t* st = (state_t*)p;
+    switch (reason) {
+    case 0:
+        st->sock = api->udp_open(os, 0);
+        send_ping(st, os, api);
+        break;
+    case 1:
+        send_ping(st, os, api);
+        break;
+    case 2:  /* datagram: a=sock handle, b=src host, c=(aux<<32)|len */
+        if ((int)(c >> 32) == 4242) st->echoed++;
+        break;
+    default:
+        break;
+    }
+}
+
+/* test hook: expose counters */
+int plugin_get_sent(void* p) { return ((state_t*)p)->sent; }
+int plugin_get_echoed(void* p) { return ((state_t*)p)->echoed; }
+
+#ifdef __cplusplus
+}
+#endif

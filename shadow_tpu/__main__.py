@@ -97,6 +97,16 @@ def main(argv=None):
                    choices=["error", "warning", "message", "info", "debug"])
     p.add_argument("--tcp-congestion-control", default="cubic",
                    choices=["aimd", "reno", "cubic"])
+    p.add_argument("--interface-qdisc", default="rr",
+                   choices=["fifo", "rr"],
+                   help="NIC socket service discipline")
+    p.add_argument("--cpu-threshold", type=int, default=-1, metavar="US",
+                   help="CPU blocked-delay threshold in microseconds "
+                        "(negative disables; reference default -1)")
+    p.add_argument("--cpu-precision", type=int, default=1, metavar="US",
+                   help="round CPU delays to the nearest microseconds "
+                        "(default 1; the reference's 200 would round "
+                        "the constant modeled event cost to zero)")
     p.add_argument("--pcap-dir", default=None, metavar="DIR",
                    help="write pcap files for hosts with logpcap set")
     p.add_argument("--checkpoint", default=None, metavar="PATH")
@@ -127,6 +137,10 @@ def main(argv=None):
         scenario.stop_time = parse_time(args.stop_time, default_unit="s")
     if args.seed is not None:
         scenario.seed = args.seed
+    scenario.cpu_threshold_ns = (args.cpu_threshold * 1000
+                                 if args.cpu_threshold >= 0 else -1)
+    scenario.cpu_precision_ns = (args.cpu_precision * 1000
+                                 if args.cpu_precision >= 0 else 0)
 
     logger = SimLogger(level=args.log_level)
     logger.message(0, "main", f"shadow_tpu starting: "
@@ -138,6 +152,10 @@ def main(argv=None):
     if cc != sim.cfg.cc_kind:
         import jax.numpy as jnp
         sim.sh = sim.sh.replace(cc_kind=jnp.int32(cc))
+    qd = {"fifo": 0, "rr": 1}[args.interface_qdisc]
+    if qd != sim.cfg.qdisc:
+        import dataclasses
+        sim.cfg = dataclasses.replace(sim.cfg, qdisc=qd)
 
     mesh = None
     if args.workers:

@@ -73,6 +73,16 @@ class Scenario:
     plugins: list = field(default_factory=list)
     bootstrap_end: int = 0
     seed: int = 1
+    # CPU delay model (reference shd-cpu.c; engaged per host by the
+    # <host cpufrequency=...> attribute). Costs are modeled per event.
+    cpu_raw_frequency_khz: int = 3_000_000   # the "physical" CPU
+    cpu_event_cost_ns: int = 10_000          # base cost per event
+    # Precision default diverges from the reference's 200us: their
+    # rounding applies to VARIABLE measured wallclock deltas, ours to a
+    # constant modeled base cost — at 200us every realistic frequency
+    # would round the cost to exactly 0 and silently disable the model.
+    cpu_precision_ns: int = 1_000
+    cpu_threshold_ns: int = -1               # reference default: no block
 
     def total_hosts(self) -> int:
         return sum(h.quantity for h in self.hosts)

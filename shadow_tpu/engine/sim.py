@@ -155,6 +155,10 @@ class Simulation:
         bw_up = np.zeros(H, dtype=np.int64)
         bw_down = np.zeros(H, dtype=np.int64)
         nic_buf = np.full(H, INTERFACE_BUFFER_SIZE, dtype=np.int64)
+        cpu_cost = np.zeros(H, dtype=np.int64)
+        cpu_threshold = np.full(H, -1, dtype=np.int64)
+        rcvbuf0 = np.full(H, -1, dtype=np.int64)   # -1 = autotune
+        sndbuf0 = np.full(H, -1, dtype=np.int64)
         app_kind = np.zeros(H, dtype=np.int32)
         app_cfg = np.zeros((H, 8), dtype=np.int64)
         start_times = np.zeros((H,), dtype=np.int64)
@@ -170,7 +174,30 @@ class Simulation:
             bw_down[idx] = spec.bandwidth_down or self.topo.v_bw_down_bytes[v] or 1 << 40
             if spec.interface_buffer:
                 nic_buf[idx] = spec.interface_buffer
+            if spec.socket_recv_buffer:
+                rcvbuf0[idx] = spec.socket_recv_buffer
+            if spec.socket_send_buffer:
+                sndbuf0[idx] = spec.socket_send_buffer
             pcap_on[idx] = spec.pcap
+            if spec.cpu_frequency:
+                # reference semantics (shd-cpu.c:16-44): cost scales by
+                # rawFrequency / hostFrequency; precision-round here at
+                # build (the device then only adds a constant).
+                ratio = (scenario.cpu_raw_frequency_khz /
+                         max(spec.cpu_frequency, 1))
+                cost = int(scenario.cpu_event_cost_ns * ratio)
+                prec = scenario.cpu_precision_ns
+                if prec and prec > 0:
+                    cost = ((cost + prec // 2) // prec) * prec
+                if cost == 0:
+                    import sys as _sys
+                    _sys.stderr.write(
+                        f"shadow_tpu: warning: host {name!r} sets "
+                        f"cpufrequency but its rounded event cost is 0 "
+                        f"(precision {prec}ns) — CPU model inactive "
+                        "for it\n")
+                cpu_cost[idx] = cost
+                cpu_threshold[idx] = scenario.cpu_threshold_ns
             if spec.processes:
                 # TPU app tier: one process per host for now (multi-process
                 # hosts arrive with the hosting milestone)
@@ -225,8 +252,16 @@ class Simulation:
             app_kind=jnp.asarray(app_kind),
             app_cfg=jnp.asarray(app_cfg),
             nic_buf=jnp.asarray(nic_buf),
+            cpu_cost=jnp.asarray(cpu_cost),
+            cpu_threshold=jnp.asarray(cpu_threshold),
+            rcvbuf0=jnp.asarray(rcvbuf0),
+            sndbuf0=jnp.asarray(sndbuf0),
             pcap_on=jnp.asarray(pcap_on),
         )
+
+        if bool((cpu_cost > 0).any()) and not self.cfg.cpu_model:
+            import dataclasses as _dc
+            self.cfg = _dc.replace(self.cfg, cpu_model=True)
 
         # pcap capture needs the trace ring sized for a window chunk
         if pcap_on.any() and self.cfg.tracecap == 0:
@@ -242,7 +277,8 @@ class Simulation:
                               seed=seed, cc_kind=self.cfg.cc_kind,
                               tgen_nodes=tg_nodes, tgen_peers=tg_peers,
                               tgen_pool=tg_pool,
-                              host_vertex=vertex)
+                              host_vertex=vertex,
+                              host_bw_up=bw_up, host_bw_down=bw_down)
 
         # --- initial events: process starts (reference process_schedule) ---
         hosts = alloc_hosts(self.cfg)
@@ -295,11 +331,25 @@ class Simulation:
                                      jnp.zeros((pad, 8), jnp.int64)]),
             nic_buf=jnp.concatenate([self.hp.nic_buf,
                                      jnp.ones(pad, jnp.int64)]),
+            cpu_cost=jnp.concatenate([self.hp.cpu_cost,
+                                      jnp.zeros(pad, jnp.int64)]),
+            cpu_threshold=jnp.concatenate([self.hp.cpu_threshold,
+                                           jnp.full((pad,), -1,
+                                                    jnp.int64)]),
+            rcvbuf0=jnp.concatenate([self.hp.rcvbuf0,
+                                     jnp.full((pad,), -1, jnp.int64)]),
+            sndbuf0=jnp.concatenate([self.hp.sndbuf0,
+                                     jnp.full((pad,), -1, jnp.int64)]),
             pcap_on=jnp.concatenate([self.hp.pcap_on,
                                      jnp.zeros(pad, jnp.bool_)]),
         )
-        sh = self.sh.replace(host_vertex=jnp.concatenate(
-            [self.sh.host_vertex, jnp.zeros(pad, jnp.int32)]))
+        sh = self.sh.replace(
+            host_vertex=jnp.concatenate(
+                [self.sh.host_vertex, jnp.zeros(pad, jnp.int32)]),
+            host_bw_up=jnp.concatenate(
+                [self.sh.host_bw_up, jnp.ones(pad, jnp.int64)]),
+            host_bw_down=jnp.concatenate(
+                [self.sh.host_bw_down, jnp.ones(pad, jnp.int64)]))
         return hosts, hp, sh, cfg
 
     def run(self, verbose: bool = False, mesh=None, heartbeat_s: float = 0,

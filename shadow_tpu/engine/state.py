@@ -52,6 +52,13 @@ class EngineConfig:
     # unreachable by construction).
     app_kinds: tuple = None  # e.g. (0, 3) — must include 0 (APP_NULL)
     uses_tcp: bool = True
+    qdisc: int = 1          # NIC socket service: 0=fifo, 1=round-robin
+    #   (reference --interface-qdisc, default fifo; rr kept as our
+    #   default for fairness under many concurrent flows)
+    cpu_model: bool = False  # host CPU delay model (net effect only
+    #   when a scenario sets cpu costs; static so the default engine
+    #   compiles none of it — the reference's is also off by default
+    #   (--cpu-threshold [-1], shd-options.c:76)
     tracecap: int = 0       # packet-trace ring slots per host (obs.pcap;
     #   0 disables tracing entirely — the exchange compiles no trace code)
 
@@ -67,6 +74,8 @@ class Hosts:
     eq_ctr: jnp.ndarray    # [H] i32 next sequence number
     # --- per-host RNG use counter (key = fold_in(host_key, rng_ctr)) ---
     rng_ctr: jnp.ndarray   # [H] i32
+    # --- CPU model (reference shd-cpu.c): busy horizon per host ---
+    cpu_avail: jnp.ndarray  # [H] i64 time the CPU becomes available
     # --- NIC (reference shd-network-interface.c bandwidth accounting) ---
     nic_busy: jnp.ndarray      # [H] i64: tx free at this time
     nic_sched: jnp.ndarray     # [H] bool: an EV_NIC_TX event is in flight
@@ -121,6 +130,7 @@ class Hosts:
     sk_sndbuf: jnp.ndarray   # i64
     sk_rcvbuf: jnp.ndarray   # i64
     sk_hs_time: jnp.ndarray  # i64 handshake start (connect timeout/rtt)
+    sk_last_tx: jnp.ndarray  # i64 last NIC service time (fifo qdisc key)
     sk_syn_tag: jnp.ndarray  # i32 connection-metadata tag carried on SYN
     # cubic congestion-control per-socket vars (net.congestion)
     sk_cc_wmax: jnp.ndarray   # f32 window before last loss
@@ -159,6 +169,15 @@ class HostParams:
     app_kind: jnp.ndarray   # [H] i32 which app runs here (apps registry)
     app_cfg: jnp.ndarray    # [H, 8] i64 app static params
     nic_buf: jnp.ndarray    # [H] i64 NIC input buffer bytes
+    cpu_cost: jnp.ndarray   # [H] i64 modeled CPU ns per executed event
+    #   (= base event cost x frequencyRatio, precision-rounded at
+    #   build; the modeled-app stand-in for shd-cpu.c's measured
+    #   wallclock x ratio). 0 = free.
+    cpu_threshold: jnp.ndarray  # [H] i64 blocked-CPU threshold (-1 off)
+    rcvbuf0: jnp.ndarray    # [H] i64 explicit socket recv buffer, or -1
+    #   = autotune from the delay-bandwidth product at establishment
+    #   (reference <host socketrecvbuffer>, shd-tcp.c:340-433)
+    sndbuf0: jnp.ndarray    # [H] i64 explicit send buffer, or -1
     pcap_on: jnp.ndarray    # [H] bool: record this host's packets
     #   (reference <host logpcap=...>, shd-network-interface.c:186-223)
 
@@ -173,6 +192,9 @@ class Shared:
     host_vertex: jnp.ndarray  # [H] i32 host -> topology vertex (replicated
     #   copy of HostParams.vertex: routing needs the vertex of REMOTE
     #   destination hosts, which a host-sharded table cannot provide)
+    host_bw_up: jnp.ndarray    # [H] i64 replicated peer-bandwidth tables
+    host_bw_down: jnp.ndarray  # [H] i64 (TCP buffer autotuning needs the
+    #   REMOTE end's bandwidths, shd-tcp.c:386-404)
     rng_root: jnp.ndarray  # PRNG key (host-side / setup uses)
     seed32: jnp.ndarray    # u32 scalar: root of the cheap counter PRNG
     stop_time: jnp.ndarray  # i64 scalar
@@ -202,6 +224,7 @@ def alloc_hosts(cfg: EngineConfig) -> Hosts:
         eq_pkt=full((H, Q, PKT_WORDS), 0, jnp.int32),
         eq_ctr=full((H,), 0, jnp.int32),
         rng_ctr=full((H,), 0, jnp.int32),
+        cpu_avail=full((H,), 0, jnp.int64),
         nic_busy=full((H,), 0, jnp.int64),
         nic_sched=full((H,), False, jnp.bool_),
         nic_rr=full((H,), 0, jnp.int32),
@@ -246,6 +269,7 @@ def alloc_hosts(cfg: EngineConfig) -> Hosts:
         sk_sndbuf=full((H, S), C.SEND_BUFFER_SIZE, jnp.int64),
         sk_rcvbuf=full((H, S), C.RECV_BUFFER_SIZE, jnp.int64),
         sk_hs_time=full((H, S), 0, jnp.int64),
+        sk_last_tx=full((H, S), 0, jnp.int64),
         sk_syn_tag=full((H, S), 0, jnp.int32),
         sk_cc_wmax=full((H, S), 0.0, jnp.float32),
         sk_cc_epoch=full((H, S), -1, jnp.int64),
@@ -276,9 +300,15 @@ def make_shared(topo_lat_ns: np.ndarray, topo_rel: np.ndarray, rng_root,
                 tgen_nodes: np.ndarray = None,
                 tgen_peers: np.ndarray = None,
                 tgen_pool: np.ndarray = None,
-                host_vertex: np.ndarray = None) -> Shared:
+                host_vertex: np.ndarray = None,
+                host_bw_up: np.ndarray = None,
+                host_bw_down: np.ndarray = None) -> Shared:
     if host_vertex is None:
         host_vertex = np.zeros((1,), np.int32)
+    if host_bw_up is None:
+        host_bw_up = np.ones((1,), np.int64)
+    if host_bw_down is None:
+        host_bw_down = np.ones((1,), np.int64)
     if tgen_nodes is None:
         tgen_nodes = np.zeros((1, 8), np.int64)
     if tgen_peers is None:
@@ -289,6 +319,8 @@ def make_shared(topo_lat_ns: np.ndarray, topo_rel: np.ndarray, rng_root,
         lat_ns=jnp.asarray(topo_lat_ns, dtype=jnp.int64),
         rel=jnp.asarray(topo_rel, dtype=jnp.float32),
         host_vertex=jnp.asarray(host_vertex, dtype=jnp.int32),
+        host_bw_up=jnp.asarray(host_bw_up, dtype=jnp.int64),
+        host_bw_down=jnp.asarray(host_bw_down, dtype=jnp.int64),
         rng_root=rng_root,
         seed32=jnp.uint32(seed & 0xFFFFFFFF),
         stop_time=jnp.int64(stop_time),

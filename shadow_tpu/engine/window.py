@@ -97,10 +97,13 @@ def _make_handlers(cfg: EngineConfig):
 
         return jax.lax.cond(keep, deliver, lambda r: r, row)
 
+    def _on_tx(row, hp, sh, now, wend, pkt):
+        return nic.on_tx(row, hp, sh, now, wend, pkt, qdisc=cfg.qdisc)
+
     if cfg.uses_tcp:
-        return [_on_null, _on_app, _on_pkt, nic.on_tx, on_tcp_timer,
+        return [_on_null, _on_app, _on_pkt, _on_tx, on_tcp_timer,
                 on_tcp_close]
-    return [_on_null, _on_app, _on_pkt, nic.on_tx, _on_null, _on_null]
+    return [_on_null, _on_app, _on_pkt, _on_tx, _on_null, _on_null]
 
 
 def step_one_host(row, hp, sh, wend, cfg: EngineConfig):
@@ -109,9 +112,34 @@ def step_one_host(row, hp, sh, wend, cfg: EngineConfig):
     ready = t < wend
     kind = jnp.where(ready, rget(row.eq_kind, slot), EV_NULL)
     pkt = rget(row.eq_pkt, slot)
+
+    if cfg.cpu_model:
+        # Reference CPU model (shd-cpu.c:55-107 + the blocked-I/O
+        # check in event_execute, shd-event.c:52-81): when the CPU's
+        # built-up delay exceeds the threshold, the event is pushed
+        # forward to when the CPU drains instead of executing now.
+        blocked = (ready & (hp.cpu_threshold >= 0) &
+                   (row.cpu_avail - t > hp.cpu_threshold))
+        retry_at = jnp.maximum(row.cpu_avail, t + 1)
+        row = jax.lax.cond(
+            blocked,
+            lambda r: equeue.q_push(equeue.q_clear_slot(r, slot),
+                                    retry_at, kind, pkt),
+            lambda r: r, row)
+        ready = ready & ~blocked
+        kind = jnp.where(blocked, EV_NULL, kind)
+
     row = jax.lax.cond(ready, lambda r: equeue.q_clear_slot(r, slot),
                        lambda r: r, row)
     row = jax.lax.switch(kind, _make_handlers(cfg), row, hp, sh, t, wend, pkt)
+
+    if cfg.cpu_model:
+        # charge this event's modeled CPU cost to the busy horizon
+        row = row.replace(cpu_avail=jnp.where(
+            ready,
+            jnp.maximum(row.cpu_avail, t) + hp.cpu_cost,
+            row.cpu_avail))
+
     return row.replace(
         stats=radd(row.stats, ST_EVENTS, jnp.where(ready, 1, 0)))
 

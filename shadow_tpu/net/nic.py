@@ -98,9 +98,16 @@ def kick(row, now):
     return jax.lax.cond(need, sched, lambda r: r, row)
 
 
-def on_tx(row, hp, sh, now, wend, pkt):
+QDISC_FIFO = 0   # least-recently-served socket first — a non-starving
+#                  approximation of the reference's FIFO-by-packet-
+#                  priority qdisc (shd-network-interface.c:335-379):
+#                  oldest waiting work wins, no static priorities
+QDISC_RR = 1     # round-robin over wanting sockets
+
+
+def on_tx(row, hp, sh, now, wend, pkt, qdisc=QDISC_RR):
     """EV_NIC_TX handler: pull one packet — transmit ring first (UDP and
-    queued control), else the round-robin-selected TCP socket — emit it,
+    queued control), else the qdisc-selected TCP socket — emit it,
     account bandwidth, reschedule while work remains.
 
     When the outbox (this window's emit budget) is full, transmission is
@@ -118,17 +125,23 @@ def on_tx(row, hp, sh, now, wend, pkt):
         return r.replace(nic_sched=ok)
 
     return jax.lax.cond(no_room, defer,
-                        lambda r: _tx_pull(r, hp, sh, now), row)
+                        lambda r: _tx_pull(r, hp, sh, now, qdisc), row)
 
 
-def _tx_pull(row, hp, sh, now):
+def _tx_pull(row, hp, sh, now, qdisc=QDISC_RR):
     from .tcp import tcp_pull
     want = tx_want(row)
     S = want.shape[0]
-    # round-robin pick: the wanting socket with the smallest rotated
-    # priority (elementwise + argmin; no gathers)
-    prio = (jnp.arange(S) - row.nic_rr) % S
-    sock = jnp.argmin(jnp.where(want, prio, S))
+    if qdisc == QDISC_RR:
+        # round-robin pick: the wanting socket with the smallest
+        # rotated priority (elementwise + argmin; no gathers)
+        prio = (jnp.arange(S) - row.nic_rr) % S
+        sock = jnp.argmin(jnp.where(want, prio, S))
+    else:
+        # FIFO: least recently served first (index as tie-break)
+        key = row.sk_last_tx * S + jnp.arange(S)
+        sock = jnp.argmin(jnp.where(want, key,
+                                    jnp.iinfo(jnp.int64).max))
     ring_has = row.txq_cnt > 0
 
     def pull_ring(r):

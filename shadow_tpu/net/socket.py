@@ -82,6 +82,7 @@ def sock_alloc(row, proto):
         sk_sndbuf=setf(row.sk_sndbuf, SEND_BUFFER_SIZE, jnp.int64),
         sk_rcvbuf=setf(row.sk_rcvbuf, RECV_BUFFER_SIZE, jnp.int64),
         sk_hs_time=setf(row.sk_hs_time, 0, jnp.int64),
+        sk_last_tx=setf(row.sk_last_tx, 0, jnp.int64),
         sk_syn_tag=setf(row.sk_syn_tag, 0, jnp.int32),
         sk_cc_wmax=setf(row.sk_cc_wmax, 0.0, jnp.float32),
         sk_cc_epoch=setf(row.sk_cc_epoch, -1, jnp.int64),

@@ -35,7 +35,8 @@ import jax
 import jax.numpy as jnp
 
 from ..core.constants import (TCP_MSS, TCP_RTO_MIN, TCP_RTO_MAX,
-                              TCP_CLOSE_TIMER_DELAY)
+                              TCP_CLOSE_TIMER_DELAY,
+                              SEND_BUFFER_MIN_SIZE, RECV_BUFFER_MIN_SIZE)
 from ..core.rowops import radd, rget, rset
 from ..engine import equeue
 from ..engine.defs import (EV_APP, EV_TCP_TIMER, EV_TCP_CLOSE,
@@ -301,7 +302,8 @@ def tcp_pull(row, hp, sh, now, slot):
           jnp.where(sel == 4, CTL_FIN, 0))))
     acked_too = (sel == 2) | (sel >= 3)
     clr = clr | jnp.where(acked_too, CTL_ACKNOW, 0)
-    row = _set(row, slot, sk_ctl=ctl & ~clr)
+    row = _set(row, slot, sk_ctl=ctl & ~clr,
+               sk_last_tx=_I64(now))  # fifo qdisc service stamp
 
     # data accounting: fresh transmission vs retransmission, RTT timing
     is_data = sel == 3
@@ -429,6 +431,43 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
                         jnp.where(estA, WAKE_CONNECTED, WAKE_ACCEPT), slot,
                         pkt=pkt),
         lambda r: r, row)
+
+    # --- A2. buffer autotuning at establishment (shd-tcp.c:340-433):
+    # size the buffers to 1.25x the delay-bandwidth product over the
+    # true path (bottleneck of the two ends), min-bounded; loopback
+    # pairs get the reference's 16 MiB. Explicit per-host buffer sizes
+    # (hp.rcvbuf0/sndbuf0 >= 0) disable autotuning, like the
+    # reference's user-set socket buffer options.
+    peer = pkt[P.SRC]
+    v_self = hp.vertex
+    v_peer = sh.host_vertex[jnp.clip(peer, 0,
+                                     sh.host_vertex.shape[0] - 1)]
+    rtt_ns = sh.lat_ns[v_self, v_peer] + sh.lat_ns[v_peer, v_self]
+    peer_up = sh.host_bw_up[jnp.clip(peer, 0,
+                                     sh.host_bw_up.shape[0] - 1)]
+    peer_dn = sh.host_bw_down[jnp.clip(peer, 0,
+                                       sh.host_bw_down.shape[0] - 1)]
+    # clamp bandwidth and compute via microseconds so the product
+    # cannot overflow int64 even for "unlimited" (1<<40 B/s) hosts
+    bw_cap = jnp.int64(1) << 38
+    snd_bw = jnp.minimum(jnp.minimum(hp.bw_up, peer_dn), bw_cap)
+    rcv_bw = jnp.minimum(jnp.minimum(hp.bw_down, peer_up), bw_cap)
+    rtt_us = rtt_ns // 1000
+    buf_cap = jnp.int64(1) << 30
+    sndbuf_auto = jnp.clip((snd_bw * rtt_us // 1_000_000) * 5 // 4,
+                           SEND_BUFFER_MIN_SIZE, buf_cap)
+    rcvbuf_auto = jnp.clip((rcv_bw * rtt_us // 1_000_000) * 5 // 4,
+                           RECV_BUFFER_MIN_SIZE, buf_cap)
+    is_loop = peer == hp.hid
+    sndbuf_auto = jnp.where(is_loop, 16 * 1024 * 1024, sndbuf_auto)
+    rcvbuf_auto = jnp.where(is_loop, 16 * 1024 * 1024, rcvbuf_auto)
+    sndbuf1 = jnp.where(hp.sndbuf0 >= 0, hp.sndbuf0, sndbuf_auto)
+    rcvbuf1 = jnp.where(hp.rcvbuf0 >= 0, hp.rcvbuf0, rcvbuf_auto)
+    row = _set(row, slot,
+               sk_sndbuf=jnp.where(est, sndbuf1,
+                                   rget(row.sk_sndbuf, slot)),
+               sk_rcvbuf=jnp.where(est, rcvbuf1,
+                                   rget(row.sk_rcvbuf, slot)))
 
     # --- B. ACK processing ---
     conn = state1 >= TCPS_ESTABLISHED

@@ -1,0 +1,40 @@
+"""TCP buffer autotuning (reference shd-tcp.c:340-433)."""
+
+import numpy as np
+
+from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario
+from shadow_tpu.engine.sim import Simulation
+from shadow_tpu.engine.state import EngineConfig
+from shadow_tpu.core.constants import RECV_BUFFER_MIN_SIZE
+
+import test_tcp as T
+
+CFG = dict(qcap=64, scap=4, obcap=32, incap=64, chunk_windows=8)
+
+
+def test_autotune_sizes_buffers_from_bdp():
+    # 100ms latency x 50 MiB/s bottleneck -> BDP ~5.2MB; default fixed
+    # buffers (174760) would cap the window far below that.
+    topo = T.poi_topology(bw_down=51200, bw_up=51200, latency_ms=100.0)
+    scen = T.bulk_scenario(topo, size=400_000, count=1, stop=60)
+    sim = Simulation(scen, engine_cfg=EngineConfig(num_hosts=2, **CFG))
+    rep = sim.run()
+    assert rep.summary()["bytes_recv"] == 400_000
+    rcvbuf = np.asarray(sim.final_hosts.sk_rcvbuf)
+    # the server-side child's receive buffer autotuned to ~1.25x BDP
+    # (rtt 200ms x min-bw 52428800 B/s x 1.25 ~ 13.1 MB)
+    assert rcvbuf.max() > 10_000_000, rcvbuf.max()
+
+
+def test_explicit_buffer_disables_autotune():
+    topo = T.poi_topology(bw_down=51200, bw_up=51200, latency_ms=100.0)
+    scen = T.bulk_scenario(topo, size=200_000, count=1, stop=60)
+    for h in scen.hosts:
+        h.socket_recv_buffer = RECV_BUFFER_MIN_SIZE
+    sim = Simulation(scen, engine_cfg=EngineConfig(num_hosts=2, **CFG))
+    rep = sim.run()
+    rcvbuf = np.asarray(sim.final_hosts.sk_rcvbuf)
+    assert rep.summary()["bytes_recv"] == 200_000
+    # no socket ballooned to the BDP — autotuning stayed off
+    # (unestablished sockets keep the allocation default)
+    assert rcvbuf.max() <= max(RECV_BUFFER_MIN_SIZE, 174760)

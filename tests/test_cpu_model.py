@@ -1,0 +1,66 @@
+"""CPU delay model tests (reference shd-cpu.c semantics)."""
+
+import numpy as np
+
+from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario
+from shadow_tpu.engine import defs
+from shadow_tpu.engine.sim import Simulation
+from shadow_tpu.engine.state import EngineConfig
+
+from test_phold import MESH_TOPO
+
+CFG = dict(qcap=16, scap=4, obcap=8, incap=16, chunk_windows=8)
+
+
+def scen(freq_khz=None, threshold_ns=-1, stop=6):
+    s = Scenario(
+        stop_time=stop * 10**9,
+        topology_graphml=MESH_TOPO,
+        hosts=[
+            HostSpec(id="srv", cpu_frequency=freq_khz, processes=[
+                ProcessSpec(plugin="pingserver", start_time=10**9,
+                            arguments="port=8000")]),
+            HostSpec(id="cli", processes=[
+                ProcessSpec(plugin="ping", start_time=2 * 10**9,
+                            arguments="peer=srv port=8000 interval=500ms "
+                                      "size=64 count=5")]),
+        ],
+    )
+    s.cpu_threshold_ns = threshold_ns
+    return s
+
+
+def test_cpu_model_off_by_default():
+    sim = Simulation(scen(), engine_cfg=EngineConfig(num_hosts=2, **CFG))
+    assert not sim.cfg.cpu_model
+    r = sim.run()
+    assert r.summary()["transfers_done"] == 5
+
+
+def test_cpu_cost_scales_with_frequency():
+    # slow CPU (500 MHz vs raw 3 GHz): ratio 6 -> cost 60us rounded to
+    # precision 200us -> 0? rounded 60us -> 0.2ms buckets: 60_000 ->
+    # round(60000/200000)=0 -> cost 0. Use finer precision scenario.
+    s = scen(freq_khz=500_000)
+    s.cpu_precision_ns = 1000
+    sim = Simulation(s, engine_cfg=EngineConfig(num_hosts=2, **CFG))
+    assert sim.cfg.cpu_model
+    assert int(np.asarray(sim.hp.cpu_cost)[0]) == 60_000  # 10us x6
+    assert int(np.asarray(sim.hp.cpu_cost)[1]) == 0       # unset host
+    r = sim.run()
+    # ping still completes; the server's processing adds delay, not loss
+    assert r.summary()["transfers_done"] == 5
+    assert r.summary()["mean_rtt_us"] >= 50_000  # >= network-only RTT
+
+
+def test_cpu_threshold_blocks_and_recovers():
+    # Huge per-event cost + tiny threshold: server events get deferred
+    # to the CPU horizon; everything still completes, later.
+    fast = Simulation(scen(freq_khz=3_000_000, threshold_ns=10**9),
+                      engine_cfg=EngineConfig(num_hosts=2, **CFG)).run()
+    s = scen(freq_khz=30_000, threshold_ns=1000)  # ratio 100 -> 1ms/evt
+    s.cpu_precision_ns = 1000
+    slow = Simulation(s, engine_cfg=EngineConfig(num_hosts=2, **CFG)).run()
+    assert slow.summary()["transfers_done"] == 5
+    assert (slow.summary()["mean_rtt_us"] >
+            fast.summary()["mean_rtt_us"])

@@ -1,0 +1,106 @@
+#!/usr/bin/env python3
+"""Generate GraphML topologies (the analogue of the reference's
+src/tools/topology toolkit generators).
+
+Usage:
+  python tools/gen_topology.py single --latency 25 --bw 102400
+  python tools/gen_topology.py ring --n 8 --latency 10
+  python tools/gen_topology.py star --n 16 --latency 20
+  python tools/gen_topology.py er --n 64 --p 0.1 --latency-range 5 80 \
+      --loss 0.001 --seed 3     # Erdos-Renyi + spanning tree (connected)
+
+Writes GraphML to stdout (or --out FILE) in the attribute schema the
+simulator and the reference both read (latency ms, packetloss,
+bandwidthup/down KiB/s).
+"""
+
+import argparse
+import random
+import sys
+
+HEADER = """<?xml version="1.0" encoding="utf-8"?>
+<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d9" />
+  <key attr.name="latency" attr.type="double" for="edge" id="d7" />
+  <key attr.name="type" attr.type="string" for="node" id="d5" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d4" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d3" />
+  <key attr.name="packetloss" attr.type="double" for="node" id="d0" />
+  <graph edgedefault="undirected">"""
+
+
+def node(i, bw, loss=0.0, typ="net"):
+    return (f'    <node id="poi-{i}"><data key="d0">{loss}</data>'
+            f'<data key="d3">{bw}</data><data key="d4">{bw}</data>'
+            f'<data key="d5">{typ}</data></node>')
+
+
+def edge(a, b, lat, loss=0.0):
+    return (f'    <edge source="poi-{a}" target="poi-{b}">'
+            f'<data key="d7">{lat}</data>'
+            f'<data key="d9">{loss}</data></edge>')
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("kind", choices=["single", "ring", "star", "er"])
+    ap.add_argument("--n", type=int, default=8)
+    ap.add_argument("--latency", type=float, default=25.0)
+    ap.add_argument("--latency-range", type=float, nargs=2)
+    ap.add_argument("--bw", type=int, default=102400, help="KiB/s")
+    ap.add_argument("--loss", type=float, default=0.0)
+    ap.add_argument("--p", type=float, default=0.1,
+                    help="er edge probability")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--out", default="-")
+    args = ap.parse_args()
+
+    rng = random.Random(args.seed)
+
+    def lat():
+        if args.latency_range:
+            lo, hi = args.latency_range
+            return round(rng.uniform(lo, hi), 2)
+        return args.latency
+
+    lines = [HEADER]
+    if args.kind == "single":
+        lines.append(node(0, args.bw))
+        lines.append(edge(0, 0, lat(), args.loss))
+    elif args.kind == "ring":
+        for i in range(args.n):
+            lines.append(node(i, args.bw))
+        for i in range(args.n):
+            lines.append(edge(i, i, 1.0, 0.0))
+            lines.append(edge(i, (i + 1) % args.n, lat(), args.loss))
+    elif args.kind == "star":
+        for i in range(args.n):
+            lines.append(node(i, args.bw))
+        lines.append(edge(0, 0, 1.0, 0.0))
+        for i in range(1, args.n):
+            lines.append(edge(i, i, 1.0, 0.0))
+            lines.append(edge(0, i, lat(), args.loss))
+    else:  # er: random graph + spanning tree for connectivity
+        for i in range(args.n):
+            lines.append(node(i, args.bw))
+        for i in range(args.n):
+            lines.append(edge(i, i, 1.0, 0.0))
+        for i in range(1, args.n):
+            lines.append(edge(rng.randrange(i), i, lat(), args.loss))
+        for a in range(args.n):
+            for b in range(a + 1, args.n):
+                if rng.random() < args.p:
+                    lines.append(edge(a, b, lat(), args.loss))
+    lines.append("  </graph>\n</graphml>")
+
+    text = "\n".join(lines)
+    if args.out == "-":
+        print(text)
+    else:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+#!/usr/bin/env python3
+"""Plot shadow_tpu heartbeat metrics (the analogue of the reference's
+src/tools/plot-shadow.py over parse-shadow output).
+
+Usage:
+  python tools/plot_heartbeat.py sim.log --out sim.pdf
+  python tools/plot_heartbeat.py sim.log --metric bytes_recv --out x.png
+
+Produces per-metric time series: one line per host plus the aggregate.
+"""
+
+import argparse
+import collections
+import csv
+import io
+import subprocess
+import sys
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+METRICS = ["events", "pkts_sent", "pkts_recv", "bytes_sent",
+           "bytes_recv", "retransmits", "drop_net", "transfers_done"]
+
+
+def load(log_path):
+    out = subprocess.run(
+        [sys.executable, "tools/parse_heartbeat.py", log_path],
+        capture_output=True, text=True, check=True).stdout
+    rows = list(csv.DictReader(io.StringIO(out)))
+    series = collections.defaultdict(lambda: collections.defaultdict(list))
+    for r in rows:
+        for m in METRICS:
+            series[m][r["host"]].append((int(r["time"]),
+                                         int(r[m])))
+    return series
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("log")
+    ap.add_argument("--out", default="heartbeat.pdf")
+    ap.add_argument("--metric", action="append",
+                    help=f"subset of {METRICS}")
+    args = ap.parse_args()
+
+    series = load(args.log)
+    metrics = args.metric or METRICS
+    fig, axes = plt.subplots(len(metrics), 1,
+                             figsize=(8, 2.2 * len(metrics)),
+                             sharex=True, squeeze=False)
+    for ax, m in zip(axes[:, 0], metrics):
+        total = collections.Counter()
+        for host, pts in sorted(series[m].items()):
+            xs = [t for t, _ in pts]
+            ys = [v for _, v in pts]
+            ax.plot(xs, ys, alpha=0.35, linewidth=0.8)
+            for t, v in pts:
+                total[t] += v
+        if total:
+            xs = sorted(total)
+            ax.plot(xs, [total[t] for t in xs], color="black",
+                    linewidth=1.6, label="all hosts")
+            ax.legend(loc="upper left", fontsize=7)
+        ax.set_ylabel(m, fontsize=8)
+        ax.tick_params(labelsize=7)
+    axes[-1, 0].set_xlabel("simulated time (s)", fontsize=8)
+    fig.tight_layout()
+    fig.savefig(args.out)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
