@@ -341,7 +341,18 @@ def _run_windows_impl(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
         we_eff = jnp.minimum(we, sh.stop_time)
 
         def ev_cond(h):
-            return next_event_time(h) < we_eff
+            go = next_event_time(h) < we_eff
+            if cfg.hostedcap > 1:
+                # pause before a hosted wake ring can overflow so the
+                # CPU tier drains mid-window (the window simply
+                # re-opens on the next call — long loopback event
+                # chains otherwise complete inside ONE window and
+                # blow past any fixed ring size). The threshold floor
+                # keeps tiny manual hostedcap values from wedging the
+                # loop (hw_cnt stays 0 without hosted apps).
+                cap = h.hw_time.shape[1]
+                go = go & (jnp.max(h.hw_cnt) < max(cap - 4, 1))
+            return go
 
         def ev_body(h):
             return step_all_hosts(h, hp, sh, we_eff, cfg)

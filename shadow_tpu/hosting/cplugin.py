@@ -109,6 +109,7 @@ class CPluginApp(HostedApp):
         self.lib = _load(so_path)
         self.state = self.lib.plugin_create(args.encode())
         self._socks = []         # handle -> Sock
+        self._closed = set()     # handles whose socket was closed
         self._os = None
         # keep callback objects alive for the instance lifetime
         self._cbs = self._make_api()
@@ -142,6 +143,7 @@ class CPluginApp(HostedApp):
 
         def close_sk(_, h):
             self._os.close(self._socks[h])
+            self._closed.add(h)
 
         def timer(_, delay_ns, tag):
             self._os.timer(delay_ns, tag)
@@ -158,8 +160,12 @@ class CPluginApp(HostedApp):
         return cbs
 
     def _handle_of_slot(self, sock) -> int:
-        for h, s in enumerate(self._socks):
-            if isinstance(s, Sock) and s.slot == sock.slot:
+        # newest-first and skipping closed handles: device socket slots
+        # are recycled, so an old closed handle may share the slot id
+        for h in range(len(self._socks) - 1, -1, -1):
+            s = self._socks[h]
+            if (h not in self._closed and isinstance(s, Sock)
+                    and s.slot == sock.slot):
                 return h
         self._socks.append(sock)
         return len(self._socks) - 1

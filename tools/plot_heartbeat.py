@@ -13,6 +13,7 @@ import argparse
 import collections
 import csv
 import io
+import os
 import subprocess
 import sys
 
@@ -26,8 +27,10 @@ METRICS = ["events", "pkts_sent", "pkts_recv", "bytes_sent",
 
 
 def load(log_path):
+    parser = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "parse_heartbeat.py")
     out = subprocess.run(
-        [sys.executable, "tools/parse_heartbeat.py", log_path],
+        [sys.executable, parser, log_path],
         capture_output=True, text=True, check=True).stdout
     rows = list(csv.DictReader(io.StringIO(out)))
     series = collections.defaultdict(lambda: collections.defaultdict(list))
