@@ -358,7 +358,14 @@ def _run_windows_impl(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
             return step_all_hosts(h, hp, sh, we_eff, cfg)
 
         hosts = jax.lax.while_loop(ev_cond, ev_body, hosts)
-        hosts = exchange(hosts, hp, sh, cfg)
+        # an empty exchange is the identity: skip its sort/gather work
+        # for windows that emitted nothing (common in sparse phases).
+        # Single-chip only — the sharded body's collectives must run
+        # uniformly on every shard.
+        hosts = jax.lax.cond(
+            jnp.any(hosts.ob_cnt > 0),
+            lambda h: exchange(h, hp, sh, cfg),
+            lambda h: h, hosts)
         nt = next_event_time(hosts)
         we2 = jnp.where(nt == SIMTIME_MAX, SIMTIME_MAX, nt + sh.min_jump)
         return hosts, nt, we2, i + 1

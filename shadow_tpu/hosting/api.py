@@ -19,14 +19,18 @@ from dataclasses import dataclass, field
 
 
 class Sock:
-    """Handle for a device-side socket slot. Resolves after the op
-    batch that created it is applied; hosted apps only dereference it
-    in later callbacks, by which time it is bound."""
+    """Handle for one socket INCARNATION: a (device slot, generation)
+    pair. Slots are recycled after close; the generation (stamped on
+    every wake by the engine) keeps a handle bound to exactly the
+    connection that created it. Resolves after the op batch that
+    created it is applied; hosted apps only dereference it in later
+    callbacks, by which time it is bound."""
 
-    __slots__ = ("slot",)
+    __slots__ = ("slot", "gen")
 
     def __init__(self):
         self.slot = None
+        self.gen = None
 
     def __index__(self):
         if self.slot is None:
@@ -34,7 +38,7 @@ class Sock:
         return self.slot
 
     def __repr__(self):
-        return f"Sock({self.slot})"
+        return f"Sock({self.slot}@{self.gen})"
 
 
 @dataclass
@@ -125,20 +129,28 @@ class HostOS:
             return sock if sock.slot is None else sock.slot
         return int(sock)
 
-    def sock_for(self, slot: int) -> Sock:
-        """Sock handle for a raw wake slot (server-accepted children
-        get their first handle here)."""
-        s = self._socks.get(slot)
+    def sock_for(self, slot: int, gen: int = 0) -> Sock:
+        """Sock handle for a wake's (slot, generation) — the SAME
+        object for every wake of one connection incarnation
+        (server-accepted children get their first handle here)."""
+        s = self._socks.get((slot, gen))
         if s is None:
             s = Sock()
             s.slot = slot
-            self._socks[slot] = s
+            s.gen = gen
+            self._socks[(slot, gen)] = s
         return s
 
-    def _bind(self, sock: Sock, slot: int):
-        sock.slot = slot
-        if slot >= 0:
-            self._socks[slot] = sock
+    def _bind(self, sock: Sock, packed: int):
+        """Bind an open's result: packed = (generation << 16) | slot,
+        or -1 on failure."""
+        if packed < 0:
+            sock.slot = -1
+            sock.gen = -1
+            return
+        sock.slot = packed & 0xFFFF
+        sock.gen = (packed >> 16) & 0x7FFF
+        self._socks[(sock.slot, sock.gen)] = sock
 
 
 class HostedApp:

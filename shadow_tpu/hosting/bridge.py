@@ -81,9 +81,12 @@ def _apply_one(hosts, hp, sh, op, results):
     K = results.shape[0]
 
     def deref(x):
-        """Resolve a possibly-referencing operand to a concrete value."""
+        """Resolve a possibly-referencing operand to a concrete slot
+        (results pack (generation << 16) | slot for opens)."""
         j = jnp.clip(-x - 2, 0, K - 1).astype(_I32)
-        return jnp.where(x >= -1, x, results[j].astype(jnp.int64))
+        rj = results[j]
+        slot_j = jnp.where(rj >= 0, rj & 0xFFFF, -1).astype(jnp.int64)
+        return jnp.where(x >= -1, x, slot_j)
 
     # Only SOCKET-SLOT operands may be same-batch references; derefing
     # every word would corrupt legitimate negative scalars (e.g. an
@@ -98,20 +101,27 @@ def _apply_one(hosts, hp, sh, op, results):
     def op_nop(r):
         return r, _I32(-1)
 
+    def _slot_result(r, slot, ok):
+        # pack (generation << 16) | slot so the host side can bind the
+        # handle to this exact socket incarnation (slots are recycled)
+        from ..core.rowops import rget as _rget
+        gen = _rget(r.sk_timer_gen, slot) & 0x7FFF
+        return jnp.where(ok, (gen << 16) | slot, -1).astype(_I32)
+
     def op_udp_open(r):
         r, slot, ok = _udp_open_bridge(r, op[2].astype(_I32))
-        return r, jnp.where(ok, slot, -1).astype(_I32)
+        return r, _slot_result(r, slot, ok)
 
     def op_listen(r):
         r, slot, ok = tcp_listen(r, op[2].astype(_I32))
-        return r, jnp.where(ok, slot, -1).astype(_I32)
+        return r, _slot_result(r, slot, ok)
 
     def op_connect(r):
         r, slot, ok = tcp_connect(r, hrow, sh, now,
                                   dst_host=op[2].astype(_I32),
                                   dst_port=op[3].astype(_I32),
                                   tag=op[4].astype(_I32))
-        return r, jnp.where(ok, slot, -1).astype(_I32)
+        return r, _slot_result(r, slot, ok)
 
     def op_write(r):
         r = tcp_write(r, now, op[2].astype(_I32), op[3])

@@ -41,7 +41,7 @@ import ctypes
 import os
 import subprocess
 
-from .api import HostedApp, Sock, register
+from .api import HostedApp, register
 
 _API_FIELDS = [
     ("now", ctypes.CFUNCTYPE(ctypes.c_longlong, ctypes.c_void_p)),
@@ -108,8 +108,9 @@ class CPluginApp(HostedApp):
     def __init__(self, so_path: str, args: str):
         self.lib = _load(so_path)
         self.state = self.lib.plugin_create(args.encode())
-        self._socks = []         # handle -> Sock
-        self._closed = set()     # handles whose socket was closed
+        self._socks = []           # handle -> Sock
+        self._handle_of = {}       # id(Sock) -> handle (stable: HostOS
+        #   returns one object per connection incarnation)
         self._os = None
         # keep callback objects alive for the instance lifetime
         self._cbs = self._make_api()
@@ -124,7 +125,9 @@ class CPluginApp(HostedApp):
 
         def _new_handle(sock) -> int:
             self._socks.append(sock)
-            return len(self._socks) - 1
+            h = len(self._socks) - 1
+            self._handle_of[id(sock)] = h
+            return h
 
         def udp_open(_, port):
             return _new_handle(self._os.udp_open(port))
@@ -143,7 +146,6 @@ class CPluginApp(HostedApp):
 
         def close_sk(_, h):
             self._os.close(self._socks[h])
-            self._closed.add(h)
 
         def timer(_, delay_ns, tag):
             self._os.timer(delay_ns, tag)
@@ -160,15 +162,16 @@ class CPluginApp(HostedApp):
         return cbs
 
     def _handle_of_slot(self, sock) -> int:
-        # newest-first and skipping closed handles: device socket slots
-        # are recycled, so an old closed handle may share the slot id
-        for h in range(len(self._socks) - 1, -1, -1):
-            s = self._socks[h]
-            if (h not in self._closed and isinstance(s, Sock)
-                    and s.slot == sock.slot):
-                return h
-        self._socks.append(sock)
-        return len(self._socks) - 1
+        # HostOS hands back ONE Sock object per connection incarnation
+        # (keyed by slot+generation), so object identity is the stable
+        # mapping — recycled slots and late post-close wakes both
+        # resolve to the right handle.
+        h = self._handle_of.get(id(sock))
+        if h is None:
+            self._socks.append(sock)
+            h = len(self._socks) - 1
+            self._handle_of[id(sock)] = h
+        return h
 
     def _wake(self, os, reason, a=0, b=0, c=0):
         self._os = os

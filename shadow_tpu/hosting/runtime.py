@@ -62,7 +62,8 @@ class HostingRuntime:
             wake = hw_pkt[hid, i]
             reason = int(wake[P.ACK])
             slot = int(wake[P.SEQ])
-            sock = os.sock_for(slot) if slot >= 0 else None
+            gen = int(wake[P.WND]) & 0x7FFF
+            sock = os.sock_for(slot, gen) if slot >= 0 else None
             if reason == WAKE_START:
                 app.on_start(os)
             elif reason == WAKE_TIMER:

@@ -78,6 +78,10 @@ def _wake(row, now, reason, slot, pkt=None, ln=0, aux=0):
     w = rset(w, P.SEQ, _I32(slot))
     w = rset(w, P.LEN, _I32(ln))
     w = rset(w, P.AUX, _I32(aux))
+    # socket GENERATION rides the (otherwise unused in wakes) WND word
+    # so the hosting tier can tell a recycled slot from the connection
+    # a late wake belongs to (device slots are reused after close)
+    w = rset(w, P.WND, rget(row.sk_timer_gen, slot))
     return equeue.q_push(row, now + 1, EV_APP, w)
 
 

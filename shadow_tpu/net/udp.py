@@ -66,4 +66,6 @@ def udp_deliver(row, hp, sh, now, slot, pkt):
         stats=radd(row.stats, ST_BYTES_RECV, length),
     )
     wake = rset(rset(pkt, P.SEQ, jnp.int32(slot)), P.ACK, WAKE_SOCKET)
+    # socket generation for the hosting tier (see tcp._wake)
+    wake = rset(wake, P.WND, rget(row.sk_timer_gen, slot))
     return equeue.q_push(row, now + 1, EV_APP, wake)

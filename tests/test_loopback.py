@@ -2,8 +2,6 @@
 (the reference's tcp-loopback test variants, and the pipe/channel
 equivalent for hosted apps — a self-connection is a byte channel)."""
 
-import pytest
-
 from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario
 from shadow_tpu.engine import defs
 from shadow_tpu.engine.sim import Simulation
