@@ -95,8 +95,32 @@ def main(argv=None):
                    metavar="SEC", help="tracker heartbeat interval")
     p.add_argument("--log-level", default="message",
                    choices=["error", "warning", "message", "info", "debug"])
+    p.add_argument("--runahead", type=str, default=None, metavar="TIME",
+                   help="override the lookahead window width (e.g. 10ms;"
+                        " reference --runahead). Larger than the true "
+                        "minimum path latency trades causality slack "
+                        "for fewer barriers, like the reference")
     p.add_argument("--tcp-congestion-control", default="cubic",
                    choices=["aimd", "reno", "cubic"])
+    p.add_argument("--tcp-windows", type=float, default=10.0,
+                   metavar="PKTS",
+                   help="initial TCP congestion window in packets "
+                        "(reference --tcp-windows, default 10)")
+    p.add_argument("--tcp-ssthresh", type=float, default=0,
+                   metavar="PKTS",
+                   help="initial TCP slow-start threshold in packets "
+                        "(0 = discover; reference --tcp-ssthresh)")
+    p.add_argument("--socket-recv-buffer", type=int, default=0,
+                   metavar="BYTES",
+                   help="default socket receive buffer for hosts that "
+                        "set none (0 = autotune, the reference default)")
+    p.add_argument("--socket-send-buffer", type=int, default=0,
+                   metavar="BYTES",
+                   help="default socket send buffer (0 = autotune)")
+    p.add_argument("--interface-buffer", type=int, default=0,
+                   metavar="BYTES",
+                   help="default NIC input buffer size for hosts that "
+                        "set none (reference --interface-buffer)")
     p.add_argument("--interface-qdisc", default="rr",
                    choices=["fifo", "rr"],
                    help="NIC socket service discipline")
@@ -141,6 +165,15 @@ def main(argv=None):
                                  if args.cpu_threshold >= 0 else -1)
     scenario.cpu_precision_ns = (args.cpu_precision * 1000
                                  if args.cpu_precision >= 0 else 0)
+    # CLI buffer defaults apply to hosts whose XML sets none (the
+    # reference's CLI-default / XML-override layering, shd-master.c:296-341)
+    for h in scenario.hosts:
+        if args.socket_recv_buffer and h.socket_recv_buffer is None:
+            h.socket_recv_buffer = args.socket_recv_buffer
+        if args.socket_send_buffer and h.socket_send_buffer is None:
+            h.socket_send_buffer = args.socket_send_buffer
+        if args.interface_buffer and h.interface_buffer is None:
+            h.interface_buffer = args.interface_buffer
 
     logger = SimLogger(level=args.log_level)
     logger.message(0, "main", f"shadow_tpu starting: "
@@ -148,10 +181,25 @@ def main(argv=None):
                    f"stop={scenario.stop_time / 1e9:.1f}s")
 
     sim = Simulation(scenario)
+    import jax.numpy as jnp
     cc = {"aimd": 0, "reno": 1, "cubic": 2}[args.tcp_congestion_control]
     if cc != sim.cfg.cc_kind:
-        import jax.numpy as jnp
         sim.sh = sim.sh.replace(cc_kind=jnp.int32(cc))
+    if args.tcp_windows != 10.0:
+        sim.sh = sim.sh.replace(tcp_init_wnd=jnp.float32(args.tcp_windows))
+    if args.tcp_ssthresh:
+        sim.sh = sim.sh.replace(
+            tcp_ssthresh0=jnp.float32(args.tcp_ssthresh))
+    if args.runahead:
+        ra = parse_time(args.runahead, default_unit="ms")
+        true_min = int(sim.sh.min_jump)
+        if ra > true_min:
+            logger.warning(
+                0, "main",
+                f"runahead {ra}ns exceeds the minimum path latency "
+                f"{true_min}ns: cross-host arrivals may execute late "
+                "(the reference gives the same warning)")
+        sim.sh = sim.sh.replace(min_jump=jnp.int64(max(ra, 1)))
     qd = {"fifo": 0, "rr": 1}[args.interface_qdisc]
     if qd != sim.cfg.qdisc:
         import dataclasses
@@ -172,6 +220,14 @@ def main(argv=None):
                    f"done: {s['events']} events in {s['wall_seconds']:.2f}s "
                    f"wall ({s['events_per_sec']:.0f} ev/s, "
                    f"speedup x{s['speedup']:.2f})")
+    # end-of-run capacity accounting (reference ObjectCounter report)
+    for row in report.capacity_report():
+        line = (f"capacity {row['array']}: peak {row['peak']}"
+                f"/{row['capacity']}, overflow {row['overflow']}")
+        if row["overflow"]:
+            logger.warning(report.sim_time_ns, "main", line)
+        else:
+            logger.message(report.sim_time_ns, "main", line)
     if args.summary_json:
         print(json.dumps(s))
     return 0

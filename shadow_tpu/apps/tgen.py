@@ -7,22 +7,32 @@ as a per-host vectorized state machine. The behavior-graph file format
 is tgen's: a directed GraphML whose vertex ids name actions — ``start``
 (peers list, serverport, initial delay), ``transfer`` (type get/put,
 protocol, size), ``pause`` (fixed time or a comma list to draw from),
-``end`` (count / time / size stop conditions) — connected by edges the
-client walks in a cycle (see resource/examples/tgen.webclient.graphml.xml).
+``synchronize`` (join barrier), ``end`` (count / time / size stop
+conditions) — connected by edges the client walks
+(see resource/examples/tgen.webclient.graphml.xml).
+
+Walk semantics match the reference's graph engine:
+
+- **parallel multi-edge walks**: completing an action follows ALL
+  outgoing edges, forking concurrent walk cursors (the reference walks
+  every out-edge of a completed action, shd-tgen-graph.c /
+  shd-tgen.c onComplete); cursors execute through a bounded device-side
+  work stack, and blocking actions (transfer, nonzero pause, delayed
+  start) park their continuation on a timer or socket.
+- **synchronize joins**: a synchronize vertex blocks arriving cursors
+  until as many arrivals as it has incoming edges have accumulated,
+  then fires once and resets (shd-tgen-action.c synchronize semantics);
+  arrival counters live in Hosts.tgen_sync.
 
 Compilation (host side): :func:`compile_tgen_graph` flattens a graph
-into rows of a device node table plus peer/pause pools shared across
-all hosts (state.Shared.tgen_*). Runtime (device side): :func:`app_tgen`
-walks the table with lax primitives; transfers ride the TCP stack with
-the request type+size carried on the SYN's APP word, exactly the role
-of tgen's command header on a real connection.
+into rows of a device node table plus peer/pause/successor pools shared
+across all hosts (state.Shared.tgen_*). Runtime (device side):
+:func:`app_tgen` walks the table with lax primitives; transfers ride
+the TCP stack with the request type+size carried on the SYN's APP word,
+exactly the role of tgen's command header on a real connection.
 
-Walk semantics notes vs the reference: each node has one active
-successor (the first outgoing edge); tgen's parallel multi-edge walks
-and ``synchronize`` joins collapse to sequential execution — the
-canonical example graphs are single-successor cycles, which this
-reproduces exactly. ``timeout``/``stallout`` attrs parse but v1 ignores
-them (no transfer abort path yet).
+``timeout``/``stallout`` attrs parse but are ignored for now (no
+transfer abort path yet).
 """
 
 from __future__ import annotations
@@ -35,22 +45,36 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.rowops import radd, rset
+from ..core.rowops import radd, rget, rset
 from ..core.simtime import SIMTIME_ONE_SECOND
 from ..engine.defs import (WAKE_START, WAKE_TIMER, WAKE_SOCKET,
                            WAKE_CONNECTED, WAKE_EOF, WAKE_ACCEPT, WAKE_SENT,
-                           ST_XFER_DONE, ST_APP_DONE)
+                           ST_XFER_DONE, ST_APP_DONE, ST_TGEN_DROP)
 from ..net import packet as P
 from ..net.tcp import tcp_connect, tcp_listen, tcp_write, tcp_close_call
 from .base import draw, timer
 
-# --- node table encoding (Shared.tgen_nodes: int64 [N, 8]) ---
-# [kind, a, b, c, next, peers_off, n_peers, pool_ref]
+# --- node table encoding (Shared.tgen_nodes: int64 [N, 10]) ---
+# [kind, a, b, c, next, peers_off, n_peers, sync_ref, edge_off, edge_cnt]
 NK_START = 0      # a=serverport, b=initial delay ns
 NK_TRANSFER = 1   # a=type (0 get, 1 put), b=size bytes
 NK_PAUSE = 2      # a=fixed time ns (or -1: draw from pool[b:b+c])
 NK_END = 3        # a=count limit, b=time-limit ns, c=size-limit bytes
-COL_KIND, COL_A, COL_B, COL_C, COL_NEXT, COL_POFF, COL_PCNT, COL_REF = range(8)
+NK_SYNC = 4       # a=indegree (arrivals required), sync_ref=counter slot
+(COL_KIND, COL_A, COL_B, COL_C, COL_NEXT, COL_POFF, COL_PCNT, COL_REF,
+ COL_EOFF, COL_ECNT) = range(10)
+NODE_COLS = 10
+
+# walk-cursor work stack depth (per wake); forks beyond this are
+# dropped and counted in ST_TGEN_DROP
+STACK_CAP = 8
+
+# app_r register use: r2=transfers completed, r3=bytes transferred,
+# r4=walk start time, r5=done flag (end conditions met)
+REG_COUNT = 2
+REG_BYTES = 3
+REG_T0 = 4
+REG_DONE = 5
 
 # transfer request tag riding the SYN (31 usable bits)
 TAG_PUT = 1 << 30
@@ -86,9 +110,11 @@ class TgenTables:
     tables (deduplicated per distinct graph)."""
 
     def __init__(self):
-        self.nodes = []    # rows of 8 int64
+        self.nodes = []    # rows of NODE_COLS int64
         self.peers = []    # (host, port) int32 rows
         self.pool = []     # int64 pause choices (ns)
+        self.edges = []    # int32 absolute successor-node indices
+        self.sync_slots = 0  # per-host synchronize counters allocated
         self._cache = {}
 
     def compile(self, source: str, dns) -> int:
@@ -103,12 +129,14 @@ class TgenTables:
 
     def arrays(self):
         nodes = (np.asarray(self.nodes, dtype=np.int64)
-                 if self.nodes else np.zeros((1, 8), np.int64))
+                 if self.nodes else np.zeros((1, NODE_COLS), np.int64))
         peers = (np.asarray(self.peers, dtype=np.int32)
                  if self.peers else np.zeros((1, 2), np.int32))
         pool = (np.asarray(self.pool, dtype=np.int64)
                 if self.pool else np.zeros((1,), np.int64))
-        return nodes, peers, pool
+        edges = (np.asarray(self.edges, dtype=np.int32)
+                 if self.edges else np.full((1,), -1, np.int32))
+        return nodes, peers, pool, edges
 
 
 def _resolve_peers(text: str, dns):
@@ -152,9 +180,13 @@ def compile_tgen_graph(source: str, dns, tab: TgenTables) -> int:
         raw[nd.attrib["id"]] = attrs
         order.append(nd.attrib["id"])
 
-    succ = {}     # node id -> first-successor id
+    succs = {nid: [] for nid in order}   # node id -> successor ids (file order)
+    indeg = {nid: 0 for nid in order}    # node id -> incoming edge count
     for e in graph.findall(f"{ns}edge"):
-        succ.setdefault(e.attrib["source"], e.attrib["target"])
+        s, t = e.attrib["source"], e.attrib["target"]
+        if s in succs and t in indeg:
+            succs[s].append(t)
+            indeg[t] += 1
 
     base = len(tab.nodes)
     index = {nid: base + i for i, nid in enumerate(order)}
@@ -170,7 +202,10 @@ def compile_tgen_graph(source: str, dns, tab: TgenTables) -> int:
     for nid in order:
         a = raw[nid]
         act = action_of(nid)
-        nxt = index[succ[nid]] if succ.get(nid) in index else -1
+        slist = [index[t] for t in succs[nid]]
+        nxt = slist[0] if slist else -1
+        eoff, ecnt = len(tab.edges), len(slist)
+        tab.edges.extend(slist)
         poff = pcnt = 0
         if act == "start":
             peers = _resolve_peers(a.get("peers", ""), dns)
@@ -181,7 +216,7 @@ def compile_tgen_graph(source: str, dns, tab: TgenTables) -> int:
                 default_peers = (poff, pcnt)
             port = int(a.get("serverport", 0) or 0)
             delay = _parse_tgen_seconds(a["time"]) if a.get("time") else 0
-            row = [NK_START, port, delay, 0, nxt, poff, pcnt, 0]
+            row = [NK_START, port, delay, 0, nxt, poff, pcnt, 0, eoff, ecnt]
         elif act == "transfer":
             ttype = 1 if a.get("type", "get").lower() == "put" else 0
             size = parse_size(a.get("size", "1 MiB"))
@@ -197,7 +232,8 @@ def compile_tgen_graph(source: str, dns, tab: TgenTables) -> int:
                 raise ValueError(
                     f"tgen transfer node {nid!r} has no peers (set a "
                     "'peers' attr on it or on the start node)")
-            row = [NK_TRANSFER, ttype, size, 0, nxt, poff, pcnt, 0]
+            row = [NK_TRANSFER, ttype, size, 0, nxt, poff, pcnt, 0, eoff,
+                   ecnt]
         elif act == "pause":
             t = a.get("time", "1")
             if "," in t:
@@ -205,77 +241,111 @@ def compile_tgen_graph(source: str, dns, tab: TgenTables) -> int:
                            for x in t.split(",") if x.strip()]
                 ref = len(tab.pool)
                 tab.pool.extend(choices)
-                row = [NK_PAUSE, -1, ref, len(choices), nxt, 0, 0, 0]
+                row = [NK_PAUSE, -1, ref, len(choices), nxt, 0, 0, 0, eoff,
+                       ecnt]
             else:
-                row = [NK_PAUSE, _parse_tgen_seconds(t), 0, 0, nxt, 0, 0, 0]
+                row = [NK_PAUSE, _parse_tgen_seconds(t), 0, 0, nxt, 0, 0, 0,
+                       eoff, ecnt]
         elif act == "synchronize":
-            # v1: a join of one path is a no-op passthrough
-            row = [NK_PAUSE, 0, 0, 0, nxt, 0, 0, 0]
+            # join barrier: fires after `indegree` cursor arrivals
+            sref = tab.sync_slots
+            tab.sync_slots += 1
+            row = [NK_SYNC, max(indeg[nid], 1), 0, 0, nxt, 0, 0, sref, eoff,
+                   ecnt]
         else:  # end
             count = int(a.get("count", 0) or 0)
             tlim = _parse_tgen_seconds(a["time"]) if a.get("time") else 0
             slim = parse_size(a["size"]) if a.get("size") else 0
-            row = [NK_END, count, tlim, slim, nxt, 0, 0, 0]
+            row = [NK_END, count, tlim, slim, nxt, 0, 0, 0, eoff, ecnt]
         rows.append(row)
     tab.nodes.extend(rows)
 
     if "start" not in index:
         raise ValueError("tgen graph has no 'start' node")
 
-    # Reject walks that can spin forever: follow the single-successor
-    # chain from start; any reachable cycle must contain a blocking
-    # node (a transfer, or a pause/start with nonzero wait) or the
-    # device while_loop in _run_chain would never terminate.
+    # Reject walks that can spin forever on device: the subgraph of
+    # transitions that complete instantly (no timer, no socket) must be
+    # acyclic, or the walk loop would chain through a cycle unboundedly
+    # within one wake. Blocking nodes: transfers, pauses with a
+    # guaranteed-nonzero wait, delayed starts, and multi-arrival
+    # synchronize barriers.
     def blocks(local_i: int) -> bool:
         r = rows[local_i]
-        return (r[COL_KIND] == NK_TRANSFER or
-                (r[COL_KIND] == NK_PAUSE and (r[COL_A] != 0)) or
-                (r[COL_KIND] == NK_START and r[COL_B] > 0))
+        if r[COL_KIND] == NK_TRANSFER:
+            return True
+        if r[COL_KIND] == NK_PAUSE:
+            if r[COL_A] > 0:
+                return True
+            if r[COL_A] < 0:  # drawn from pool: blocking iff no 0 choice
+                lo, n = r[COL_B], r[COL_C]
+                return min(tab.pool[lo:lo + n]) > 0
+            return False
+        if r[COL_KIND] == NK_START:
+            return r[COL_B] > 0
+        if r[COL_KIND] == NK_SYNC:
+            return r[COL_A] > 1
+        return False
 
-    seen = {}
-    cur = index["start"] - base
-    step = 0
-    while cur >= 0:
-        if cur in seen:
-            cycle = [i for i, s in seen.items() if s >= seen[cur]]
-            if not any(blocks(i) for i in cycle):
-                names = [order[i] for i in cycle]
-                raise ValueError(
-                    "tgen graph cycle never blocks (no transfer or "
-                    f"nonzero pause): {' -> '.join(names)}")
-            break
-        seen[cur] = step
-        step += 1
-        nxt_abs = rows[cur][COL_NEXT]
-        cur = nxt_abs - base if nxt_abs >= 0 else -1
+    WHITE, GRAY, BLACK = 0, 1, 2
+
+    # iterative DFS over the non-blocking subgraph
+    def succ_local(i):
+        r = rows[i]
+        return [tab.edges[r[COL_EOFF] + j] - base for j in range(r[COL_ECNT])]
+
+    state = [WHITE] * len(rows)
+    for root_i in range(len(rows)):
+        if state[root_i] != WHITE or blocks(root_i):
+            continue
+        stack = [(root_i, 0)]
+        state[root_i] = GRAY
+        while stack:
+            i, j = stack[-1]
+            ss = [s for s in succ_local(i) if not blocks(s)]
+            if j < len(ss):
+                stack[-1] = (i, j + 1)
+                s = ss[j]
+                if state[s] == GRAY:
+                    names = [order[x] for x, _ in stack] + [order[s]]
+                    raise ValueError(
+                        "tgen graph cycle never blocks (no transfer or "
+                        f"nonzero pause): {' -> '.join(names)}")
+                if state[s] == WHITE:
+                    state[s] = GRAY
+                    stack.append((s, 0))
+            else:
+                state[i] = BLACK
+                stack.pop()
 
     return index["start"]
 
 
 # --- device-side walk ------------------------------------------------------
-# registers: r0=active client socket (-1 none), r1=node to execute on the
-# next wake (timer) / node of the in-flight transfer, r2=transfers
-# completed, r3=total bytes transferred, r4=walk start time
 
 _I32 = jnp.int32
 _I64 = jnp.int64
 
 
+def _node(sh, cur):
+    return sh.tgen_nodes[jnp.clip(cur, 0, sh.tgen_nodes.shape[0] - 1)]
+
+
 def _exec_node(row, hp, sh, now, cur):
-    """Execute node `cur`'s entry action. Returns (row, nxt) where
-    nxt >= 0 chains immediately and -1 blocks awaiting a wake."""
-    nd = sh.tgen_nodes[jnp.clip(cur, 0, sh.tgen_nodes.shape[0] - 1)]
+    """Execute node `cur`'s entry action. Returns (row, proceed): when
+    proceed, the walk continues through ALL the node's out-edges; when
+    not, the cursor parked on a timer/socket or died (end/sync)."""
+    nd = _node(sh, cur)
     kind = nd[COL_KIND]
-    nxt = nd[COL_NEXT].astype(_I32)
+    F = jnp.zeros((), jnp.bool_)
+    T = jnp.ones((), jnp.bool_)
 
     def do_start(r):
         delay = nd[COL_B]
 
         def wait(rr):
-            rr = rr.replace(app_r=rset(rr.app_r, 1, nxt.astype(_I64)))
-            return timer(rr, now + delay), _I32(-1)
+            return timer(rr, now + delay, aux=cur), F
 
-        return jax.lax.cond(delay > 0, wait, lambda rr: (rr, nxt), r)
+        return jax.lax.cond(delay > 0, wait, lambda rr: (rr, T), r)
 
     def do_transfer(r):
         pcnt = jnp.maximum(nd[COL_PCNT], 1)
@@ -291,16 +361,19 @@ def _exec_node(row, hp, sh, now, cur):
         tag = (size | jnp.where(ttype == 1, TAG_PUT, 0)).astype(_I32)
         r, slot, ok = tcp_connect(r, hp, sh, now, dst_host=peer_host,
                                   dst_port=peer_port, tag=tag)
-        r = r.replace(app_r=rset(rset(r.app_r, 0,
-                                      slot.astype(_I64)), 1, _I64(cur)))
-        # connect failure (socket table full): retry the transfer after
-        # a 1s backoff instead of blocking the walk forever
-        r = jax.lax.cond(ok, lambda rr: rr,
-                         lambda rr: timer(rr.replace(
-                             app_r=rset(rset(rr.app_r, 0, -1), 1,
-                                        _I64(cur))), now + SIMTIME_ONE_SECOND),
-                         r)
-        return r, _I32(-1)
+        # client sockets remember their owning behavior node, so any
+        # number of transfers (parallel walk branches) can be in flight
+        r = jax.lax.cond(
+            ok,
+            lambda rr: rr.replace(
+                sk_app_ref=rset(rr.sk_app_ref, slot, cur.astype(_I32))),
+            # connect failure (socket table full): retry the transfer
+            # after a 1s backoff instead of losing the walk branch
+            # (negative timer aux = re-enter the node itself)
+            lambda rr: timer(rr, now + SIMTIME_ONE_SECOND,
+                             aux=-(cur.astype(_I32) + 1)),
+            r)
+        return r, F
 
     def do_pause(r):
         fixed = nd[COL_A]
@@ -320,65 +393,134 @@ def _exec_node(row, hp, sh, now, cur):
         r, t = jax.lax.cond(fixed < 0, drawn, fixed_t, r)
 
         def wait(rr):
-            rr = rr.replace(app_r=rset(rr.app_r, 1, nxt.astype(_I64)))
-            return timer(rr, now + t), _I32(-1)
+            return timer(rr, now + t, aux=cur), F
 
-        return jax.lax.cond(t > 0, wait, lambda rr: (rr, nxt), r)
+        return jax.lax.cond(t > 0, wait, lambda rr: (rr, T), r)
 
     def do_end(r):
         met = jnp.zeros((), jnp.bool_)
-        met |= (nd[COL_A] > 0) & (r.app_r[2] >= nd[COL_A])
-        met |= (nd[COL_B] > 0) & (now - r.app_r[4] >= nd[COL_B])
-        met |= (nd[COL_C] > 0) & (r.app_r[3] >= nd[COL_C])
+        met |= (nd[COL_A] > 0) & (r.app_r[REG_COUNT] >= nd[COL_A])
+        met |= (nd[COL_B] > 0) & (now - r.app_r[REG_T0] >= nd[COL_B])
+        met |= (nd[COL_C] > 0) & (r.app_r[REG_BYTES] >= nd[COL_C])
 
         def stop(rr):
             rr = rr.replace(
-                app_r=rset(rr.app_r, 1, _I64(-1)),
+                app_r=rset(rr.app_r, REG_DONE, _I64(1)),
                 stats=radd(rr.stats, ST_APP_DONE, 1))
-            return rr, _I32(-1)
+            return rr, F
 
-        return jax.lax.cond(met, stop, lambda rr: (rr, nxt), r)
+        return jax.lax.cond(met, stop, lambda rr: (rr, T), r)
 
-    return jax.lax.switch(jnp.clip(kind, 0, 3).astype(_I32),
-                          [do_start, do_transfer, do_pause, do_end], row)
+    def do_sync(r):
+        # join barrier: the reference's synchronize action waits until
+        # every incoming walk branch has arrived, then all proceed as
+        # one (shd-tgen-action.c); counter resets so loops re-arm
+        ref = nd[COL_REF].astype(_I32)
+        cnt = rget(r.tgen_sync, ref) + 1
+        fire = cnt >= nd[COL_A].astype(_I32)
+        r = r.replace(tgen_sync=rset(r.tgen_sync, ref,
+                                     jnp.where(fire, 0, cnt)))
+        return r, fire
+
+    return jax.lax.switch(jnp.clip(kind, 0, 4).astype(_I32),
+                          [do_start, do_transfer, do_pause, do_end,
+                           do_sync], row)
 
 
-def _run_chain(row, hp, sh, now, start):
-    """Execute nodes until one blocks (the chain is bounded: every cycle
-    in a well-formed graph contains a blocking pause/transfer)."""
+def _push_succs(row, sh, stack, sp, cur):
+    """Push all of `cur`'s successors onto the cursor stack (overflow
+    drops the branch and counts it)."""
+    nd = _node(sh, cur)
+    eoff = nd[COL_EOFF].astype(_I32)
+    ecnt = nd[COL_ECNT].astype(_I32)
+
+    def body(j, c):
+        row, stack, sp = c
+        tgt = sh.tgen_edges[jnp.clip(eoff + j, 0,
+                                     sh.tgen_edges.shape[0] - 1)]
+        can = sp < STACK_CAP
+        stack = jnp.where(jnp.arange(STACK_CAP) == sp, tgt, stack)
+        sp = sp + jnp.where(can, 1, 0)
+        row = row.replace(stats=radd(row.stats, ST_TGEN_DROP,
+                                     jnp.where(can, 0, 1)))
+        return row, stack, sp
+
+    return jax.lax.fori_loop(0, ecnt, body, (row, stack, sp))
+
+
+def _walk(row, hp, sh, now, stack, sp):
+    """Run queued walk cursors until all have blocked or died. Bounded:
+    compile-time validation guarantees every instant cycle is broken by
+    a blocking node, so each cursor chain terminates."""
+    N = sh.tgen_nodes.shape[0]
+    cap = 4 * N + 4 * STACK_CAP
 
     def cond(c):
-        _, cur = c
-        return cur >= 0
+        _, _, sp, it = c
+        return (sp > 0) & (it < cap)
 
     def body(c):
-        r, cur = c
-        return _exec_node(r, hp, sh, now, cur)
+        row, stack, sp, it = c
+        sp = sp - 1
+        cur = rget(stack, sp).astype(_I32)
+        done = row.app_r[REG_DONE] != 0
+        row, proceed = jax.lax.cond(
+            done, lambda r: (r, jnp.zeros((), jnp.bool_)),
+            lambda r: _exec_node(r, hp, sh, now, cur), row)
+        row, stack, sp = jax.lax.cond(
+            proceed,
+            lambda c2: _push_succs(c2[0], sh, c2[1], c2[2], cur),
+            lambda c2: c2, (row, stack, sp))
+        return row, stack, sp, it + 1
 
-    row, _ = jax.lax.while_loop(cond, body,
-                                (row, jnp.asarray(start, _I32)))
-    return row
+    row, _, sp_left, _ = jax.lax.while_loop(
+        cond, body, (row, stack, jnp.asarray(sp, _I32), jnp.int32(0)))
+    # iteration-cap exit with cursors still queued: count the lost
+    # branches (same accounting as a stack overflow)
+    return row.replace(stats=radd(row.stats, ST_TGEN_DROP,
+                                  sp_left.astype(jnp.int64)))
+
+
+def _walk_enter(row, hp, sh, now, node):
+    """Start a cursor AT `node` (executes its action)."""
+    stack = jnp.full((STACK_CAP,), -1, _I32)
+    stack = stack.at[0].set(jnp.asarray(node, _I32))
+    return _walk(row, hp, sh, now, stack, 1)
+
+
+def _walk_succ(row, hp, sh, now, node):
+    """Continue a cursor PAST `node` (its action completed): fork into
+    all its successors."""
+    stack = jnp.full((STACK_CAP,), -1, _I32)
+    row, stack, sp = _push_succs(row, sh, stack, jnp.int32(0),
+                                 jnp.asarray(node, _I32))
+    return _walk(row, hp, sh, now, stack, sp)
 
 
 def _finish_transfer(row, hp, sh, now, sock):
-    """A transfer completed on `sock`: account it and walk on."""
-    nd = sh.tgen_nodes[jnp.clip(row.app_r[1].astype(_I32), 0,
-                                sh.tgen_nodes.shape[0] - 1)]
+    """A transfer completed on client socket `sock`: account it and walk
+    on from its owning node."""
+    node = rget(row.sk_app_ref, sock)
+    nd = _node(sh, node)
+    row = row.replace(sk_app_ref=rset(row.sk_app_ref, sock, -1))
     row = tcp_close_call(row, now, sock)
     row = row.replace(
-        app_r=rset(radd(radd(row.app_r, 2, 1), 3, nd[COL_B]), 0, -1),
+        app_r=radd(radd(row.app_r, REG_COUNT, 1), REG_BYTES, nd[COL_B]),
         stats=radd(row.stats, ST_XFER_DONE, 1))
-    return _run_chain(row, hp, sh, now, nd[COL_NEXT].astype(_I32))
+    return _walk_succ(row, hp, sh, now, node)
 
 
 def app_tgen(row, hp, sh, now, wake):
     reason = wake[P.ACK]
     slot = wake[P.SEQ]
     start_node = hp.app_cfg[0].astype(_I32)
+    # stale-wake guard: socket wakes carry the slot generation in the
+    # WND word (net.tcp._wake); a recycled slot has a newer generation
+    fresh = wake[P.WND] == rget(row.sk_timer_gen, slot)
+    is_client = fresh & (rget(row.sk_app_ref, slot) >= 0)
 
     def on_start(r):
-        nd = sh.tgen_nodes[jnp.clip(start_node, 0,
-                                    sh.tgen_nodes.shape[0] - 1)]
+        nd = _node(sh, start_node)
         port = nd[COL_A]
 
         def listen(rr):
@@ -386,11 +528,15 @@ def app_tgen(row, hp, sh, now, wake):
             return rr
 
         r = jax.lax.cond(port > 0, listen, lambda rr: rr, r)
-        r = r.replace(app_r=rset(rset(r.app_r, 4, _I64(now)), 0, -1))
-        return _run_chain(r, hp, sh, now, start_node)
+        r = r.replace(app_r=rset(r.app_r, REG_T0, _I64(now)))
+        return _walk_enter(r, hp, sh, now, start_node)
 
     def on_timer(r):
-        return _run_chain(r, hp, sh, now, r.app_r[1].astype(_I32))
+        aux = wake[P.AUX]
+        return jax.lax.cond(
+            aux >= 0,
+            lambda rr: _walk_succ(rr, hp, sh, now, aux),
+            lambda rr: _walk_enter(rr, hp, sh, now, -aux - 1), r)
 
     def on_connected(r):
         # our client socket connected; PUT writes now, GET just waits
@@ -402,8 +548,7 @@ def app_tgen(row, hp, sh, now, wake):
             rr = tcp_write(rr, now, slot, size)
             return tcp_close_call(rr, now, slot)
 
-        return jax.lax.cond(is_put & (slot == r.app_r[0].astype(_I32)),
-                            put, lambda rr: rr, r)
+        return jax.lax.cond(is_put & is_client, put, lambda rr: rr, r)
 
     def on_accept(r):
         # server child established: serve the request in its SYN tag
@@ -415,11 +560,9 @@ def app_tgen(row, hp, sh, now, wake):
             rr = tcp_write(rr, now, slot, size)
             return tcp_close_call(rr, now, slot)
 
-        return jax.lax.cond(is_get, serve_get, lambda rr: rr, r)
+        return jax.lax.cond(fresh & is_get, serve_get, lambda rr: rr, r)
 
     def on_eof(r):
-        is_client = slot == r.app_r[0].astype(_I32)
-
         def client_done(rr):
             return _finish_transfer(rr, hp, sh, now, slot)
 
@@ -428,7 +571,8 @@ def app_tgen(row, hp, sh, now, wake):
             # server-side transfer; EOFs on served-GET children (the
             # client's own close) and on already-finished client
             # sockets are teardown noise.
-            is_put_child = (rr.sk_used[slot] & (rr.sk_parent[slot] >= 0) &
+            is_put_child = (fresh & rr.sk_used[slot] &
+                            (rr.sk_parent[slot] >= 0) &
                             ((rr.sk_syn_tag[slot] & TAG_PUT) != 0))
 
             def done_put(r2):
@@ -440,9 +584,8 @@ def app_tgen(row, hp, sh, now, wake):
         return jax.lax.cond(is_client, client_done, other, r)
 
     def on_sent(r):
-        # all written bytes acked. For a client PUT this completes the
-        # transfer; server GET children already have close_after set.
-        is_client = slot == r.app_r[0].astype(_I32)
+        # all written bytes acked: a client PUT's transfer is complete
+        # (server GET children already have close_after set)
         return jax.lax.cond(is_client,
                             lambda rr: _finish_transfer(rr, hp, sh, now,
                                                         slot),

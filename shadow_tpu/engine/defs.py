@@ -42,4 +42,5 @@ ST_XFER_DONE = 13      # app-level transfers completed
 ST_RTT_SUM_US = 14     # accumulated app RTT measurements (microseconds)
 ST_RTT_COUNT = 15      # number of RTT samples
 ST_TXQ_DROP = 16       # dropped: NIC transmit ring full (sndbuf overflow)
-N_STATS = 17
+ST_TGEN_DROP = 17      # tgen walk forks lost to cursor-stack overflow
+N_STATS = 18

@@ -41,6 +41,7 @@ class SimReport:
     wall_seconds: float
     windows: int
     heartbeats: list = field(default_factory=list)
+    capacity: dict = field(default_factory=dict)
 
     def total(self, stat: int) -> int:
         return int(self.stats[:, stat].sum())
@@ -59,6 +60,24 @@ class SimReport:
         if not self.wall_seconds:
             return 0.0
         return (self.sim_time_ns / SIMTIME_ONE_SECOND) / self.wall_seconds
+
+    def capacity_report(self) -> list:
+        """End-of-run capacity accounting (the reference's
+        ObjectCounter shutdown report, shd-slave.c:207-211, recast for
+        fixed arrays): per array, configured capacity, peak occupancy
+        across hosts, and events lost to overflow."""
+        drops = {
+            "event_queue": (self.total(defs.ST_PKTS_DROP_Q) +
+                            self.total(defs.ST_EQ_FULL_LOCAL)),
+            "socket_table": self.total(defs.ST_SOCK_FAIL),
+            "outbox": self.total(defs.ST_OUTBOX_DROP),
+            "nic_txq": self.total(defs.ST_TXQ_DROP),
+        }
+        out = []
+        for name, cap, peak in self.capacity.get("rows", []):
+            out.append({"array": name, "capacity": cap, "peak": peak,
+                        "overflow": drops.get(name, 0)})
+        return out
 
     def summary(self) -> dict:
         mean_rtt_us = (self.total(defs.ST_RTT_SUM_US) /
@@ -213,7 +232,11 @@ class Simulation:
                     hosted_specs.append(
                         (idx, name, proc.plugin[len("hosted:"):],
                          proc.arguments))
-        tg_nodes, tg_peers, tg_pool = tgen_tables.arrays()
+        tg_nodes, tg_peers, tg_pool, tg_edges = tgen_tables.arrays()
+        if tgen_tables.sync_slots > self.cfg.synccap:
+            import dataclasses as _dc
+            self.cfg = _dc.replace(self.cfg,
+                                   synccap=tgen_tables.sync_slots)
 
         # Dead-branch pruning (see EngineConfig): record which app kinds
         # exist and whether TCP can be opened at all.
@@ -276,7 +299,7 @@ class Simulation:
                               R.root_key(seed), scenario.stop_time, min_jump,
                               seed=seed, cc_kind=self.cfg.cc_kind,
                               tgen_nodes=tg_nodes, tgen_peers=tg_peers,
-                              tgen_pool=tg_pool,
+                              tgen_pool=tg_pool, tgen_edges=tg_edges,
                               host_vertex=vertex,
                               host_bw_up=bw_up, host_bw_down=bw_down)
 
@@ -471,11 +494,19 @@ class Simulation:
         stats = np.asarray(hosts.stats)[:H]
         wall = _time.perf_counter() - wall0
         self.final_hosts = hosts
+        peaks = np.asarray(hosts.cap_peaks)[:H].max(axis=0)
+        capacity = {"rows": [
+            ("event_queue", cfg.qcap, int(peaks[0])),
+            ("socket_table", cfg.scap, int(peaks[1])),
+            ("outbox", cfg.obcap, int(peaks[2])),
+            ("nic_txq", cfg.txqcap, int(peaks[3])),
+        ]}
         sim_ns = min(int(sh.stop_time), ws) if ws < SIMTIME_MAX else int(sh.stop_time)
         return SimReport(stats=stats, host_names=self.host_names,
                          sim_time_ns=sim_ns, wall_seconds=wall,
                          windows=total_windows,
-                         heartbeats=(tracker.lines if tracker else []))
+                         heartbeats=(tracker.lines if tracker else []),
+                         capacity=capacity)
 
 
 def run_scenario(scenario: Scenario, **kw) -> SimReport:

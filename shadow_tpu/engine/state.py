@@ -61,6 +61,8 @@ class EngineConfig:
     #   (--cpu-threshold [-1], shd-options.c:76)
     tracecap: int = 0       # packet-trace ring slots per host (obs.pcap;
     #   0 disables tracing entirely — the exchange compiles no trace code)
+    synccap: int = 1        # tgen synchronize-barrier counters per host
+    #   (sized by the Simulation to the compiled graphs' sync-node count)
 
 
 @chex.dataclass
@@ -132,6 +134,9 @@ class Hosts:
     sk_hs_time: jnp.ndarray  # i64 handshake start (connect timeout/rtt)
     sk_last_tx: jnp.ndarray  # i64 last NIC service time (fifo qdisc key)
     sk_syn_tag: jnp.ndarray  # i32 connection-metadata tag carried on SYN
+    sk_app_ref: jnp.ndarray  # i32 app-owner reference for client sockets
+    #   (tgen: the behavior node whose transfer rides this socket; -1
+    #   for server children and non-app sockets)
     # cubic congestion-control per-socket vars (net.congestion)
     sk_cc_wmax: jnp.ndarray   # f32 window before last loss
     sk_cc_epoch: jnp.ndarray  # i64 start of current cubic epoch (-1)
@@ -139,6 +144,7 @@ class Hosts:
     # --- app layer (vectorized behavior machines) ---
     app_node: jnp.ndarray  # [H] i32 current behavior-graph node / phase
     app_r: jnp.ndarray     # [H, 8] i64 app registers
+    tgen_sync: jnp.ndarray  # [H, SY] i32 synchronize-barrier arrival counts
     # --- outbox: packets emitted this window awaiting exchange ---
     ob_pkt: jnp.ndarray    # [H, O, PKT_WORDS] i32
     ob_time: jnp.ndarray   # [H, O] i64 send (wire-entry) time
@@ -156,6 +162,11 @@ class Hosts:
     tr_drop: jnp.ndarray   # [H] i32 records lost to ring overflow
     # --- observability ---
     stats: jnp.ndarray     # [H, N_STATS] i64
+    cap_peaks: jnp.ndarray  # [H, 4] i32 peak occupancy of the fixed
+    #   capacity arrays (0=event queue, 1=socket table, 2=outbox,
+    #   3=NIC tx ring) — the TPU analogue of the reference's
+    #   ObjectCounter end-of-run report (shd-object-counter.c; there
+    #   leaks are the hazard, here capacity headroom is)
 
 
 @chex.dataclass
@@ -205,9 +216,11 @@ class Shared:
     tcp_init_wnd: jnp.ndarray  # f32 initial cwnd, packets (default 10)
     tcp_ssthresh0: jnp.ndarray  # f32 initial ssthresh (0 = discover)
     # tgen behavior-graph tables (apps.tgen; 1-row dummies when unused)
-    tgen_nodes: jnp.ndarray    # [N, 8] i64 node table
+    tgen_nodes: jnp.ndarray    # [N, 10] i64 node table
     tgen_peers: jnp.ndarray    # [M, 2] i32 (host, port) pool
     tgen_pool: jnp.ndarray     # [K] i64 pause-choice pool (ns)
+    tgen_edges: jnp.ndarray    # [E] i32 successor-node pool (multi-edge
+    #   parallel walks: each node points at edges[eoff:eoff+ecnt])
 
 
 def alloc_hosts(cfg: EngineConfig) -> Hosts:
@@ -271,11 +284,13 @@ def alloc_hosts(cfg: EngineConfig) -> Hosts:
         sk_hs_time=full((H, S), 0, jnp.int64),
         sk_last_tx=full((H, S), 0, jnp.int64),
         sk_syn_tag=full((H, S), 0, jnp.int32),
+        sk_app_ref=full((H, S), -1, jnp.int32),
         sk_cc_wmax=full((H, S), 0.0, jnp.float32),
         sk_cc_epoch=full((H, S), -1, jnp.int64),
         sk_cc_k=full((H, S), 0.0, jnp.float32),
         app_node=full((H,), 0, jnp.int32),
         app_r=full((H, 8), 0, jnp.int64),
+        tgen_sync=full((H, max(cfg.synccap, 1)), 0, jnp.int32),
         ob_pkt=full((H, O, PKT_WORDS), 0, jnp.int32),
         ob_time=full((H, O), 0, jnp.int64),
         ob_cnt=full((H,), 0, jnp.int32),
@@ -289,6 +304,7 @@ def alloc_hosts(cfg: EngineConfig) -> Hosts:
         tr_cnt=full((H,), 0, jnp.int32),
         tr_drop=full((H,), 0, jnp.int32),
         stats=full((H, N_STATS), 0, jnp.int64),
+        cap_peaks=full((H, 4), 0, jnp.int32),
     )
 
 
@@ -300,6 +316,7 @@ def make_shared(topo_lat_ns: np.ndarray, topo_rel: np.ndarray, rng_root,
                 tgen_nodes: np.ndarray = None,
                 tgen_peers: np.ndarray = None,
                 tgen_pool: np.ndarray = None,
+                tgen_edges: np.ndarray = None,
                 host_vertex: np.ndarray = None,
                 host_bw_up: np.ndarray = None,
                 host_bw_down: np.ndarray = None) -> Shared:
@@ -310,11 +327,13 @@ def make_shared(topo_lat_ns: np.ndarray, topo_rel: np.ndarray, rng_root,
     if host_bw_down is None:
         host_bw_down = np.ones((1,), np.int64)
     if tgen_nodes is None:
-        tgen_nodes = np.zeros((1, 8), np.int64)
+        tgen_nodes = np.zeros((1, 10), np.int64)
     if tgen_peers is None:
         tgen_peers = np.zeros((1, 2), np.int32)
     if tgen_pool is None:
         tgen_pool = np.zeros((1,), np.int64)
+    if tgen_edges is None:
+        tgen_edges = np.full((1,), -1, np.int32)
     return Shared(
         lat_ns=jnp.asarray(topo_lat_ns, dtype=jnp.int64),
         rel=jnp.asarray(topo_rel, dtype=jnp.float32),
@@ -331,4 +350,5 @@ def make_shared(topo_lat_ns: np.ndarray, topo_rel: np.ndarray, rng_root,
         tgen_nodes=jnp.asarray(tgen_nodes, dtype=jnp.int64),
         tgen_peers=jnp.asarray(tgen_peers, dtype=jnp.int32),
         tgen_pool=jnp.asarray(tgen_pool, dtype=jnp.int64),
+        tgen_edges=jnp.asarray(tgen_edges, dtype=jnp.int32),
     )

@@ -291,6 +291,26 @@ def trace_and_merge(hosts, hp, cfg: EngineConfig, in_pkt, in_time):
     return jax.vmap(merge)(hosts, in_pkt, in_time)
 
 
+def update_cap_peaks(hosts):
+    """Track peak occupancy of the fixed-capacity per-host arrays (one
+    fused elementwise pass per window). Backs the end-of-run capacity
+    report — the TPU analogue of the reference's ObjectCounter
+    new/free accounting (shd-object-counter.c, reported at
+    shd-slave.c:207-211): with no heap objects the failure mode is not
+    a leak but an undersized array, so we report headroom instead.
+
+    Sampled at window boundaries (after the drain for outbox/tx, after
+    the merge for the queue), so short intra-window spikes can exceed
+    the recorded peak — the overflow column of the report is the exact
+    loss signal; peaks are a sizing hint."""
+    eq_fill = jnp.sum(hosts.eq_time != SIMTIME_MAX, axis=1,
+                      dtype=jnp.int32)
+    sk_fill = jnp.sum(hosts.sk_used, axis=1, dtype=jnp.int32)
+    cur = jnp.stack([eq_fill, sk_fill, hosts.ob_cnt, hosts.txq_cnt],
+                    axis=1)
+    return hosts.replace(cap_peaks=jnp.maximum(hosts.cap_peaks, cur))
+
+
 # --- Multi-window driver ---------------------------------------------------
 
 def next_event_time(hosts):
@@ -358,6 +378,7 @@ def _run_windows_impl(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
             return step_all_hosts(h, hp, sh, we_eff, cfg)
 
         hosts = jax.lax.while_loop(ev_cond, ev_body, hosts)
+        hosts = update_cap_peaks(hosts)
         # an empty exchange is the identity: skip its sort/gather work
         # for windows that emitted nothing (common in sparse phases).
         # Single-chip only — the sharded body's collectives must run
@@ -366,6 +387,8 @@ def _run_windows_impl(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
             jnp.any(hosts.ob_cnt > 0),
             lambda h: exchange(h, hp, sh, cfg),
             lambda h: h, hosts)
+        # second sample catches the queue right after arrivals merged
+        hosts = update_cap_peaks(hosts)
         nt = next_event_time(hosts)
         we2 = jnp.where(nt == SIMTIME_MAX, SIMTIME_MAX, nt + sh.min_jump)
         return hosts, nt, we2, i + 1

@@ -67,7 +67,9 @@ class HostOS:
         self._dns = dns
         self._clock = clock          # callable -> current sim time ns
         self._ops: list = []
-        self._socks: dict = {}       # slot -> Sock
+        self._socks: dict = {}       # (slot, gen) -> Sock (live handles;
+        #   entries are dropped at close so the map is bounded by
+        #   concurrently-open sockets)
 
     # --- environment ---
     def now(self) -> int:
@@ -104,6 +106,11 @@ class HostOS:
 
     def close(self, sock):
         self._push(_PendingOp(6, a=self._slot(sock)))
+        # retire the incarnation's handle so _socks stays bounded by
+        # open sockets, not by connections ever opened; a late wake for
+        # the closed incarnation just materializes a fresh handle
+        if isinstance(sock, Sock) and sock.slot is not None:
+            self._socks.pop((sock.slot, sock.gen), None)
 
     def timer(self, delay_ns: int, tag: int = 0):
         self._push(_PendingOp(7, a=self.now() + int(delay_ns),

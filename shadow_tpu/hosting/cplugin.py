@@ -108,7 +108,8 @@ class CPluginApp(HostedApp):
     def __init__(self, so_path: str, args: str):
         self.lib = _load(so_path)
         self.state = self.lib.plugin_create(args.encode())
-        self._socks = []           # handle -> Sock
+        self._socks = []           # handle -> Sock (None = retired)
+        self._free_handles = []    # retired handle indices for reuse
         self._handle_of = {}       # id(Sock) -> handle (stable: HostOS
         #   returns one object per connection incarnation)
         self._os = None
@@ -124,10 +125,16 @@ class CPluginApp(HostedApp):
             return self._os.random()
 
         def _new_handle(sock) -> int:
-            self._socks.append(sock)
-            h = len(self._socks) - 1
+            if self._free_handles:
+                h = self._free_handles.pop()
+                self._socks[h] = sock
+            else:
+                self._socks.append(sock)
+                h = len(self._socks) - 1
             self._handle_of[id(sock)] = h
             return h
+
+        self._new_handle = _new_handle
 
         def udp_open(_, port):
             return _new_handle(self._os.udp_open(port))
@@ -145,7 +152,13 @@ class CPluginApp(HostedApp):
             self._os.write(self._socks[h], nbytes)
 
         def close_sk(_, h):
-            self._os.close(self._socks[h])
+            sock = self._socks[h]
+            self._os.close(sock)
+            # retire the handle: bounded by open sockets, not by
+            # connections ever opened
+            self._handle_of.pop(id(sock), None)
+            self._socks[h] = None
+            self._free_handles.append(h)
 
         def timer(_, delay_ns, tag):
             self._os.timer(delay_ns, tag)
@@ -168,9 +181,7 @@ class CPluginApp(HostedApp):
         # resolve to the right handle.
         h = self._handle_of.get(id(sock))
         if h is None:
-            self._socks.append(sock)
-            h = len(self._socks) - 1
-            self._handle_of[id(sock)] = h
+            h = self._new_handle(sock)
         return h
 
     def _wake(self, os, reason, a=0, b=0, c=0):

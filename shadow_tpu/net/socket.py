@@ -38,10 +38,23 @@ CTL_RST = 16
 
 
 def sock_alloc(row, proto):
-    """Claim a free socket row. Returns (row, slot, ok)."""
+    """Claim a free socket row. Returns (row, slot, ok).
+
+    Under table pressure, recycles the TIME_WAIT socket with the most
+    progress toward its close-timer expiry (real stacks' tw_reuse; the
+    reference's per-peer child hash table has no fixed capacity, so
+    eviction is what keeps a fixed-width table equivalent). Safe: by
+    TIME_WAIT both FINs are exchanged, and the stale close event is
+    filtered by the slot generation."""
     free = ~row.sk_used
-    ok = jnp.any(free)
-    slot = jnp.argmax(free)
+    tw = row.sk_used & (row.sk_state == TCPS_TIME_WAIT)
+    any_free = jnp.any(free)
+    ok = any_free | jnp.any(tw)
+    # TIME_WAIT eviction: longest-resident first (earliest service
+    # stamp) so a recycled connection's 2MSL protection degrades
+    # gracefully; non-tw rows rank last
+    tw_rank = jnp.where(tw, row.sk_last_tx, jnp.iinfo(jnp.int64).max)
+    slot = jnp.where(any_free, jnp.argmax(free), jnp.argmin(tw_rank))
 
     def setf(arr, val, dt):
         return rset_where(arr, slot, ok, jnp.asarray(val, dt))
@@ -84,6 +97,7 @@ def sock_alloc(row, proto):
         sk_hs_time=setf(row.sk_hs_time, 0, jnp.int64),
         sk_last_tx=setf(row.sk_last_tx, 0, jnp.int64),
         sk_syn_tag=setf(row.sk_syn_tag, 0, jnp.int32),
+        sk_app_ref=setf(row.sk_app_ref, -1, jnp.int32),
         sk_cc_wmax=setf(row.sk_cc_wmax, 0.0, jnp.float32),
         sk_cc_epoch=setf(row.sk_cc_epoch, -1, jnp.int64),
         sk_cc_k=setf(row.sk_cc_k, 0.0, jnp.float32),
@@ -101,6 +115,7 @@ def sock_free(row, slot):
         sk_rto_deadline=rset(row.sk_rto_deadline, slot, 0),
         sk_timer_on=rset(row.sk_timer_on, slot, False),
         sk_timer_gen=radd(row.sk_timer_gen, slot, 1),
+        sk_app_ref=rset(row.sk_app_ref, slot, -1),
     )
 
 

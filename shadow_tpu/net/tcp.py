@@ -413,6 +413,12 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
     estA = (state0 == TCPS_SYN_SENT) & syn & ackf       # our SYN answered
     estB = (state0 == TCPS_SYN_RECEIVED) & ackf & ~syn  # our SYN|ACK acked
     resyn = (state0 == TCPS_SYN_RECEIVED) & syn & ~ackf  # dup SYN: re-answer
+    # dup SYN|ACK after we established (our handshake ACK was lost and
+    # the peer's SYN|ACK retransmitted): answer with an ACK or the peer
+    # stays in SYN_RECEIVED forever (standard TCP: duplicate segments
+    # elicit an ACK; the reference reaches the same via ackd-state
+    # responses in its packet processing)
+    resynack = (state0 >= TCPS_ESTABLISHED) & syn & ackf
     state1 = jnp.where(estA | estB, TCPS_ESTABLISHED, state0).astype(_I32)
 
     hs_rtt = now - rget(row.sk_hs_time, slot)
@@ -423,7 +429,8 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
                sk_state=state1,
                sk_ctl=rget(row.sk_ctl, slot)
                | jnp.where(estA, CTL_ACKNOW, 0)
-               | jnp.where(resyn, CTL_SYNACK, 0),
+               | jnp.where(resyn, CTL_SYNACK, 0)
+               | jnp.where(resynack, CTL_ACKNOW, 0),
                sk_srtt=jnp.where(est, hs_srtt, rget(row.sk_srtt, slot)),
                sk_rttvar=jnp.where(est, hs_rttvar, rget(row.sk_rttvar, slot)),
                sk_rto=jnp.where(est, hs_rto, rget(row.sk_rto, slot)),

@@ -43,7 +43,7 @@ from ..core.simtime import SIMTIME_MAX
 from ..engine import equeue
 from ..engine.defs import (EV_PKT, ST_PKTS_DROP_NET, ST_PKTS_DROP_Q)
 from ..engine.state import EngineConfig
-from ..engine.window import step_all_hosts
+from ..engine.window import step_all_hosts, update_cap_peaks
 from ..net import packet as P
 
 AXIS = "hosts"
@@ -132,7 +132,9 @@ def _windows_body(hosts, hp, sh, wstart, wend, cfg, lcfg, max_windows):
             return step_all_hosts(h, hp, sh, we_eff, cfg)
 
         hosts = jax.lax.while_loop(ev_cond, ev_body, hosts)
+        hosts = update_cap_peaks(hosts)
         hosts = exchange_sharded(hosts, hp, sh, cfg, lcfg)
+        hosts = update_cap_peaks(hosts)
         nt = next_time_global(hosts)
         we2 = jnp.where(nt == SIMTIME_MAX, SIMTIME_MAX, nt + sh.min_jump)
         return hosts, nt, we2, i + 1

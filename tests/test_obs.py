@@ -88,3 +88,35 @@ def test_logger_levels(capsys):
     assert "hello" in out and "0:00:01.500000000" in out
     assert "invisible" not in out
     assert "visible" in out
+
+
+def test_capacity_report(simple_topology_xml):
+    """End-of-run capacity accounting (the ObjectCounter analogue):
+    peaks reflect real occupancy and no overflow on a healthy run."""
+    from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario
+    from shadow_tpu.engine.sim import Simulation
+
+    scen = Scenario(
+        stop_time=5 * 10**9,
+        topology_graphml=simple_topology_xml,
+        hosts=[
+            HostSpec(id="server", processes=[
+                ProcessSpec(plugin="pingserver", start_time=10**9,
+                            arguments="port=9000")]),
+            HostSpec(id="client", processes=[
+                ProcessSpec(plugin="ping", start_time=10**9,
+                            arguments="peer=server port=9000 "
+                                      "interval=100ms count=10")]),
+        ],
+    )
+    report = Simulation(scen).run()
+    rows = {r["array"]: r for r in report.capacity_report()}
+    assert set(rows) == {"event_queue", "socket_table", "outbox",
+                         "nic_txq"}
+    # the ping exchange touched the queue, sockets and outbox
+    assert rows["event_queue"]["peak"] >= 1
+    assert rows["socket_table"]["peak"] >= 1
+    assert rows["outbox"]["peak"] >= 1
+    for r in rows.values():
+        assert r["peak"] <= r["capacity"]
+        assert r["overflow"] == 0

@@ -12,8 +12,9 @@ from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario
 from shadow_tpu.engine import defs
 from shadow_tpu.engine.sim import Simulation
 from shadow_tpu.apps.tgen import (TgenTables, parse_size, NK_START,
-                                  NK_TRANSFER, NK_PAUSE, NK_END,
-                                  COL_KIND, COL_A, COL_B, COL_NEXT)
+                                  NK_TRANSFER, NK_PAUSE, NK_END, NK_SYNC,
+                                  COL_KIND, COL_A, COL_B, COL_NEXT,
+                                  COL_EOFF, COL_ECNT, NODE_COLS)
 
 SERVER_GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
   <key attr.name="serverport" attr.type="string" for="node" id="d0" />
@@ -100,8 +101,8 @@ def test_graph_compile(simple_topology_xml):
         dns.register(i, name, None)
     tab = TgenTables()
     start = tab.compile(WEB_GRAPH, dns)
-    nodes, peers, pool = tab.arrays()
-    assert nodes.shape == (4, 8)
+    nodes, peers, pool, edges = tab.arrays()
+    assert nodes.shape == (4, NODE_COLS)
     assert nodes[start, COL_KIND] == NK_START
     kinds = set(nodes[:, COL_KIND].tolist())
     assert kinds == {NK_START, NK_TRANSFER, NK_PAUSE, NK_END}
@@ -145,3 +146,102 @@ def test_tgen_deterministic(simple_topology_xml):
     r1 = Simulation(tgen_scenario(simple_topology_xml)).run()
     r2 = Simulation(tgen_scenario(simple_topology_xml)).run()
     assert np.array_equal(r1.stats, r2.stats)
+
+
+# fork: start fans out to TWO parallel transfers; synchronize joins them
+# before end counts a round (reference tgen multi-edge walk +
+# synchronize action, shd-tgen-graph.c / shd-tgen-action.c)
+FORK_GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="count" attr.type="string" for="node" id="d6" />
+  <key attr.name="size" attr.type="string" for="node" id="d5" />
+  <key attr.name="type" attr.type="string" for="node" id="d4" />
+  <key attr.name="peers" attr.type="string" for="node" id="d0" />
+  <graph edgedefault="directed">
+    <node id="start">
+      <data key="d0">server1:30080,server2:30080</data>
+    </node>
+    <node id="transfer1">
+      <data key="d4">get</data><data key="d5">10 KiB</data>
+    </node>
+    <node id="transfer2">
+      <data key="d4">get</data><data key="d5">20 KiB</data>
+    </node>
+    <node id="synchronize" />
+    <node id="end"><data key="d6">4</data></node>
+    <edge source="start" target="transfer1" />
+    <edge source="start" target="transfer2" />
+    <edge source="transfer1" target="synchronize" />
+    <edge source="transfer2" target="synchronize" />
+    <edge source="synchronize" target="end" />
+    <edge source="end" target="start" />
+  </graph>
+</graphml>"""
+
+
+def test_fork_graph_compile(simple_topology_xml):
+    from shadow_tpu.routing.dns import DNS
+    dns = DNS()
+    for i, name in enumerate(["server1", "server2"]):
+        dns.register(i, name, None)
+    tab = TgenTables()
+    start = tab.compile(FORK_GRAPH, dns)
+    nodes, peers, pool, edges = tab.arrays()
+    assert nodes.shape == (5, NODE_COLS)
+    # start has two out-edges (the fork)
+    assert nodes[start, COL_ECNT] == 2
+    s_eoff = nodes[start, COL_EOFF]
+    forks = edges[s_eoff:s_eoff + 2].tolist()
+    assert sorted(nodes[f, COL_KIND] for f in forks) == [NK_TRANSFER,
+                                                         NK_TRANSFER]
+    # synchronize has indegree 2
+    sync = [i for i in range(5) if nodes[i, COL_KIND] == NK_SYNC][0]
+    assert nodes[sync, COL_A] == 2
+    assert tab.sync_slots == 1
+
+
+def test_tgen_fork_and_synchronize(simple_topology_xml):
+    """Both forked transfers complete each round; synchronize fires only
+    after BOTH arrive; 2 rounds x 2 transfers = 4 completions."""
+    scen = Scenario(
+        stop_time=60 * 10**9,
+        topology_graphml=simple_topology_xml,
+        hosts=[
+            HostSpec(id="server", quantity=2, processes=[
+                ProcessSpec(plugin="tgen", start_time=10**9,
+                            arguments=SERVER_GRAPH)]),
+            HostSpec(id="client", quantity=2, processes=[
+                ProcessSpec(plugin="tgen", start_time=2 * 10**9,
+                            arguments=FORK_GRAPH)]),
+        ],
+    )
+    report = Simulation(scen).run()
+    stats = report.stats
+    clients = slice(2, 4)
+    # each client: 2 rounds of (2 parallel GETs + sync join) = 4 xfers
+    assert (stats[clients, defs.ST_XFER_DONE] == 4).all(), \
+        stats[:, defs.ST_XFER_DONE]
+    assert (stats[clients, defs.ST_APP_DONE] == 1).all()
+    # both payloads arrived each round: 2 x (10 + 20) KiB
+    assert (stats[clients, defs.ST_BYTES_RECV] >=
+            2 * (10 + 20) * 1024).all()
+    # no walk branches were lost to cursor-stack overflow
+    assert (stats[:, defs.ST_TGEN_DROP] == 0).all()
+
+
+def test_tgen_nonblocking_cycle_rejected():
+    from shadow_tpu.routing.dns import DNS
+    dns = DNS()
+    dns.register(0, "server1", None)
+    bad = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+      <graph edgedefault="directed">
+        <node id="start" />
+        <node id="pause"><data key="time">0</data></node>
+        <node id="end" />
+        <edge source="start" target="pause" />
+        <edge source="pause" target="end" />
+        <edge source="end" target="pause" />
+      </graph>
+    </graphml>"""
+    tab = TgenTables()
+    with pytest.raises(ValueError, match="cycle never blocks"):
+        tab.compile(bad, dns)

@@ -1,0 +1,137 @@
+"""Topology toolkit tests (tools/topology_tool.py): the reference's
+src/tools/topology pipeline (prune -> compute-paths -> collapse)
+rebuilt on the framework's own routing oracle."""
+
+import csv
+import importlib.util
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+_spec = importlib.util.spec_from_file_location(
+    "topology_tool",
+    Path(__file__).resolve().parent.parent / "tools" / "topology_tool.py")
+ttool = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(ttool)
+
+from shadow_tpu.routing.graphml import parse_graphml  # noqa: E402
+
+# two geocode clusters (us: a,b / eu: c,d), chain a-b-c-d plus a 'relay'
+# that prune removes
+CHAIN = """<?xml version="1.0"?>
+<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="d7"/>
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d9"/>
+  <key attr.name="geocode" attr.type="string" for="node" id="d1"/>
+  <key attr.name="type" attr.type="string" for="node" id="d2"/>
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d4"/>
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d3"/>
+  <graph edgedefault="undirected">
+    <node id="a"><data key="d1">us</data><data key="d2">server</data>
+      <data key="d4">1000</data><data key="d3">1000</data></node>
+    <node id="b"><data key="d1">us</data><data key="d2">server</data>
+      <data key="d4">1000</data><data key="d3">1000</data></node>
+    <node id="c"><data key="d1">eu</data><data key="d2">server</data>
+      <data key="d4">2000</data><data key="d3">2000</data></node>
+    <node id="d"><data key="d1">eu</data><data key="d2">server</data>
+      <data key="d4">2000</data><data key="d3">2000</data></node>
+    <node id="x"><data key="d1">as</data><data key="d2">relay</data>
+      <data key="d4">500</data><data key="d3">500</data></node>
+    <edge source="a" target="b"><data key="d7">5.0</data></edge>
+    <edge source="b" target="c"><data key="d7">40.0</data>
+      <data key="d9">0.01</data></edge>
+    <edge source="c" target="d"><data key="d7">5.0</data></edge>
+    <edge source="d" target="x"><data key="d7">100.0</data></edge>
+  </graph>
+</graphml>"""
+
+
+@pytest.fixture
+def chain_file(tmp_path):
+    p = tmp_path / "chain.graphml.xml"
+    p.write_text(CHAIN)
+    return str(p)
+
+
+def test_prune_by_type(chain_file, tmp_path, capsys):
+    out = tmp_path / "pruned.graphml.xml"
+    ttool.main(["prune", chain_file, "--keep-types", "server",
+                "--out", str(out)])
+    g = parse_graphml(str(out))
+    assert sorted(g.vertex_ids) == ["a", "b", "c", "d"]
+    assert g.num_edges == 3  # d-x edge dropped with x
+
+
+def test_compute_paths_complete(chain_file, tmp_path):
+    out = tmp_path / "complete.graphml.xml"
+    ttool.main(["compute-paths", chain_file, "--out", str(out)])
+    g = parse_graphml(str(out))
+    V = g.num_vertices
+    assert V == 5
+    # complete: every unordered pair + self loops
+    assert g.num_edges == V * (V + 1) // 2
+    lookup = {}
+    for k in range(g.num_edges):
+        s, t = g.vertex_ids[g.e_src[k]], g.vertex_ids[g.e_dst[k]]
+        lookup[frozenset((s, t))] = (g.e_latency_ms[k], g.e_packetloss[k])
+    lat_ad, loss_ad = lookup[frozenset(("a", "d"))]
+    assert lat_ad == pytest.approx(50.0)          # 5 + 40 + 5
+    assert loss_ad == pytest.approx(0.01)         # the b-c lossy hop
+    # feeding the complete graph back into the simulator's loader gives
+    # the same pairwise table (no Dijkstra needed at load time)
+    from shadow_tpu.routing.topology import build_topology
+    topo = build_topology(str(out))
+    ia, idd = g.vertex_ids.index("a"), g.vertex_ids.index("d")
+    # original graph through the oracle:
+    topo0 = build_topology(chain_file)
+    assert topo.latency_ns[ia, idd] == topo0.latency_ns[ia, idd]
+
+
+def test_collapse_by_geocode(chain_file, tmp_path):
+    pruned = tmp_path / "pruned.graphml.xml"
+    ttool.main(["prune", chain_file, "--keep-types", "server",
+                "--out", str(pruned)])
+    out = tmp_path / "collapsed.graphml.xml"
+    ttool.main(["collapse", str(pruned), "--by", "geocode",
+                "--out", str(out)])
+    g = parse_graphml(str(out))
+    assert g.num_vertices == 2  # us + eu clusters
+    assert set(g.v_geocode) == {"us", "eu"}
+    # inter-cluster latency = median of {a,b}x{c,d} path latencies
+    # paths: a-c 45, a-d 50, b-c 40, b-d 45 -> median 45
+    inter = [g.e_latency_ms[k] for k in range(g.num_edges)
+             if g.e_src[k] != g.e_dst[k]]
+    assert inter == [pytest.approx(45.0)]
+    # bandwidth = cluster median
+    assert set(g.v_bw_up.tolist()) == {1000.0, 2000.0}
+
+
+def test_extract_latencies_csv(chain_file, tmp_path):
+    out = tmp_path / "lat.csv"
+    ttool.main(["extract-latencies", chain_file, "--out", str(out)])
+    with open(out) as f:
+        rows = list(csv.DictReader(f))
+    d = {(r["source"], r["target"]): float(r["latency_ms"]) for r in rows}
+    assert d[("a", "c")] == pytest.approx(45.0)
+    assert len(rows) == 5 * 4
+
+
+def test_convert_csv_roundtrip(tmp_path):
+    src = tmp_path / "edges.csv"
+    src.write_text("source,target,latency_ms,loss\n"
+                   "n1,n2,12.5,0.001\nn2,n3,30,\n".replace(",\n", ",0\n"))
+    out = tmp_path / "conv.graphml.xml"
+    ttool.main(["convert", str(src), "--out", str(out)])
+    g = parse_graphml(str(out))
+    assert g.vertex_ids == ["n1", "n2", "n3"]
+    assert g.e_latency_ms.tolist() == [12.5, 30.0]
+    assert g.e_packetloss[0] == pytest.approx(0.001)
+
+
+def test_info_runs(chain_file, capsys):
+    ttool.main(["info", chain_file])
+    out = capsys.readouterr().out
+    assert "vertices: 5" in out
+    assert "connected components: 1" in out

@@ -1,0 +1,372 @@
+#!/usr/bin/env python3
+"""Topology toolkit: inspect and transform GraphML network topologies.
+
+The TPU-native counterpart of the reference's src/tools/topology
+pipeline (readme: full map --prune--> pruned --compute-paths-->
+complete --collapse--> clustered), rebuilt on this framework's own
+routing oracle (shadow_tpu.routing) instead of networkx/igraph:
+
+  info               vertex/edge/attribute/connectivity summary
+  prune              keep a vertex subset (by type / id file), then the
+                     largest connected component of what remains
+                     (prune-topology-relays.py role)
+  compute-paths      emit the COMPLETE graph whose edge (u,v) carries
+                     the shortest-path latency and end-to-end
+                     reliability-derived packetloss between u and v
+                     (compute-topology-paths.py role) — a complete
+                     graph needs no Dijkstra at simulation time
+  collapse           cluster vertices by geocode/type/asn into one
+                     point-of-interest per cluster; inter-cluster edges
+                     carry the median of member-pair path latencies
+                     (collapse-topology.py role)
+  extract-latencies  pairwise shortest-path latency CSV
+                     (extract-pairwise-latencies.py role)
+  convert            CSV edge list -> GraphML
+                     (convert-topology.py role for external formats)
+
+All subcommands read .graphml[.xml][.xz] via shadow_tpu.routing.graphml
+and write plain GraphML. Latencies are milliseconds, bandwidths KiB/s,
+losses are probabilities — the schema both this framework and the
+reference consume.
+"""
+
+import argparse
+import csv
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from shadow_tpu.routing.graphml import Graph, parse_graphml  # noqa: E402
+
+
+# --- GraphML emission -------------------------------------------------------
+
+def write_graphml(g: Graph, out, complete_attrs=False):
+    """Serialize a Graph back to GraphML (undirected)."""
+    w = out.write
+    w('<?xml version="1.0" encoding="utf-8"?>\n')
+    w('<graphml xmlns="http://graphml.graphdrawing.org/xmlns">\n')
+    w('  <key attr.name="packetloss" attr.type="double" for="edge" id="e0" />\n')
+    w('  <key attr.name="latency" attr.type="double" for="edge" id="e1" />\n')
+    w('  <key attr.name="jitter" attr.type="double" for="edge" id="e2" />\n')
+    w('  <key attr.name="packetloss" attr.type="double" for="node" id="n0" />\n')
+    w('  <key attr.name="bandwidthup" attr.type="int" for="node" id="n1" />\n')
+    w('  <key attr.name="bandwidthdown" attr.type="int" for="node" id="n2" />\n')
+    w('  <key attr.name="type" attr.type="string" for="node" id="n3" />\n')
+    w('  <key attr.name="geocode" attr.type="string" for="node" id="n4" />\n')
+    w('  <key attr.name="ip" attr.type="string" for="node" id="n5" />\n')
+    w('  <key attr.name="asn" attr.type="int" for="node" id="n6" />\n')
+    w('  <graph edgedefault="undirected">\n')
+    for i, vid in enumerate(g.vertex_ids):
+        w(f'    <node id="{vid}">\n')
+        if g.v_packetloss is not None and g.v_packetloss[i]:
+            w(f'      <data key="n0">{g.v_packetloss[i]:g}</data>\n')
+        if g.v_bw_up is not None and g.v_bw_up[i]:
+            w(f'      <data key="n1">{int(g.v_bw_up[i])}</data>\n')
+        if g.v_bw_down is not None and g.v_bw_down[i]:
+            w(f'      <data key="n2">{int(g.v_bw_down[i])}</data>\n')
+        if g.v_type and g.v_type[i]:
+            w(f'      <data key="n3">{g.v_type[i]}</data>\n')
+        if g.v_geocode and g.v_geocode[i]:
+            w(f'      <data key="n4">{g.v_geocode[i]}</data>\n')
+        if g.v_ip and g.v_ip[i]:
+            w(f'      <data key="n5">{g.v_ip[i]}</data>\n')
+        if g.v_asn is not None and g.v_asn[i]:
+            w(f'      <data key="n6">{int(g.v_asn[i])}</data>\n')
+        w('    </node>\n')
+    E = g.num_edges
+    for k in range(E):
+        s = g.vertex_ids[g.e_src[k]]
+        t = g.vertex_ids[g.e_dst[k]]
+        w(f'    <edge source="{s}" target="{t}">\n')
+        w(f'      <data key="e1">{g.e_latency_ms[k]:g}</data>\n')
+        if g.e_packetloss is not None and g.e_packetloss[k]:
+            w(f'      <data key="e0">{g.e_packetloss[k]:g}</data>\n')
+        if g.e_jitter_ms is not None and g.e_jitter_ms[k]:
+            w(f'      <data key="e2">{g.e_jitter_ms[k]:g}</data>\n')
+        w('    </edge>\n')
+    w('  </graph>\n</graphml>\n')
+
+
+def _open_out(path):
+    return open(path, "w") if path else sys.stdout
+
+
+def _subgraph(g: Graph, keep: np.ndarray) -> Graph:
+    """Vertex-induced subgraph; `keep` is a bool mask over vertices."""
+    idx = np.flatnonzero(keep)
+    remap = -np.ones(g.num_vertices, dtype=np.int64)
+    remap[idx] = np.arange(len(idx))
+    emask = keep[g.e_src] & keep[g.e_dst]
+    ng = Graph(vertex_ids=[g.vertex_ids[i] for i in idx],
+               directed=g.directed)
+    ng.v_ip = [g.v_ip[i] for i in idx]
+    ng.v_geocode = [g.v_geocode[i] for i in idx]
+    ng.v_type = [g.v_type[i] for i in idx]
+    ng.v_asn = g.v_asn[idx]
+    ng.v_bw_up = g.v_bw_up[idx]
+    ng.v_bw_down = g.v_bw_down[idx]
+    ng.v_packetloss = g.v_packetloss[idx]
+    ng.e_src = remap[g.e_src[emask]]
+    ng.e_dst = remap[g.e_dst[emask]]
+    ng.e_latency_ms = g.e_latency_ms[emask]
+    ng.e_jitter_ms = g.e_jitter_ms[emask]
+    ng.e_packetloss = g.e_packetloss[emask]
+    return ng
+
+
+def _components(g: Graph):
+    """Connected-component label per vertex (undirected union-find)."""
+    parent = np.arange(g.num_vertices)
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for s, t in zip(g.e_src, g.e_dst):
+        rs, rt = find(s), find(t)
+        if rs != rt:
+            parent[rs] = rt
+    return np.array([find(i) for i in range(g.num_vertices)])
+
+
+def _apsp(g: Graph):
+    """(latency_ms [V,V] with NaN for unreachable, reliability [V,V])
+    via the framework oracle."""
+    from shadow_tpu.routing.topology import compute_all_pairs
+    lat_ms, rel, unreachable = compute_all_pairs(g)
+    lat_ms = lat_ms.astype(float).copy()
+    lat_ms[unreachable] = np.nan
+    return lat_ms, rel
+
+
+# --- subcommands ------------------------------------------------------------
+
+def cmd_info(args):
+    g = parse_graphml(args.input)
+    comp = _components(g)
+    ncomp = len(np.unique(comp))
+    V, E = g.num_vertices, g.num_edges
+    types = sorted(set(t for t in g.v_type if t))
+    geos = sorted(set(c for c in g.v_geocode if c))
+    complete = E >= V * (V - 1) // 2
+    print(f"vertices: {V}")
+    print(f"edges: {E} ({'complete' if complete else 'sparse'})")
+    print(f"connected components: {ncomp}")
+    print(f"directed: {g.directed}")
+    if E:
+        print(f"latency ms: min={g.e_latency_ms.min():g} "
+              f"median={np.median(g.e_latency_ms):g} "
+              f"max={g.e_latency_ms.max():g}")
+        print(f"edge loss: max={g.e_packetloss.max():g}")
+    print(f"vertex types: {types}")
+    print(f"geocodes: {len(geos)}")
+
+
+def cmd_prune(args):
+    g = parse_graphml(args.input)
+    keep = np.ones(g.num_vertices, dtype=bool)
+    if args.keep_types:
+        allowed = set(args.keep_types.split(","))
+        keep &= np.array([t in allowed for t in g.v_type])
+    if args.keep_ids:
+        with open(args.keep_ids) as f:
+            ids = {ln.strip() for ln in f if ln.strip()}
+        keep &= np.array([v in ids for v in g.vertex_ids])
+    g = _subgraph(g, keep)
+    # largest connected component of what remains (a disconnected
+    # topology fails validation at load, shd-topology.c:232-474)
+    comp = _components(g)
+    if g.num_vertices:
+        vals, counts = np.unique(comp, return_counts=True)
+        g = _subgraph(g, comp == vals[np.argmax(counts)])
+    with _open_out(args.out) as f:
+        write_graphml(g, f)
+    print(f"pruned to {g.num_vertices} vertices / {g.num_edges} edges",
+          file=sys.stderr)
+
+
+def cmd_compute_paths(args):
+    g = parse_graphml(args.input)
+    lat_ms, rel = _apsp(g)
+    V = g.num_vertices
+    ng = Graph(vertex_ids=list(g.vertex_ids), directed=False)
+    ng.v_ip, ng.v_geocode, ng.v_type = g.v_ip, g.v_geocode, g.v_type
+    ng.v_asn, ng.v_bw_up, ng.v_bw_down = g.v_asn, g.v_bw_up, g.v_bw_down
+    # vertex loss folds into the path loss on the complete graph
+    ng.v_packetloss = np.zeros(V)
+    src, dst, lat, loss = [], [], [], []
+    for i in range(V):
+        for j in range(i, V):
+            if not np.isfinite(lat_ms[i, j]):
+                continue
+            src.append(i)
+            dst.append(j)
+            lat.append(max(lat_ms[i, j], args.min_latency))
+            loss.append(1.0 - float(rel[i, j]))
+    ng.e_src = np.array(src, dtype=np.int64)
+    ng.e_dst = np.array(dst, dtype=np.int64)
+    ng.e_latency_ms = np.array(lat)
+    ng.e_jitter_ms = np.zeros(len(lat))
+    ng.e_packetloss = np.array(loss)
+    with _open_out(args.out) as f:
+        write_graphml(ng, f)
+    print(f"complete graph: {V} vertices / {len(lat)} edges",
+          file=sys.stderr)
+
+
+def cmd_collapse(args):
+    g = parse_graphml(args.input)
+    key_of = {"geocode": g.v_geocode, "type": g.v_type,
+              "asn": [str(a) for a in g.v_asn]}[args.by]
+    lat_ms, rel = _apsp(g)
+    labels = sorted(set(k or "none" for k in key_of))
+    group = {lab: np.array([i for i, k in enumerate(key_of)
+                            if (k or "none") == lab]) for lab in labels}
+    C = len(labels)
+    ng = Graph(vertex_ids=[f"poi-{i + 1}" for i in range(C)],
+               directed=False)
+    ng.v_ip = ["" for _ in range(C)]
+    ng.v_geocode = [lab if args.by == "geocode" else "" for lab in labels]
+    ng.v_type = ["cluster" for _ in range(C)]
+    ng.v_asn = np.zeros(C, dtype=np.int64)
+    ng.v_bw_up = np.array([np.median(g.v_bw_up[group[lab]])
+                           for lab in labels])
+    ng.v_bw_down = np.array([np.median(g.v_bw_down[group[lab]])
+                             for lab in labels])
+    ng.v_packetloss = np.array([np.median(g.v_packetloss[group[lab]])
+                                for lab in labels])
+    src, dst, lat, loss = [], [], [], []
+    for a in range(C):
+        ia = group[labels[a]]
+        for b in range(a, C):
+            ib = group[labels[b]]
+            block_l = lat_ms[np.ix_(ia, ib)]
+            block_r = rel[np.ix_(ia, ib)]
+            if a == b and len(ia) == 1:
+                # self-loop for intra-cluster traffic
+                med_l, med_r = args.min_latency, 1.0
+            else:
+                finite = np.isfinite(block_l)
+                if a == b:
+                    finite &= ~np.eye(len(ia), dtype=bool)
+                if not finite.any():
+                    continue
+                med_l = max(float(np.median(block_l[finite])),
+                            args.min_latency)
+                med_r = float(np.median(block_r[finite]))
+            src.append(a)
+            dst.append(b)
+            lat.append(med_l)
+            loss.append(max(1.0 - med_r, 0.0))
+    ng.e_src = np.array(src, dtype=np.int64)
+    ng.e_dst = np.array(dst, dtype=np.int64)
+    ng.e_latency_ms = np.array(lat)
+    ng.e_jitter_ms = np.zeros(len(lat))
+    ng.e_packetloss = np.array(loss)
+    with _open_out(args.out) as f:
+        write_graphml(ng, f)
+    print(f"collapsed {g.num_vertices} vertices into {C} clusters",
+          file=sys.stderr)
+
+
+def cmd_extract_latencies(args):
+    g = parse_graphml(args.input)
+    lat_ms, _ = _apsp(g)
+    with _open_out(args.out) as f:
+        wr = csv.writer(f)
+        wr.writerow(["source", "target", "latency_ms"])
+        for i in range(g.num_vertices):
+            for j in range(g.num_vertices):
+                if i != j and np.isfinite(lat_ms[i, j]):
+                    wr.writerow([g.vertex_ids[i], g.vertex_ids[j],
+                                 f"{lat_ms[i, j]:g}"])
+
+
+def cmd_convert(args):
+    """CSV edge list (source,target,latency_ms[,packetloss]) -> GraphML."""
+    rows = []
+    with open(args.input) as f:
+        for rec in csv.reader(f):
+            if not rec or rec[0].startswith("#") or rec[0] == "source":
+                continue
+            rows.append(rec)
+    ids = []
+    index = {}
+    for rec in rows:
+        for v in rec[:2]:
+            if v not in index:
+                index[v] = len(ids)
+                ids.append(v)
+    V = len(ids)
+    g = Graph(vertex_ids=ids, directed=False)
+    g.v_ip = ["" for _ in range(V)]
+    g.v_geocode = ["" for _ in range(V)]
+    g.v_type = ["" for _ in range(V)]
+    g.v_asn = np.zeros(V, dtype=np.int64)
+    g.v_bw_up = np.full(V, float(args.bw))
+    g.v_bw_down = np.full(V, float(args.bw))
+    g.v_packetloss = np.zeros(V)
+    g.e_src = np.array([index[r[0]] for r in rows], dtype=np.int64)
+    g.e_dst = np.array([index[r[1]] for r in rows], dtype=np.int64)
+    g.e_latency_ms = np.array([float(r[2]) for r in rows])
+    g.e_jitter_ms = np.zeros(len(rows))
+    g.e_packetloss = np.array([float(r[3]) if len(r) > 3 else 0.0
+                               for r in rows])
+    with _open_out(args.out) as f:
+        write_graphml(g, f)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("info")
+    p.add_argument("input")
+    p.set_defaults(fn=cmd_info)
+
+    p = sub.add_parser("prune")
+    p.add_argument("input")
+    p.add_argument("--keep-types", help="comma list of vertex types")
+    p.add_argument("--keep-ids", help="file of vertex ids, one per line")
+    p.add_argument("--out")
+    p.set_defaults(fn=cmd_prune)
+
+    p = sub.add_parser("compute-paths")
+    p.add_argument("input")
+    p.add_argument("--min-latency", type=float, default=1.0,
+                   help="floor for emitted latencies, ms")
+    p.add_argument("--out")
+    p.set_defaults(fn=cmd_compute_paths)
+
+    p = sub.add_parser("collapse")
+    p.add_argument("input")
+    p.add_argument("--by", choices=["geocode", "type", "asn"],
+                   default="geocode")
+    p.add_argument("--min-latency", type=float, default=1.0)
+    p.add_argument("--out")
+    p.set_defaults(fn=cmd_collapse)
+
+    p = sub.add_parser("extract-latencies")
+    p.add_argument("input")
+    p.add_argument("--out")
+    p.set_defaults(fn=cmd_extract_latencies)
+
+    p = sub.add_parser("convert")
+    p.add_argument("input", help="CSV: source,target,latency_ms[,loss]")
+    p.add_argument("--bw", type=int, default=102400,
+                   help="vertex bandwidth KiB/s for converted graphs")
+    p.add_argument("--out")
+    p.set_defaults(fn=cmd_convert)
+
+    args = ap.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()
