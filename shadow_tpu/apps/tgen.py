@@ -56,6 +56,9 @@ from .base import draw, timer
 
 # --- node table encoding (Shared.tgen_nodes: int64 [N, 10]) ---
 # [kind, a, b, c, next, peers_off, n_peers, sync_ref, edge_off, edge_cnt]
+# `next` = first successor, kept as a debugging/inspection convenience
+# (tests walk it); the device walk routes ONLY through the edge pool
+# (edge_off/edge_cnt -> Shared.tgen_edges).
 NK_START = 0      # a=serverport, b=initial delay ns
 NK_TRANSFER = 1   # a=type (0 get, 1 put), b=size bytes
 NK_PAUSE = 2      # a=fixed time ns (or -1: draw from pool[b:b+c])

@@ -390,6 +390,20 @@ class Simulation:
         self._ran = True
         H = self.cfg.num_hosts
 
+        from ..parallel import dist
+        multiproc = dist.is_multiprocess()
+        if multiproc:
+            if self.hosting:
+                raise NotImplementedError(
+                    "hosted apps + multi-process mesh not supported")
+            if checkpoint_path or resume_from:
+                raise NotImplementedError(
+                    "checkpoint/resume + multi-process mesh not "
+                    "supported yet (snapshots are per-process)")
+            if pcap_dir is not None:
+                raise NotImplementedError(
+                    "pcap capture + multi-process mesh not supported")
+
         tracker = None
         if heartbeat_s:
             from ..obs.tracker import Tracker
@@ -428,7 +442,13 @@ class Simulation:
                 return run_windows_sharded(hosts, hp, sh, ws, we, cfg,
                                            cfg.chunk_windows, mesh)
 
-        t0 = jnp.min(hosts.eq_time)
+        if multiproc:
+            # eager reductions cannot run on non-addressable global
+            # arrays; a jitted min yields a replicated (addressable)
+            # scalar on every process
+            t0 = jax.jit(jnp.min)(hosts.eq_time)
+        else:
+            t0 = jnp.min(hosts.eq_time)
         wstart = t0
         wend = jnp.where(t0 == SIMTIME_MAX, t0, t0 + sh.min_jump)
 
@@ -477,9 +497,10 @@ class Simulation:
                 pcap.drain(hosts.tr_time, hosts.tr_pkt, hosts.tr_cnt)
                 hosts = hosts.replace(
                     tr_cnt=jnp.zeros_like(hosts.tr_cnt))
-            if tracker is not None:
+            if tracker is not None and tracker.due(min(ws,
+                                                       int(sh.stop_time))):
                 tracker.maybe_heartbeat(min(ws, int(sh.stop_time)),
-                                        np.asarray(hosts.stats)[:H])
+                                        dist.gather_stats(hosts.stats)[:H])
             if checkpoint_path and ckpt_at is not None and ws >= ckpt_at:
                 ckpt.save(checkpoint_path, hosts, ws, int(wend),
                           total_windows, fingerprint)
@@ -491,10 +512,10 @@ class Simulation:
                 break
         if pcap is not None:
             pcap.close()
-        stats = np.asarray(hosts.stats)[:H]
+        stats = dist.gather_stats(hosts.stats)[:H]
         wall = _time.perf_counter() - wall0
         self.final_hosts = hosts
-        peaks = np.asarray(hosts.cap_peaks)[:H].max(axis=0)
+        peaks = dist.gather_stats(hosts.cap_peaks)[:H].max(axis=0)
         capacity = {"rows": [
             ("event_queue", cfg.qcap, int(peaks[0])),
             ("socket_table", cfg.scap, int(peaks[1])),

@@ -145,14 +145,28 @@ class CPluginApp(HostedApp):
         def tcp_listen(_, port):
             return _new_handle(self._os.tcp_listen(port))
 
+        def _live(h):
+            """Handle -> Sock, or None for retired/invalid handles
+            (double close or use-after-close from the plugin is treated
+            as a no-op, like writes on a closed fd returning EBADF)."""
+            if 0 <= h < len(self._socks):
+                return self._socks[h]
+            return None
+
         def send_to(_, h, dst, port, nbytes, aux):
-            self._os.sendto(self._socks[h], dst, port, nbytes, aux)
+            sock = _live(h)
+            if sock is not None:
+                self._os.sendto(sock, dst, port, nbytes, aux)
 
         def write_sk(_, h, nbytes):
-            self._os.write(self._socks[h], nbytes)
+            sock = _live(h)
+            if sock is not None:
+                self._os.write(sock, nbytes)
 
         def close_sk(_, h):
-            sock = self._socks[h]
+            sock = _live(h)
+            if sock is None:
+                return
             self._os.close(sock)
             # retire the handle: bounded by open sockets, not by
             # connections ever opened

@@ -39,6 +39,12 @@ class Tracker:
         if self.logger is not None:
             self.logger.message(self.next_ns, "tracker", line)
 
+    def due(self, sim_ns: int) -> bool:
+        """Will maybe_heartbeat emit anything at this time? Lets the
+        caller skip fetching stats (a cross-process all-gather on a
+        multi-process mesh) when no interval boundary has passed."""
+        return self.interval > 0 and sim_ns >= self.next_ns
+
     def maybe_heartbeat(self, sim_ns: int, stats: np.ndarray):
         """Called after each window chunk with current cumulative stats;
         emits one heartbeat per elapsed interval boundary."""

@@ -190,9 +190,27 @@ def run_windows_sharded(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
 
 def device_put_sharded(hosts, hp, sh, mesh: Mesh):
     """Place the simulation state for a sharded run: Hosts/HostParams
-    block-sharded over the hosts axis, Shared replicated."""
+    block-sharded over the hosts axis, Shared replicated.
+
+    On a multi-process mesh (the DCN tier, parallel.dist) every
+    process holds the same full host-side arrays — deterministic
+    scenario build — and contributes its addressable shards via
+    make_array_from_callback; single-process keeps the plain
+    device_put fast path."""
     shard = NamedSharding(mesh, PS(AXIS))
     repl = NamedSharding(mesh, PS())
+    if jax.process_count() > 1:
+        import numpy as _np
+
+        def put(x, s):
+            arr = _np.asarray(x)
+            return jax.make_array_from_callback(
+                arr.shape, s, lambda idx: arr[idx])
+
+        hosts = jax.tree.map(lambda x: put(x, shard), hosts)
+        hp = jax.tree.map(lambda x: put(x, shard), hp)
+        sh = jax.tree.map(lambda x: put(x, repl), sh)
+        return hosts, hp, sh
     hosts = jax.tree.map(lambda x: jax.device_put(x, shard), hosts)
     hp = jax.tree.map(lambda x: jax.device_put(x, shard), hp)
     sh = jax.tree.map(lambda x: jax.device_put(x, repl), sh)

@@ -1,0 +1,47 @@
+"""Worker process for the multi-process (DCN-tier) test.
+
+Launched N times by tests/test_distributed.py over loopback TCP:
+    python dist_worker.py <coordinator> <num_procs> <proc_id> <out.npy>
+Each process contributes 2 virtual CPU devices; the global mesh spans
+all processes — the same shape a real multi-host TPU deployment has
+(ICI within a process's slice, DCN between processes).
+"""
+
+import os
+import sys
+
+
+def main():
+    coord, nproc, pid, out = sys.argv[1:5]
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(here, "..", ".."))
+    sys.path.insert(0, here)
+    from shadow_tpu.parallel import dist
+
+    dist.init(coord, int(nproc), int(pid), local_device_count=2)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario
+    from shadow_tpu.engine.sim import Simulation
+    from shadow_tpu.engine.state import EngineConfig
+
+    from scenario_phold import make_scenario, make_cfg  # noqa: F401
+
+    scen = make_scenario()
+    cfg = make_cfg()
+    mesh = dist.global_mesh()
+    assert len(mesh.devices.flat) == 2 * int(nproc)
+    r = Simulation(scen, engine_cfg=cfg).run(mesh=mesh)
+    if int(pid) == 0:
+        np.save(out, r.stats)
+    print(f"proc {pid}: {r.events} events", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Shared tiny PHOLD scenario for the distributed test: built
+identically by the worker processes and the comparing test process."""
+
+TOPO = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="d7"/>
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d9"/>
+  <key attr.name="packetloss" attr.type="double" for="node" id="d0"/>
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d4"/>
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d3"/>
+  <graph edgedefault="undirected">
+    <node id="poi"><data key="d0">0.0</data>
+      <data key="d3">10240</data><data key="d4">10240</data></node>
+    <edge source="poi" target="poi"><data key="d7">20.0</data>
+      <data key="d9">0.0</data></edge>
+  </graph>
+</graphml>"""
+
+N_HOSTS = 4
+
+
+def make_scenario():
+    from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario
+
+    return Scenario(
+        stop_time=3 * 10**9,
+        topology_graphml=TOPO,
+        hosts=[HostSpec(id="node", quantity=N_HOSTS, processes=[
+            ProcessSpec(plugin="phold", start_time=10**9,
+                        arguments="port=9000 mean=200ms size=64 init=1")])],
+    )
+
+
+def make_cfg():
+    from shadow_tpu.engine.state import EngineConfig
+
+    return EngineConfig(num_hosts=N_HOSTS, qcap=16, scap=4, obcap=8,
+                        incap=16, chunk_windows=8, app_kinds=(0, 3),
+                        uses_tcp=False)

@@ -1,0 +1,76 @@
+"""Multi-process (DCN-tier) backend test.
+
+Realizes the reference's anticipated multi-slave deployment
+(shd-master.c:415-416 "once we get multiple slaves", shd-message.h):
+two OS processes, each contributing 2 virtual CPU devices, join one
+JAX distributed runtime over loopback TCP and run the SAME shard_map
+window program on a 4-device global mesh. The result must be
+bit-identical to the single-process run — the same contract the
+single-process sharded path already guarantees vs single-chip.
+
+Slow (~1 min): spawns two fresh JAX processes that each compile the
+window program; it is the only coverage of the DCN tier, so it stays
+in the default suite.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+HELPERS = Path(__file__).resolve().parent / "helpers"
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_mesh_matches_single(tmp_path):
+    sys.path.insert(0, str(HELPERS))
+    try:
+        from scenario_phold import make_scenario, make_cfg
+    finally:
+        sys.path.pop(0)
+    from shadow_tpu.engine.sim import Simulation
+
+    # ground truth: single-process run (virtual 8-device CPU already
+    # configured by conftest; mesh=None = single chip)
+    truth = Simulation(make_scenario(), engine_cfg=make_cfg()).run()
+    assert truth.events > 0
+
+    coord = f"127.0.0.1:{_free_port()}"
+    out = tmp_path / "stats.npy"
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(HELPERS / "dist_worker.py"),
+             coord, "2", str(pid), str(out)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for pid in range(2)
+    ]
+    outputs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=540)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outputs.append(stdout.decode(errors="replace"))
+    for pid, (p, text) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{text[-3000:]}"
+
+    stats = np.load(out)
+    assert np.array_equal(stats, truth.stats), (
+        "multi-process stats diverge from single-process run")

@@ -34,6 +34,7 @@ import argparse
 import csv
 import sys
 from pathlib import Path
+from xml.sax.saxutils import escape, quoteattr
 
 import numpy as np
 
@@ -61,7 +62,7 @@ def write_graphml(g: Graph, out, complete_attrs=False):
     w('  <key attr.name="asn" attr.type="int" for="node" id="n6" />\n')
     w('  <graph edgedefault="undirected">\n')
     for i, vid in enumerate(g.vertex_ids):
-        w(f'    <node id="{vid}">\n')
+        w(f'    <node id={quoteattr(str(vid))}>\n')
         if g.v_packetloss is not None and g.v_packetloss[i]:
             w(f'      <data key="n0">{g.v_packetloss[i]:g}</data>\n')
         if g.v_bw_up is not None and g.v_bw_up[i]:
@@ -69,19 +70,19 @@ def write_graphml(g: Graph, out, complete_attrs=False):
         if g.v_bw_down is not None and g.v_bw_down[i]:
             w(f'      <data key="n2">{int(g.v_bw_down[i])}</data>\n')
         if g.v_type and g.v_type[i]:
-            w(f'      <data key="n3">{g.v_type[i]}</data>\n')
+            w(f'      <data key="n3">{escape(str(g.v_type[i]))}</data>\n')
         if g.v_geocode and g.v_geocode[i]:
-            w(f'      <data key="n4">{g.v_geocode[i]}</data>\n')
+            w(f'      <data key="n4">{escape(str(g.v_geocode[i]))}</data>\n')
         if g.v_ip and g.v_ip[i]:
-            w(f'      <data key="n5">{g.v_ip[i]}</data>\n')
+            w(f'      <data key="n5">{escape(str(g.v_ip[i]))}</data>\n')
         if g.v_asn is not None and g.v_asn[i]:
             w(f'      <data key="n6">{int(g.v_asn[i])}</data>\n')
         w('    </node>\n')
     E = g.num_edges
     for k in range(E):
-        s = g.vertex_ids[g.e_src[k]]
-        t = g.vertex_ids[g.e_dst[k]]
-        w(f'    <edge source="{s}" target="{t}">\n')
+        s = quoteattr(str(g.vertex_ids[g.e_src[k]]))
+        t = quoteattr(str(g.vertex_ids[g.e_dst[k]]))
+        w(f'    <edge source={s} target={t}>\n')
         w(f'      <data key="e1">{g.e_latency_ms[k]:g}</data>\n')
         if g.e_packetloss is not None and g.e_packetloss[k]:
             w(f'      <data key="e0">{g.e_packetloss[k]:g}</data>\n')
