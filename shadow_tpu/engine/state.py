@@ -29,6 +29,7 @@ import numpy as np
 from ..core.simtime import SIMTIME_MAX
 from ..core import constants as C
 from ..net.packet import PKT_WORDS
+from ..net.sack import K as SACK_K
 from .defs import N_STATS
 
 
@@ -104,15 +105,16 @@ class Hosts:
     sk_snd_max: jnp.ndarray  # i64 highest offset ever transmitted
     sk_snd_end: jnp.ndarray  # i64 total bytes app has written
     sk_rcv_nxt: jnp.ndarray  # i64 next in-order offset expected
-    # single-hole SACK emulation (the reference's scoreboard,
-    # shd-tcp-scoreboard.c, collapsed to one out-of-order range — the
-    # dominant single-loss case; multi-hole degrades to go-back-N)
-    sk_ooo_start: jnp.ndarray  # i64 receiver out-of-order range start (-1)
-    sk_ooo_end: jnp.ndarray    # i64 .. end (exclusive)
-    sk_hole_end: jnp.ndarray   # i64 sender: retransmit only [una, hole_end)
-    sk_rex_nxt: jnp.ndarray   # i64 sender: resume after skip from here
-    #   (end of the peer's sacked range; later data may have been lost
-    #   too, so transmission resumes there, not at snd_max)
+    # SACK scoreboard (the reference's shd-tcp-scoreboard.c as fixed
+    # range sets, net.sack): K disjoint [start, end) ranges per socket
+    sk_ooo_s: jnp.ndarray    # [H, S, K] i64 receiver out-of-order runs
+    sk_ooo_e: jnp.ndarray    # [H, S, K] i64 (-1 = empty slot)
+    sk_sack_s: jnp.ndarray   # [H, S, K] i64 sender: peer-sacked runs
+    sk_sack_e: jnp.ndarray   # [H, S, K] i64 (accumulated from acks)
+    sk_hole_end: jnp.ndarray  # i64 sender: recovery point — fast
+    #   retransmission covers un-sacked bytes in [rex_nxt, hole_end)
+    sk_rex_nxt: jnp.ndarray   # i64 sender: recovery cursor (skips
+    #   sacked runs via the scoreboard)
     sk_peer_fin: jnp.ndarray  # i64 peer's FIN stream offset (-1 = none seen)
     sk_fin_acked: jnp.ndarray  # bool our FIN was acked
     sk_close_after: jnp.ndarray  # bool app closed: FIN after snd_end drains
@@ -259,8 +261,10 @@ def alloc_hosts(cfg: EngineConfig) -> Hosts:
         sk_snd_max=full((H, S), 0, jnp.int64),
         sk_snd_end=full((H, S), 0, jnp.int64),
         sk_rcv_nxt=full((H, S), 0, jnp.int64),
-        sk_ooo_start=full((H, S), -1, jnp.int64),
-        sk_ooo_end=full((H, S), -1, jnp.int64),
+        sk_ooo_s=full((H, S, SACK_K), -1, jnp.int64),
+        sk_ooo_e=full((H, S, SACK_K), -1, jnp.int64),
+        sk_sack_s=full((H, S, SACK_K), -1, jnp.int64),
+        sk_sack_e=full((H, S, SACK_K), -1, jnp.int64),
         sk_hole_end=full((H, S), 0, jnp.int64),
         sk_rex_nxt=full((H, S), 0, jnp.int64),
         sk_peer_fin=full((H, S), -1, jnp.int64),

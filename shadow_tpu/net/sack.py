@@ -1,0 +1,178 @@
+"""SACK scoreboard: fixed-capacity disjoint byte-range sets.
+
+The vectorized redesign of the reference's scoreboard
+(/root/reference/src/main/host/descriptor/shd-tcp-scoreboard.c, 351
+LoC of linked-list block bookkeeping): both sides of SACK state are a
+sorted set of at most K disjoint, non-adjacent [start, end) stream
+ranges stored as two [K] int64 vectors (-1 start = empty slot, empties
+sorted last):
+
+- receiver: the out-of-order byte runs held above rcv_nxt;
+- sender: the peer-reported sacked runs above snd_una (accumulated
+  across acks, exactly like the reference scoreboard accumulates SACK
+  blocks per packet).
+
+Every operation is a branch-free pass over the K lanes (K is small and
+static), so the whole scoreboard fuses into the surrounding TCP kernel
+— no lists, no loops over blocks.
+
+Wire encoding (the two most-urgent blocks ride each ACK, AUX word +
+APP word — real TCP carries 2-4 blocks per segment): 15-bit MSS-unit
+(offset, length) pairs, SHRUNK to segment alignment — the advertised
+range is always a subset of what the receiver truly holds, so the
+sender can never skip bytes the peer does not have (an over-claim
+would stall recovery until the RTO). Misaligned edges simply lose up
+to MSS-1 bytes of advertisement.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.constants import TCP_MSS
+
+# scoreboard capacity: concurrent tracked holes beyond this degrade to
+# go-back-N via the RTO, never to wrong data
+K = 4
+
+_I64 = jnp.int64
+# plain Python int: a module-level jnp constant would initialize the
+# XLA backend at import time (breaking jax.distributed.initialize and
+# this build's AOT dispatch — see .claude/skills/verify notes)
+_INF = 2**62
+
+
+def empty():
+    """-> (starts, ends) with no ranges."""
+    return jnp.full((K,), -1, _I64), jnp.full((K,), -1, _I64)
+
+
+def _sorted_pack(s, e):
+    """Sort ranges ascending by start with empty slots (-1) last."""
+    key = jnp.where(s < 0, _INF, s)
+    order = jnp.argsort(key)
+    return s[order], e[order]
+
+
+def insert(s, e, ns, ne):
+    """Add range [ns, ne) to the set, merging any overlapping or
+    touching ranges. On overflow (more than K disjoint ranges) the
+    HIGHEST range is discarded — the least urgent for recovery; its
+    bytes are simply no longer advertised/recorded and will be
+    retransmitted if lost. Returns (s, e)."""
+    valid = s >= 0
+    new_ok = ne > ns
+    ov = valid & new_ok & (ns <= e) & (ne >= s)
+    ms = jnp.minimum(ns, jnp.min(jnp.where(ov, s, _INF)))
+    me = jnp.maximum(ne, jnp.max(jnp.where(ov, e, -1)))
+    keep = valid & ~ov
+    # K+1 candidates: survivors + the merged range; keep the K lowest
+    cs = jnp.concatenate([jnp.where(keep, s, -1),
+                          jnp.where(new_ok, ms, -1)[None]])
+    ce = jnp.concatenate([jnp.where(keep, e, -1),
+                          jnp.where(new_ok, me, -1)[None]])
+    key = jnp.where(cs < 0, _INF, cs)
+    order = jnp.argsort(key)
+    return cs[order][:K], ce[order][:K]
+
+
+def consume(s, e, rcv):
+    """Advance the in-order cursor `rcv` through any ranges it reaches,
+    absorbing them. Returns (s, e, rcv'). (A single arrival can bridge
+    several ranges, hence the K passes.)"""
+    for _ in range(K):
+        hit = (s >= 0) & (s <= rcv)
+        rcv = jnp.maximum(rcv, jnp.max(jnp.where(hit, e, -1)))
+        s = jnp.where(hit, -1, s)
+        e = jnp.where(hit, -1, e)
+    return (*_sorted_pack(s, e), rcv)
+
+
+def drop_below(s, e, lo):
+    """Remove ranges fully below `lo` and clip partial overlap (the
+    cumulative ack advanced past them)."""
+    valid = s >= 0
+    gone = valid & (e <= lo)
+    s = jnp.where(gone, -1, jnp.where(valid, jnp.maximum(s, lo), s))
+    e = jnp.where(gone, -1, e)
+    return _sorted_pack(s, e)
+
+
+def skip(x, s, e):
+    """First offset >= x not inside any range (the retransmit cursor
+    jumping over sacked runs). Single pass suffices: ranges are
+    disjoint and non-adjacent, so landing exactly on the next range is
+    impossible. Batched: x [...] with s/e [..., K]."""
+    xk = jnp.asarray(x)[..., None]
+    inside = (s >= 0) & (xk >= s) & (xk < e)
+    return jnp.maximum(x, jnp.max(jnp.where(inside, e, -1), axis=-1))
+
+
+def next_start_after(x, s, e):
+    """Smallest range start > x (bounds a retransmission so it does not
+    overrun into already-sacked bytes); _INF if none. Batched like
+    :func:`skip`."""
+    xk = jnp.asarray(x)[..., None]
+    cand = jnp.where((s >= 0) & (s > xk), s, _INF)
+    return jnp.min(cand, axis=-1)
+
+
+def any_range(s):
+    return jnp.any(s >= 0)
+
+
+def max_end(s, e):
+    """Highest sacked offset (-1 when the set is empty), over the last
+    axis. Bytes BELOW this with no sacked cover are inferably lost
+    (the scoreboard's loss rule: something sent later already
+    arrived); bytes above it are merely in flight and must not be
+    retransmitted."""
+    return jnp.max(jnp.where(s >= 0, e, -1), axis=-1)
+
+
+def lost_bound(s, e, una, hole_end):
+    """Upper bound of inferably-lost bytes for fast recovery: the
+    highest sacked run (loss rule above), or one segment past the
+    cumulative ack when no sack information exists (classic fast
+    retransmit), clipped to the recovery point. ONE implementation for
+    both the per-socket eligibility scan (tcp_want_tx) and the pull
+    path (tcp_pull), so they cannot disagree."""
+    me = max_end(s, e)
+    return jnp.minimum(hole_end, jnp.where(me > 0, me, una + TCP_MSS))
+
+
+# --- wire encoding ----------------------------------------------------------
+# 15-bit (offset, length) in MSS units, relative to the carried ack.
+# Alignment-safe: offset rounds UP, length rounds DOWN, so the
+# advertised range is contained in the true one.
+
+def _encode_one(s_i, e_i, ack):
+    has = s_i >= 0
+    rel_raw = (s_i - ack + TCP_MSS - 1) // TCP_MSS
+    rel = jnp.clip(rel_raw, 0, 0x7FFF)
+    a_s = ack + rel * TCP_MSS
+    ln = jnp.clip((e_i - a_s) // TCP_MSS, 0, 0x7FFF)
+    # a range starting beyond the 15-bit offset field cannot be
+    # represented; emit no block rather than a clipped start that
+    # would claim bytes below the true range (subset invariant)
+    ok = has & (ln > 0) & (rel_raw <= 0x7FFF)
+    word = (rel.astype(jnp.int32) << 1) | (ln.astype(jnp.int32) << 16)
+    return jnp.where(ok, word, 0)
+
+
+def encode2(s, e, ack):
+    """The two lowest (most recovery-urgent) ranges as packed words for
+    the AUX and APP header fields; 0 = no block. Bit 0 of the first
+    word is left clear for the FINACK flag."""
+    return _encode_one(s[0], e[0], ack), _encode_one(s[1], e[1], ack)
+
+
+def decode(word, ack, hi):
+    """Packed word -> (start, end) clipped to [ack, hi); (-1, -1) when
+    absent."""
+    rel = ((word >> 1) & 0x7FFF).astype(_I64)
+    ln = ((word >> 16) & 0x7FFF).astype(_I64)
+    s = ack + rel * TCP_MSS
+    e = jnp.minimum(s + ln * TCP_MSS, hi)
+    ok = (ln > 0) & (e > s)
+    return jnp.where(ok, s, -1), jnp.where(ok, e, -1)

@@ -72,8 +72,10 @@ def sock_alloc(row, proto):
         sk_snd_max=setf(row.sk_snd_max, 0, jnp.int64),
         sk_snd_end=setf(row.sk_snd_end, 0, jnp.int64),
         sk_rcv_nxt=setf(row.sk_rcv_nxt, 0, jnp.int64),
-        sk_ooo_start=setf(row.sk_ooo_start, -1, jnp.int64),
-        sk_ooo_end=setf(row.sk_ooo_end, -1, jnp.int64),
+        sk_ooo_s=setf(row.sk_ooo_s, -1, jnp.int64),
+        sk_ooo_e=setf(row.sk_ooo_e, -1, jnp.int64),
+        sk_sack_s=setf(row.sk_sack_s, -1, jnp.int64),
+        sk_sack_e=setf(row.sk_sack_e, -1, jnp.int64),
         sk_hole_end=setf(row.sk_hole_end, 0, jnp.int64),
         sk_rex_nxt=setf(row.sk_rex_nxt, 0, jnp.int64),
         sk_peer_fin=setf(row.sk_peer_fin, -1, jnp.int64),

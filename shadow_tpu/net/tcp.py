@@ -47,6 +47,7 @@ from ..engine.defs import (EV_APP, EV_TCP_TIMER, EV_TCP_CLOSE,
 from . import congestion as CC
 from . import nic
 from . import packet as P
+from . import sack
 from .socket import (TCPS_CLOSED, TCPS_LISTEN, TCPS_SYN_SENT,
                      TCPS_SYN_RECEIVED, TCPS_ESTABLISHED, TCPS_FIN_WAIT_1,
                      TCPS_FIN_WAIT_2, TCPS_CLOSE_WAIT, TCPS_CLOSING,
@@ -207,8 +208,12 @@ def tcp_want_tx(row):
     data_tx = _data_tx_states(row.sk_state)
     cw = row.sk_cwnd.astype(_I64) * TCP_MSS
     win = jnp.minimum(cw, jnp.maximum(row.sk_peer_rwnd, 1))
-    rex_ok = data_tx & (row.sk_hole_end > 0) & (row.sk_rex_nxt <
-                                                row.sk_hole_end)
+    # recovery cursor skipped over peer-sacked runs, bounded by the
+    # loss rule — same sack primitives as tcp_pull ([S, K] batched)
+    rex_tgt = sack.skip(row.sk_rex_nxt, row.sk_sack_s, row.sk_sack_e)
+    lost_end = sack.lost_bound(row.sk_sack_s, row.sk_sack_e,
+                               row.sk_snd_una, row.sk_hole_end)
+    rex_ok = data_tx & (row.sk_hole_end > 0) & (rex_tgt < lost_end)
     data_ok = (data_tx & (row.sk_snd_nxt < row.sk_snd_end) &
                (row.sk_snd_nxt < row.sk_snd_una + win))
     fin_due = (open_tx & row.sk_close_after &
@@ -217,19 +222,15 @@ def tcp_want_tx(row):
 
 
 def _finack_aux(row, slot):
+    """-> (aux_word, app_word): FINACK flag + the two most urgent SACK
+    blocks from the receive scoreboard (net.sack wire encoding)."""
     pf = rget(row.sk_peer_fin, slot)
     got_fin = (pf >= 0) & (rget(row.sk_rcv_nxt, slot) >= pf)
     aux = jnp.where(got_fin, AUX_FINACK, 0).astype(_I32)
-    # SACK block (single-hole scoreboard): bits 1-15 = hole size in MSS
-    # units (gap between rcv_nxt and the out-of-order range), bits
-    # 16-30 = sacked length in MSS units. Zero length = no block.
-    ooo_s = rget(row.sk_ooo_start, slot)
-    ooo_e = rget(row.sk_ooo_end, slot)
-    has = ooo_s >= 0
-    rel = jnp.clip((ooo_s - rget(row.sk_rcv_nxt, slot)) // TCP_MSS, 0, 0x7FFF)
-    lnm = jnp.clip((ooo_e - ooo_s + TCP_MSS - 1) // TCP_MSS, 1, 0x7FFF)
-    sack = ((rel.astype(_I32) << 1) | (lnm.astype(_I32) << 16))
-    return aux | jnp.where(has, sack, 0)
+    b1, b2 = sack.encode2(rget(row.sk_ooo_s, slot),
+                          rget(row.sk_ooo_e, slot),
+                          rget(row.sk_rcv_nxt, slot))
+    return aux | b1, b2
 
 
 def tcp_pull(row, hp, sh, now, slot):
@@ -245,11 +246,16 @@ def tcp_pull(row, hp, sh, now, slot):
     limit = rget(row.sk_snd_una, slot) + _win_bytes(row, slot)
     # fast retransmission runs on its own cursor (the reference's
     # scoreboard next-retransmit selection, shd-tcp-scoreboard.c:271):
-    # snd_nxt is NOT rewound, so recovery resends only the hole
+    # snd_nxt is NOT rewound; recovery resends only un-sacked holes,
+    # jumping the cursor over peer-sacked runs
     data_tx = _data_tx_states(state)
     hole_end = rget(row.sk_hole_end, slot)
-    rex_nxt = rget(row.sk_rex_nxt, slot)
-    rex_pending = data_tx & (hole_end > 0) & (rex_nxt < hole_end)
+    sck_s = rget(row.sk_sack_s, slot)
+    sck_e = rget(row.sk_sack_e, slot)
+    rex_nxt = sack.skip(rget(row.sk_rex_nxt, slot), sck_s, sck_e)
+    lost_end = sack.lost_bound(sck_s, sck_e, rget(row.sk_snd_una, slot),
+                               hole_end)
+    rex_pending = data_tx & (hole_end > 0) & (rex_nxt < lost_end)
     can_new = data_tx & (snd_nxt < snd_end) & (snd_nxt < limit)
     can_data = rex_pending | can_new
 
@@ -274,12 +280,16 @@ def tcp_pull(row, hp, sh, now, slot):
     base_flags = _I32(P.PROTO_TCP)
     ack_no = rget(row.sk_rcv_nxt, slot).astype(_I32)
     wnd = jnp.minimum(rget(row.sk_rcvbuf, slot), _I64(2**31 - 1)).astype(_I32)
-    aux = _finack_aux(row, slot)
+    aux, sack2 = _finack_aux(row, slot)
 
+    # a recovery send stops at the next sacked run (no overlap with
+    # bytes the peer already holds) and at the loss boundary
+    rex_cap = jnp.minimum(lost_end,
+                          sack.next_start_after(rex_nxt, sck_s, sck_e))
     ln = jnp.where(sel == 3,
                    jnp.where(rex_pending,
                              jnp.minimum(_I64(TCP_MSS),
-                                         hole_end - rex_nxt),
+                                         rex_cap - rex_nxt),
                              jnp.minimum(_I64(TCP_MSS),
                                          jnp.minimum(snd_end, limit) -
                                          snd_nxt)),
@@ -296,7 +306,8 @@ def tcp_pull(row, hp, sh, now, slot):
                  sport=rget(row.sk_lport, slot), dport=rget(row.sk_rport, slot),
                  flags=flags, seq=seq, ack=ack_no, wnd=wnd, length=ln,
                  aux=aux,
-                 app=jnp.where(sel == 1, rget(row.sk_syn_tag, slot), 0))
+                 app=jnp.where(sel == 1, rget(row.sk_syn_tag, slot),
+                               sack2))
 
     # --- state updates per selection ---
     # clear the control bit we served; any ACK-bearing send satisfies ACKNOW
@@ -403,9 +414,6 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
     ackno = pkt[P.ACK].astype(_I64)
     ln = pkt[P.LEN].astype(_I64)
     finack = (pkt[P.AUX] & AUX_FINACK) != 0
-    # SACK block from the peer (see _finack_aux encoding)
-    sack_rel = ((pkt[P.AUX] >> 1) & 0x7FFF).astype(_I64)
-    sack_len = ((pkt[P.AUX] >> 16) & 0x7FFF).astype(_I64)
 
     state0 = rget(row.sk_state, slot)
 
@@ -490,6 +498,22 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
     npkts = (acked_bytes + TCP_MSS - 1) // TCP_MSS
     snd_una1 = jnp.where(new_ack, ackno, snd_una0)
 
+    # accumulate the peer's SACK blocks into the sender scoreboard
+    # (the reference's scoreboard_update, shd-tcp-scoreboard.c:187);
+    # prune everything the cumulative ack now covers
+    snd_max0 = rget(row.sk_snd_max, slot)
+    upd = valid_ack & ~syn
+    b1s, b1e = sack.decode(pkt[P.AUX], ackno, snd_max0)
+    b2s, b2e = sack.decode(pkt[P.APP], ackno, snd_max0)
+    sb_s0 = rget(row.sk_sack_s, slot)
+    sb_e0 = rget(row.sk_sack_e, slot)
+    sb_s1, sb_e1 = sack.insert(sb_s0, sb_e0, jnp.where(upd, b1s, -1),
+                               jnp.where(upd, b1e, -2))
+    sb_s1, sb_e1 = sack.insert(sb_s1, sb_e1, jnp.where(upd, b2s, -1),
+                               jnp.where(upd, b2e, -2))
+    sb_s1, sb_e1 = sack.drop_below(sb_s1, sb_e1, snd_una1)
+    row = _set(row, slot, sk_sack_s=sb_s1, sk_sack_e=sb_e1)
+
     # RTT sample (Karn: only the timed offset, cleared on retransmit)
     rtt_seq = rget(row.sk_rtt_seq, slot)
     sample_ok = new_ack & (rtt_seq >= 0) & (ackno >= rtt_seq)
@@ -527,18 +551,15 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
         sk_cc_epoch=jnp.where(fast_rx, ep_l,
                               jnp.where(new_ack, ep_a, ep0)),
         sk_cc_k=jnp.where(new_ack & ~fast_rx, k_a, k0),
-        # Recovery: retransmit exactly the hole the peer's SACK block
-        # reports, on a separate cursor — snd_nxt is NOT rewound (the
+        # Recovery: retransmit every un-sacked hole below the recovery
+        # point on a separate cursor — snd_nxt is NOT rewound (the
         # reference's scoreboard-driven recovery, shd-tcp.c:1044-1066 +
-        # shd-tcp-scoreboard.c). The episode ends when the cumulative
-        # ack covers the hole; a partial ack advances the cursor.
+        # shd-tcp-scoreboard.c). The recovery point is everything
+        # outstanding at loss detection; the cursor jumps sacked runs
+        # (tcp_pull); the episode ends when the cumulative ack covers
+        # the recovery point; a partial ack advances the cursor.
         sk_hole_end=jnp.where(
-            fast_rx,
-            jnp.where(sack_len > 0,
-                      jnp.minimum(ackno + sack_rel * TCP_MSS,
-                                  rget(row.sk_snd_max, slot)),
-                      jnp.minimum(ackno + TCP_MSS,
-                                  rget(row.sk_snd_max, slot))),
+            fast_rx, snd_max0,
             jnp.where(new_ack & (ackno >= rget(row.sk_hole_end, slot)),
                       _I64(0), rget(row.sk_hole_end, slot))),
         sk_rex_nxt=jnp.where(fast_rx, ackno,
@@ -573,45 +594,32 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
                        lambda r: r, row)
 
     # --- C. data ---
-    # Out-of-order segments are held as ONE [ooo_start, ooo_end) range
-    # (single-hole scoreboard; a second simultaneous hole falls back to
-    # retransmission). In-order arrival that reaches the range's start
-    # delivers the whole buffered run at once.
+    # Out-of-order segments are held in the K-range receive scoreboard
+    # (net.sack). An in-order arrival that reaches a held run delivers
+    # the whole buffered chain at once; more than K disjoint runs
+    # discards the highest (its bytes are retransmitted eventually).
     can_rx = ((state2 == TCPS_ESTABLISHED) | (state2 == TCPS_FIN_WAIT_1) |
               (state2 == TCPS_FIN_WAIT_2))
     has_data = (ln > 0) & can_rx
     rcv0 = rget(row.sk_rcv_nxt, slot)
-    ooo_s0 = rget(row.sk_ooo_start, slot)
-    ooo_e0 = rget(row.sk_ooo_end, slot)
+    oos0 = rget(row.sk_ooo_s, slot)
+    ooe0 = rget(row.sk_ooo_e, slot)
     seg_end = seq + ln
 
     in_order = has_data & (seq <= rcv0) & (seg_end > rcv0)
     adv = jnp.where(in_order, seg_end, rcv0)
-    fill = in_order & (ooo_s0 >= 0) & (adv >= ooo_s0)
-    rcv1 = jnp.where(fill, jnp.maximum(adv, ooo_e0), adv)
-    ooo_s1 = jnp.where(fill, _I64(-1), ooo_s0)
-    ooo_e1 = jnp.where(fill, _I64(-1), ooo_e0)
+    oos1, ooe1, rcv1 = sack.consume(oos0, ooe0, adv)
 
     is_ooo = has_data & (seq > rcv1)
-    joins = (ooo_s1 >= 0) & (seq <= ooo_e1) & (seg_end >= ooo_s1)
-    ooo_s2 = jnp.where(is_ooo,
-                       jnp.where(ooo_s1 < 0, seq,
-                                 jnp.where(joins,
-                                           jnp.minimum(ooo_s1, seq),
-                                           ooo_s1)),
-                       ooo_s1)
-    ooo_e2 = jnp.where(is_ooo,
-                       jnp.where(ooo_e1 < 0, seg_end,
-                                 jnp.where(joins,
-                                           jnp.maximum(ooo_e1, seg_end),
-                                           ooo_e1)),
-                       ooo_e1)
+    oos2, ooe2 = sack.insert(oos1, ooe1,
+                             jnp.where(is_ooo, seq, -1),
+                             jnp.where(is_ooo, seg_end, -2))
 
     delivered = rcv1 - rcv0
     row = _set(row, slot,
                sk_rcv_nxt=rcv1,
-               sk_ooo_start=ooo_s2,
-               sk_ooo_end=ooo_e2,
+               sk_ooo_s=oos2,
+               sk_ooo_e=ooe2,
                sk_ctl=rget(row.sk_ctl, slot) |
                jnp.where((ln > 0) | fin, CTL_ACKNOW, 0))
     row = row.replace(stats=radd(row.stats, ST_BYTES_RECV, delivered))
@@ -736,6 +744,10 @@ def on_tcp_timer(row, hp, sh, now, wend, ev):
                 sk_cc_epoch=jnp.where(had_flight, ep_l,
                                       rget(rr.sk_cc_epoch, slot)),
                 sk_hole_end=_I64(0),  # RTO: full go-back-N, no skip
+                # clear the sender scoreboard: after a timeout the
+                # peer may have reneged; trust nothing (RFC 2018 §8)
+                sk_sack_s=jnp.full((sack.K,), -1, _I64),
+                sk_sack_e=jnp.full((sack.K,), -1, _I64),
                 sk_rtt_seq=_I64(-1),  # Karn
                 sk_timer_on=jnp.bool_(False),
             )

@@ -27,11 +27,8 @@ def main():
     jax.config.update("jax_platforms", "cpu")
     import numpy as np
 
-    from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario
     from shadow_tpu.engine.sim import Simulation
-    from shadow_tpu.engine.state import EngineConfig
-
-    from scenario_phold import make_scenario, make_cfg  # noqa: F401
+    from scenario_phold import make_scenario, make_cfg
 
     scen = make_scenario()
     cfg = make_cfg()

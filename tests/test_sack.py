@@ -1,0 +1,139 @@
+"""Unit tests for the SACK scoreboard range-set primitives (net.sack)
+— the vectorized redesign of the reference's shd-tcp-scoreboard.c."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shadow_tpu.net import sack
+from shadow_tpu.core.constants import TCP_MSS
+
+
+def ranges(s, e):
+    """Concrete [(start, end), ...] of non-empty slots."""
+    s, e = np.asarray(s), np.asarray(e)
+    return [(int(a), int(b)) for a, b in zip(s, e) if a >= 0]
+
+
+def build(*rs):
+    s, e = sack.empty()
+    for a, b in rs:
+        s, e = sack.insert(s, e, jnp.int64(a), jnp.int64(b))
+    return s, e
+
+
+def test_insert_disjoint_sorted():
+    s, e = build((300, 400), (100, 200))
+    assert ranges(s, e) == [(100, 200), (300, 400)]
+
+
+def test_insert_merges_overlap_and_touch():
+    s, e = build((100, 200), (200, 250))          # touching merges
+    assert ranges(s, e) == [(100, 250)]
+    s, e = build((100, 200), (300, 400), (150, 350))  # bridges both
+    assert ranges(s, e) == [(100, 400)]
+
+
+def test_insert_noop_on_empty_range():
+    s, e = build((100, 200))
+    s2, e2 = sack.insert(s, e, jnp.int64(-1), jnp.int64(-2))
+    assert ranges(s2, e2) == [(100, 200)]
+
+
+def test_insert_overflow_drops_highest():
+    s, e = build((100, 110), (200, 210), (300, 310), (400, 410),
+                 (500, 510))
+    assert len(ranges(s, e)) == sack.K
+    assert ranges(s, e)[0] == (100, 110)
+    assert (500, 510) not in ranges(s, e)
+
+
+def test_consume_chain():
+    s, e = build((200, 300), (400, 500))
+    s2, e2, rcv = sack.consume(s, e, jnp.int64(250))
+    # cursor lands inside the first range: absorbs it, stops before 400
+    assert int(rcv) == 300
+    assert ranges(s2, e2) == [(400, 500)]
+    # an arrival bridging into the second range absorbs it too
+    s3, e3, rcv2 = sack.consume(s2, e2, jnp.int64(420))
+    assert int(rcv2) == 500
+    assert ranges(s3, e3) == []
+
+
+def test_drop_below_prunes_and_clips():
+    s, e = build((100, 200), (300, 400))
+    s2, e2 = sack.drop_below(s, e, jnp.int64(350))
+    assert ranges(s2, e2) == [(350, 400)]
+
+
+def test_skip_and_next_start():
+    s, e = build((100, 200), (300, 400))
+    assert int(sack.skip(jnp.int64(150), s, e)) == 200
+    assert int(sack.skip(jnp.int64(250), s, e)) == 250
+    assert int(sack.next_start_after(jnp.int64(150), s, e)) == 300
+    assert int(sack.next_start_after(jnp.int64(350), s, e)) > 10**17
+
+
+def test_wire_roundtrip_aligned():
+    m = TCP_MSS
+    ack = jnp.int64(10 * m)
+    s, e = build((12 * m, 14 * m), (20 * m, 21 * m))
+    b1, b2 = sack.encode2(s, e, ack)
+    hi = jnp.int64(100 * m)
+    d1s, d1e = sack.decode(jnp.int32(b1), ack, hi)
+    d2s, d2e = sack.decode(jnp.int32(b2), ack, hi)
+    assert (int(d1s), int(d1e)) == (12 * m, 14 * m)
+    assert (int(d2s), int(d2e)) == (20 * m, 21 * m)
+
+
+def test_wire_never_overclaims_when_misaligned():
+    m = TCP_MSS
+    ack = jnp.int64(0)
+    true_s, true_e = 3 * m + 7, 6 * m + 11   # misaligned edges
+    s, e = build((true_s, true_e))
+    b1, _ = sack.encode2(s, e, ack)
+    ds, de = sack.decode(jnp.int32(b1), ack, jnp.int64(100 * m))
+    assert int(ds) >= true_s            # never claims earlier bytes
+    assert int(de) <= true_e            # never claims later bytes
+    assert int(de) > int(ds)            # still useful
+
+
+def test_wire_finack_bit_does_not_corrupt_block():
+    m = TCP_MSS
+    s, e = build((2 * m, 4 * m))
+    b1, _ = sack.encode2(s, e, jnp.int64(0))
+    word = jnp.int32(b1 | 1)            # FINACK flag shares the word
+    ds, de = sack.decode(word, jnp.int64(0), jnp.int64(100 * m))
+    assert (int(ds), int(de)) == (2 * m, 4 * m)
+
+
+def test_wire_no_block_beyond_offset_field():
+    """A range starting beyond the 15-bit MSS offset field must emit NO
+    block — a clipped start would advertise bytes the receiver lacks."""
+    m = TCP_MSS
+    far = (0x7FFF + 100) * m
+    s, e = build((far, far + 10 * m))
+    b1, b2 = sack.encode2(s, e, jnp.int64(0))
+    assert int(b1) == 0 and int(b2) == 0
+
+
+def test_lost_bound():
+    m = TCP_MSS
+    s, e = build((5 * m, 8 * m))
+    una = jnp.int64(2 * m)
+    hole = jnp.int64(50 * m)
+    assert int(sack.lost_bound(s, e, una, hole)) == 8 * m
+    s0, e0 = sack.empty()
+    assert int(sack.lost_bound(s0, e0, una, hole)) == 3 * m
+    assert int(sack.lost_bound(s, e, una, jnp.int64(6 * m))) == 6 * m
+
+
+def test_batched_skip_matches_rowwise():
+    m = TCP_MSS
+    s1, e1 = build((100, 200))
+    s2, e2 = build((300, 400), (500, 600))
+    S = jnp.stack([s1, s2])
+    E = jnp.stack([e1, e2])
+    x = jnp.asarray([150, 350], jnp.int64)
+    out = sack.skip(x, S, E)
+    assert out.tolist() == [200, 400]
