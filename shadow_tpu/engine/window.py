@@ -73,6 +73,8 @@ def _make_handlers(cfg: EngineConfig):
 
         def deliver(r):
             r = r.replace(stats=r.stats.at[ST_PKTS_RECV].add(1))
+            # delivery-status trail: admitted by the input buffer
+            pkt_in = pkt.at[P.STATUS].add(P.DS_RX_BUFFERED)
             proto = pkt[P.FLAGS] & P.PROTO_MASK
 
             def tcp_path(rr):
@@ -80,7 +82,7 @@ def _make_handlers(cfg: EngineConfig):
                                   pkt[P.DPORT], P.PROTO_TCP)
                 return jax.lax.cond(
                     slot >= 0,
-                    lambda r2: tcp_rx(r2, hp, sh, now, slot, pkt),
+                    lambda r2: tcp_rx(r2, hp, sh, now, slot, pkt_in),
                     lambda r2: r2, rr)
 
             def udp_path(rr):
@@ -88,7 +90,7 @@ def _make_handlers(cfg: EngineConfig):
                                   pkt[P.DPORT], P.PROTO_UDP)
                 return jax.lax.cond(
                     slot >= 0,
-                    lambda r2: udp_deliver(r2, hp, sh, now, slot, pkt),
+                    lambda r2: udp_deliver(r2, hp, sh, now, slot, pkt_in),
                     lambda r2: r2, rr)
 
             if not cfg.uses_tcp:

@@ -49,6 +49,7 @@ def txq_push(row, pkt):
     T = row.txq_pkt.shape[0]
     ok = row.txq_cnt < T
     slot = (row.txq_head + row.txq_cnt) % T
+    pkt = rset(pkt, P.STATUS, pkt[P.STATUS] | P.DS_TXQ)
     return row.replace(
         txq_pkt=rset_where(row.txq_pkt, slot, ok, pkt),
         txq_cnt=row.txq_cnt + jnp.where(ok, 1, 0),
@@ -61,17 +62,20 @@ def emit(row, hp, now, pkt):
     the window-boundary exchange. Stamps the per-source UID that keys
     the topology loss roll."""
     pkt = rset(pkt, P.UID, row.pkt_ctr)
+    pkt = rset(pkt, P.STATUS, pkt[P.STATUS] | P.DS_NIC_SENT)
     is_loop = pkt[P.DST] == hp.hid
 
     def local(r):
-        return equeue.q_push(r, now + LOOPBACK_DELAY, EV_PKT, pkt)
+        lp = rset(pkt, P.STATUS, pkt[P.STATUS] | P.DS_LOOPBACK)
+        return equeue.q_push(r, now + LOOPBACK_DELAY, EV_PKT, lp)
 
     def remote(r):
+        rp = rset(pkt, P.STATUS, pkt[P.STATUS] | P.DS_INET)
         cnt = r.ob_cnt
         ok = cnt < r.ob_time.shape[0]
         slot = jnp.minimum(cnt, r.ob_time.shape[0] - 1)
         return r.replace(
-            ob_pkt=rset_where(r.ob_pkt, slot, ok, pkt),
+            ob_pkt=rset_where(r.ob_pkt, slot, ok, rp),
             ob_time=rset_where(r.ob_time, slot, ok, now),
             ob_cnt=cnt + jnp.where(ok, 1, 0),
             stats=radd(r.stats, ST_OUTBOX_DROP, jnp.where(ok, 0, 1)),

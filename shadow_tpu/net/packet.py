@@ -34,10 +34,36 @@ space scale; connections are per-transfer in the bundled apps.
 
 import jax.numpy as jnp
 
-PKT_WORDS = 12
+PKT_WORDS = 13
 
 (SRC, DST, SPORT, DPORT, FLAGS, SEQ, ACK, WND, LEN, AUX, UID,
- APP) = range(12)
+ APP, STATUS) = range(13)
+
+# --- STATUS word: the delivery-status trail -------------------------------
+# The reference stamps 18 lifecycle flags on every packet as it moves
+# through the stack (shd-packet.h:15-36), logged per transition; here
+# the trail is a bitmask accumulated in the packet itself, visible in
+# trace-ring records (obs.pcap) and app wakes. Aggregate transition
+# counts live in the per-host stats.
+DS_CREATED = 1 << 0       # built by the transport (tcp_pull / sendto)
+DS_RETRANS = 1 << 1       # this transmission is a re-send
+DS_TXQ = 1 << 2           # queued on the NIC transmit ring
+DS_NIC_SENT = 1 << 3      # NIC handed it to the wire
+DS_LOOPBACK = 1 << 4      # took the local-delivery path
+DS_INET = 1 << 5          # entered the cross-host exchange
+DS_RX_BUFFERED = 1 << 6   # admitted by the receiver NIC input buffer
+
+_DS_NAMES = [
+    (DS_CREATED, "created"), (DS_RETRANS, "retransmit"),
+    (DS_TXQ, "tx-queued"), (DS_NIC_SENT, "nic-sent"),
+    (DS_LOOPBACK, "loopback"), (DS_INET, "inet"),
+    (DS_RX_BUFFERED, "rx-buffered"),
+]
+
+
+def status_names(bits: int) -> list:
+    """Decode a STATUS word into the trail's stage names."""
+    return [name for bit, name in _DS_NAMES if bits & bit]
 
 # FLAGS word
 PROTO_MASK = 0xFF
@@ -55,13 +81,14 @@ from ..core.constants import HEADER_SIZE_TCPIPETH, HEADER_SIZE_UDPIPETH  # noqa:
 
 
 def make(src, dst, sport, dport, flags, seq=0, ack=0, wnd=0, length=0,
-         aux=0, app=0):
+         aux=0, app=0, status=0):
     """Assemble a packet word vector (traced or concrete int32s).
     UID is stamped later, at NIC emit time."""
     return jnp.stack([
         jnp.int32(src), jnp.int32(dst), jnp.int32(sport), jnp.int32(dport),
         jnp.int32(flags), jnp.int32(seq), jnp.int32(ack), jnp.int32(wnd),
         jnp.int32(length), jnp.int32(aux), jnp.int32(0), jnp.int32(app),
+        jnp.int32(status),
     ])
 
 

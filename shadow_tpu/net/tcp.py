@@ -302,12 +302,16 @@ def tcp_pull(row, hp, sh, now, slot):
     flags = flags | jnp.where(sel == 4, P.F_FIN, 0)
     flags = flags | jnp.where((sel == 2) | (sel >= 3), P.F_ACK, 0)
 
+    is_resend = (sel == 3) & (rex_pending |
+                              (snd_nxt < rget(row.sk_snd_max, slot)))
     pkt = P.make(src=hp.hid, dst=rget(row.sk_rhost, slot),
                  sport=rget(row.sk_lport, slot), dport=rget(row.sk_rport, slot),
                  flags=flags, seq=seq, ack=ack_no, wnd=wnd, length=ln,
                  aux=aux,
                  app=jnp.where(sel == 1, rget(row.sk_syn_tag, slot),
-                               sack2))
+                               sack2),
+                 status=P.DS_CREATED |
+                 jnp.where(is_resend, P.DS_RETRANS, 0))
 
     # --- state updates per selection ---
     # clear the control bit we served; any ACK-bearing send satisfies ACKNOW
@@ -746,8 +750,8 @@ def on_tcp_timer(row, hp, sh, now, wend, ev):
                 sk_hole_end=_I64(0),  # RTO: full go-back-N, no skip
                 # clear the sender scoreboard: after a timeout the
                 # peer may have reneged; trust nothing (RFC 2018 §8)
-                sk_sack_s=jnp.full((sack.K,), -1, _I64),
-                sk_sack_e=jnp.full((sack.K,), -1, _I64),
+                sk_sack_s=sack.empty()[0],
+                sk_sack_e=sack.empty()[1],
                 sk_rtt_seq=_I64(-1),  # Karn
                 sk_timer_on=jnp.bool_(False),
             )

@@ -46,7 +46,8 @@ def udp_sendto(row, hp, now, slot, dst_host, dst_port, nbytes, aux=0):
     """
     length = jnp.minimum(jnp.int64(nbytes), UDP_MAX_PAYLOAD).astype(jnp.int32)
     pkt = P.make(src=hp.hid, dst=dst_host, sport=rget(row.sk_lport, slot),
-                 dport=dst_port, flags=P.PROTO_UDP, length=length, aux=aux)
+                 dport=dst_port, flags=P.PROTO_UDP, length=length, aux=aux,
+                 status=P.DS_CREATED)
     row = row.replace(sk_snd_end=radd(row.sk_snd_end, slot,
                                       jnp.int64(length)))
     row = nic.txq_push(row, pkt)

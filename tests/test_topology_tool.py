@@ -135,3 +135,30 @@ def test_info_runs(chain_file, capsys):
     out = capsys.readouterr().out
     assert "vertices: 5" in out
     assert "connected components: 1" in out
+
+
+def test_compute_paths_jitter_sums(tmp_path):
+    """Jitter accumulates along the shortest path, like the reference's
+    compute-topology-paths tool."""
+    src = tmp_path / "j.graphml.xml"
+    src.write_text("""<?xml version="1.0"?>
+<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="d7"/>
+  <key attr.name="jitter" attr.type="double" for="edge" id="d8"/>
+  <graph edgedefault="undirected">
+    <node id="a"/><node id="b"/><node id="c"/>
+    <edge source="a" target="b"><data key="d7">10</data>
+      <data key="d8">1.5</data></edge>
+    <edge source="b" target="c"><data key="d7">10</data>
+      <data key="d8">2.0</data></edge>
+  </graph>
+</graphml>""")
+    out = tmp_path / "jc.graphml.xml"
+    ttool.main(["compute-paths", str(src), "--out", str(out)])
+    g = parse_graphml(str(out))
+    jit = {}
+    for k in range(g.num_edges):
+        a, b = g.vertex_ids[g.e_src[k]], g.vertex_ids[g.e_dst[k]]
+        jit[frozenset((a, b))] = g.e_jitter_ms[k]
+    assert jit[frozenset(("a", "b"))] == pytest.approx(1.5)
+    assert jit[frozenset(("a", "c"))] == pytest.approx(3.5)

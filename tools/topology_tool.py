@@ -192,16 +192,65 @@ def cmd_prune(args):
           file=sys.stderr)
 
 
+def _path_jitter(g: Graph):
+    """[V, V] summed jitter along each latency-shortest path (the
+    reference's compute-topology-paths.py accumulates jitter the same
+    way it accumulates latency)."""
+    from scipy.sparse import csr_matrix
+    from scipy.sparse.csgraph import dijkstra
+
+    V = g.num_vertices
+    und = not g.directed
+    s = np.concatenate([g.e_src, g.e_dst]) if und else g.e_src
+    d = np.concatenate([g.e_dst, g.e_src]) if und else g.e_dst
+    w = np.concatenate([g.e_latency_ms] * 2) if und else g.e_latency_ms
+    jv = np.concatenate([g.e_jitter_ms] * 2) if und else g.e_jitter_ms
+    # parallel-edge dedup keeping the MIN-latency edge (and ITS jitter)
+    # — the same edge selection the latency/loss oracle uses, so the
+    # emitted jitter belongs to the path actually chosen (csr_matrix
+    # would otherwise SUM duplicates into a different graph)
+    best = {}
+    for k in range(len(s)):
+        if s[k] == d[k]:
+            continue
+        key = (int(s[k]), int(d[k]))
+        if key not in best or w[k] < w[best[key]]:
+            best[key] = k
+    ks = np.array(sorted(best.values()), dtype=np.int64)
+    adj = csr_matrix((w[ks], (s[ks], d[ks])), shape=(V, V))
+    dist, pred = dijkstra(adj, directed=True, return_predecessors=True)
+    ej = np.zeros((V, V))
+    ej[s[ks], d[ks]] = jv[ks]
+    out = np.zeros((V, V))
+    for a in range(V):
+        # fixpoint over the predecessor tree: robust to equal-distance
+        # ties (zero-latency edges), where distance order alone can
+        # visit a child before its predecessor
+        for _ in range(V):
+            changed = False
+            for b in range(V):
+                p = pred[a, b]
+                if b != a and p >= 0:
+                    v = out[a, p] + ej[p, b]
+                    if v != out[a, b]:
+                        out[a, b] = v
+                        changed = True
+            if not changed:
+                break
+    return out
+
+
 def cmd_compute_paths(args):
     g = parse_graphml(args.input)
     lat_ms, rel = _apsp(g)
+    jit = _path_jitter(g)
     V = g.num_vertices
     ng = Graph(vertex_ids=list(g.vertex_ids), directed=False)
     ng.v_ip, ng.v_geocode, ng.v_type = g.v_ip, g.v_geocode, g.v_type
     ng.v_asn, ng.v_bw_up, ng.v_bw_down = g.v_asn, g.v_bw_up, g.v_bw_down
     # vertex loss folds into the path loss on the complete graph
     ng.v_packetloss = np.zeros(V)
-    src, dst, lat, loss = [], [], [], []
+    src, dst, lat, loss, jits = [], [], [], [], []
     for i in range(V):
         for j in range(i, V):
             if not np.isfinite(lat_ms[i, j]):
@@ -210,10 +259,11 @@ def cmd_compute_paths(args):
             dst.append(j)
             lat.append(max(lat_ms[i, j], args.min_latency))
             loss.append(1.0 - float(rel[i, j]))
+            jits.append(jit[i, j])
     ng.e_src = np.array(src, dtype=np.int64)
     ng.e_dst = np.array(dst, dtype=np.int64)
     ng.e_latency_ms = np.array(lat)
-    ng.e_jitter_ms = np.zeros(len(lat))
+    ng.e_jitter_ms = np.array(jits)
     ng.e_packetloss = np.array(loss)
     with _open_out(args.out) as f:
         write_graphml(ng, f)
