@@ -47,7 +47,7 @@ def main():
     )
 
     cfg = EngineConfig(num_hosts=num_hosts, qcap=16, scap=4, obcap=8,
-                       incap=16, chunk_windows=32)
+                       incap=16, chunk_windows=512)
 
     # Warm-up run at identical array shapes but a tiny stop time:
     # stop_time is a dynamic scalar, so this compiles the full window

@@ -137,6 +137,10 @@ def main(argv=None):
     p.add_argument("--checkpoint-every", type=float, default=0,
                    metavar="SEC")
     p.add_argument("--resume", default=None, metavar="PATH")
+    p.add_argument("--engine-caps", default=None, metavar="K=V,...",
+                   help="override engine array capacities, e.g. "
+                        "qcap=16,scap=2,obcap=16,incap=32,chunk=256 "
+                        "(defaults are sized from the scenario)")
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--summary-json", action="store_true",
                    help="print the final summary as one JSON line")
@@ -180,7 +184,29 @@ def main(argv=None):
                    f"{scenario.total_hosts()} hosts, "
                    f"stop={scenario.stop_time / 1e9:.1f}s")
 
-    sim = Simulation(scenario)
+    engine_cfg = None
+    if args.engine_caps:
+        from .engine.sim import auto_engine_config
+        from .routing.topology import build_topology
+        import dataclasses
+        topo = build_topology(scenario.topology_graphml or
+                              scenario.topology_path)
+        engine_cfg = auto_engine_config(scenario, topo)
+        names = {"chunk": "chunk_windows"}
+        for kv in args.engine_caps.split(","):
+            k, _, v = kv.partition("=")
+            k = names.get(k.strip(), k.strip())
+            if k not in {"qcap", "scap", "obcap", "incap", "txqcap",
+                         "chunk_windows", "hostedcap", "tracecap"}:
+                p.error(f"unknown engine cap {k!r}")
+            try:
+                val = int(v)
+            except ValueError:
+                p.error(f"engine cap {k}={v!r} is not an integer")
+            engine_cfg = dataclasses.replace(engine_cfg, **{k: val})
+        sim = Simulation(scenario, topology=topo, engine_cfg=engine_cfg)
+    else:
+        sim = Simulation(scenario)
     import jax.numpy as jnp
     cc = {"aimd": 0, "reno": 1, "cubic": 2}[args.tcp_congestion_control]
     if cc != sim.cfg.cc_kind:

@@ -38,7 +38,8 @@ APP_TGEN = 4
 APP_BULK = 5
 APP_BULK_SERVER = 6
 APP_HOSTED = 7    # CPU-hosted real app code (hosting/)
-N_APP_KINDS = 8
+APP_GOSSIP = 8    # block-gossip / tip propagation (apps/gossip.py)
+N_APP_KINDS = 9
 
 
 def app_null(row, hp, sh, now, wake):
@@ -72,13 +73,14 @@ def _all_apps():
     from .phold import app_phold
     from .tgen import app_tgen
     from .bulk import app_bulk, app_bulk_server
+    from .gossip import app_gossip
     from ..hosting.bridge import hosted_wake
 
     def app_hosted(row, hp, sh, now, wake):
         return hosted_wake(row, hp, sh, now, wake)
 
     return [app_null, app_ping, app_ping_server, app_phold, app_tgen,
-            app_bulk, app_bulk_server, app_hosted]
+            app_bulk, app_bulk_server, app_hosted, app_gossip]
 
 
 def dispatch(row, hp, sh, now, wake, app_kinds=None):

@@ -20,7 +20,7 @@ import os
 import numpy as np
 
 from ..core.simtime import parse_time
-from .base import (APP_PING, APP_PING_SERVER, APP_PHOLD, APP_TGEN,
+from .base import (APP_PING, APP_PING_SERVER, APP_PHOLD, APP_TGEN, APP_GOSSIP,
                    APP_BULK, APP_BULK_SERVER, APP_HOSTED)
 
 
@@ -67,6 +67,19 @@ def compile_app(plugin: str, args: str, dns, num_hosts: int,
     if plugin == "bulkserver":
         cfg[1] = int(kv.get("port", 80))
         return APP_BULK_SERVER, cfg
+    if plugin == "gossip":
+        # block-gossip / Bitcoin-style tip propagation (apps/gossip.py).
+        # `n` bounds the peer id range for relay draws; it defaults to
+        # the whole scenario — in MIXED scenarios set n to the gossip
+        # host count and put the gossip hosts first, or a share of
+        # relays target non-gossip hosts and silently vanish.
+        cfg[0] = int(kv.get("n", num_hosts))
+        cfg[1] = int(kv.get("port", 8333))
+        cfg[2] = int(kv.get("fanout", 8))
+        cfg[3] = parse_time(kv.get("interval", "10s"))
+        cfg[4] = int(kv.get("miner", 0))
+        cfg[5] = int(kv.get("size", 500))
+        return APP_GOSSIP, cfg
     if plugin.startswith("hosted:"):
         # CPU-hosted real app code (hosting/): the Simulation builds a
         # HostingRuntime instance per such host; nothing device-side to
@@ -91,4 +104,4 @@ def compile_app(plugin: str, args: str, dns, num_hosts: int,
         return APP_TGEN, cfg
     raise ValueError(f"unknown plugin {plugin!r} "
                      "(builtin: ping, pingserver, phold, bulk, bulkserver, "
-                     "tgen)")
+                     "tgen, gossip)")

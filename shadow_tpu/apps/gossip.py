@@ -1,0 +1,112 @@
+"""Block-gossip app: Bitcoin-style tip propagation over a random
+overlay (the modeled-app counterpart of the reference ecosystem's
+shadow-plugin-bitcoin block-propagation workload — BASELINE.json
+config #5: "100k-node Bitcoin P2P gossip: block-propagation latency").
+
+Model: miners produce blocks of monotonically increasing height at a
+fixed interval; every node relays a block the FIRST time it sees it to
+``fanout`` uniformly random peers (UDP datagrams, the inv/announce
+role). Duplicate heights are ignored. Propagation latency needs no
+timestamp on the wire: height h was mined at
+``mine_start + (h - 1) * interval``, so each first sight contributes
+``now - mined_at`` to the per-host latency accumulators
+(ST_RTT_SUM_US/ST_RTT_COUNT — summary()'s mean_rtt_us is the mean
+block-propagation delay).
+
+app_cfg: [0]=num_hosts, [1]=port, [2]=fanout, [3]=interval ns,
+         [4]=miner (0/1), [5]=payload bytes
+app_r:   r0=socket, r1=highest height seen, r2=first-sight receptions,
+         r4=blocks mined, r5=start epoch
+Stats:   ST_XFER_DONE = first-sight receptions; RTT accumulators =
+         propagation delay (microseconds).
+
+Determinism note: peer draws always consume MAX_FANOUT PRNG values
+(mask-selected), so the per-host draw sequence is independent of the
+configured fanout — the pure-Python differential engine mirrors this
+exactly (engine.pyengine._app_gossip).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.rowops import radd, rset
+from ..engine.defs import (WAKE_START, ST_XFER_DONE, ST_RTT_SUM_US,
+                           ST_RTT_COUNT)
+from ..net import packet as P
+from ..net.udp import udp_open, udp_sendto
+from .base import draw, timer
+
+MAX_FANOUT = 8
+
+_I32 = jnp.int32
+_I64 = jnp.int64
+
+
+def _relay(row, hp, sh, now, height):
+    """Send `height` to fanout random peers (always MAX_FANOUT draws)."""
+    n = jnp.maximum(hp.app_cfg[0], 2)
+    k = jnp.clip(hp.app_cfg[2], 0, MAX_FANOUT)
+    port = hp.app_cfg[1].astype(_I32)
+    sock = row.app_r[0].astype(_I32)
+    for j in range(MAX_FANOUT):
+        row, u = draw(row, hp, sh)
+        peer = jnp.minimum((u * (n - 1).astype(jnp.float32)).astype(_I64),
+                           n - 2)
+        # skip self: indices >= hid shift up by one
+        peer = jnp.where(peer >= hp.hid, peer + 1, peer).astype(_I32)
+
+        def send(r):
+            return udp_sendto(r, hp, now, sock, peer, port,
+                              hp.app_cfg[5], aux=height.astype(_I32))
+
+        row = jax.lax.cond(j < k, send, lambda r: r, row)
+    return row
+
+
+def app_gossip(row, hp, sh, now, wake):
+    reason = wake[P.ACK]
+    interval = hp.app_cfg[3]
+
+    def on_start(r):
+        r, slot, ok = udp_open(r, port=hp.app_cfg[1].astype(_I32))
+        # r5 = the common start epoch: height h is mined at
+        # r5 + h*interval. Scenarios must start all gossip processes at
+        # the same time for the latency derivation to hold.
+        r = r.replace(app_r=rset(rset(r.app_r, 0, slot.astype(_I64)),
+                                 5, _I64(now)))
+        is_miner = hp.app_cfg[4] != 0
+        return jax.lax.cond(is_miner,
+                            lambda rr: timer(rr, now + interval),
+                            lambda rr: rr, r)
+
+    def on_timer(r):
+        # mine the next block and gossip it
+        h = r.app_r[4] + 1
+        r = r.replace(app_r=rset(rset(r.app_r, 4, h),
+                                 1, jnp.maximum(r.app_r[1], h)))
+        r = _relay(r, hp, sh, now, h)
+        return timer(r, now + interval)
+
+    def on_dgram(r):
+        h = wake[P.AUX].astype(_I64)
+        fresh = h > r.app_r[1]
+
+        def first_sight(rr):
+            # mined_at derives from the height (see module docstring);
+            # the +interval accounts for the miner's first timer delay
+            mined_at = rr.app_r[5] + h * interval
+            delay_us = jnp.maximum(now - mined_at, 0) // 1000
+            rr = rr.replace(
+                app_r=rset(radd(rr.app_r, 2, 1), 1, h),
+                stats=radd(radd(rr.stats, ST_XFER_DONE, 1),
+                           ST_RTT_SUM_US, delay_us))
+            rr = rr.replace(stats=radd(rr.stats, ST_RTT_COUNT, 1))
+            return _relay(rr, hp, sh, now, h)
+
+        return jax.lax.cond(fresh, first_sight, lambda rr: rr, r)
+
+    # START=0 TIMER=1 SOCKET=2
+    return jax.lax.switch(jnp.clip(reason, 0, 2),
+                          [on_start, on_timer, on_dgram], row)

@@ -35,7 +35,8 @@ from ..net import packet as P
 from . import defs
 from .defs import (EV_APP, EV_PKT, EV_NIC_TX, WAKE_START, WAKE_TIMER,
                    WAKE_SOCKET)
-from ..apps.base import APP_NULL, APP_PING, APP_PING_SERVER, APP_PHOLD
+from ..apps.base import (APP_NULL, APP_PING, APP_PING_SERVER, APP_PHOLD,
+                         APP_GOSSIP)
 
 
 class _Host:
@@ -288,6 +289,8 @@ class PyEngine:
             self._app_ping_server(host, now, wake)
         elif kind == APP_PHOLD:
             self._app_phold(host, now, wake)
+        elif kind == APP_GOSSIP:
+            self._app_gossip(host, now, wake)
 
     def _timer(self, host, t, aux=0):
         wake = np.zeros(P.PKT_WORDS, np.int32)
@@ -360,6 +363,50 @@ class PyEngine:
             host.app_r[1] += 1
         else:
             self._timer(host, now + self._exp_delay(host))
+
+    def _relay_gossip(self, host, now, height):
+        """Mirror of apps.gossip._relay: always MAX_FANOUT (8) draws,
+        identical float32 peer math, sends only the first `fanout`."""
+        cfg = self.hp_app_cfg[host.hid]
+        n = max(int(cfg[0]), 2)
+        k = min(max(int(cfg[2]), 0), 8)
+        for j in range(8):
+            u = self._draw(host)
+            peer = int(jnp.minimum(
+                (u * jnp.float32(n - 1)).astype(jnp.int64), n - 2))
+            if peer >= host.hid:
+                peer += 1
+            if j < k:
+                self._udp_sendto(host, now, host.app_r[0], peer,
+                                 cfg[1], cfg[5], aux=height)
+
+    def _app_gossip(self, host, now, wake):
+        """Mirror of apps.gossip.app_gossip (block-gossip workload)."""
+        cfg = self.hp_app_cfg[host.hid]
+        reason = min(max(int(wake[P.ACK]), 0), 2)
+        interval = int(cfg[3])
+        if reason == WAKE_START:
+            host.app_r[0] = self._udp_open(host, port=int(cfg[1]))
+            host.app_r[5] = now
+            if int(cfg[4]):
+                self._timer(host, now + interval)
+        elif reason == WAKE_TIMER:
+            h = host.app_r[4] + 1
+            host.app_r[4] = h
+            host.app_r[1] = max(host.app_r[1], h)
+            self._relay_gossip(host, now, h)
+            self._timer(host, now + interval)
+        else:
+            h = int(np.int64(wake[P.AUX]))
+            if h > host.app_r[1]:
+                mined_at = host.app_r[5] + h * interval
+                delay_us = max(now - mined_at, 0) // 1000
+                host.app_r[1] = h
+                host.app_r[2] += 1
+                self.stats[host.hid, defs.ST_XFER_DONE] += 1
+                self.stats[host.hid, defs.ST_RTT_SUM_US] += delay_us
+                self.stats[host.hid, defs.ST_RTT_COUNT] += 1
+                self._relay_gossip(host, now, h)
 
     # --- exchange (identical math to engine.window.exchange) ---
     def _exchange(self):

@@ -136,9 +136,24 @@ def auto_engine_config(scenario: Scenario, topo: Topology) -> EngineConfig:
             v *= 2
         return v
 
-    obcap = pow2(int(pkts_per_window * 5 // 4), 16, 512)
-    incap = pow2(2 * obcap, 32, 1024)
-    qcap = pow2(incap + 32, 32, 1024)
+    # Memory/pass-cost budget: the burst-sized caps assume every host
+    # can saturate its link simultaneously, which at 100k+ hosts would
+    # allocate queue arrays in the GBs and make every lockstep pass
+    # scan them. Cap the total slot budget (power-of-two bounds so
+    # pow2 cannot overshoot); outbox overflow defers to the next
+    # window (exact), and the event queue ALWAYS keeps timer/wake
+    # headroom above the inbound budget, whatever the clamp says —
+    # inbound bursts beyond incap are genuine queue drops, counted.
+    def pow2_floor(n):
+        return 1 << max(n, 1).bit_length() - 1
+
+    slot_budget = 1 << 24
+    hi_q = max(32, min(1024, pow2_floor(slot_budget // max(H, 1))))
+    hi_ob = max(16, min(512, pow2_floor(slot_budget // (4 * max(H, 1)))))
+
+    obcap = pow2(int(pkts_per_window * 5 // 4), 16, hi_ob)
+    incap = pow2(2 * obcap, 32, 2 * hi_ob)
+    qcap = max(pow2(incap + 32, 32, hi_q), incap + 32)
     return EngineConfig(num_hosts=H, qcap=qcap, scap=16, obcap=obcap,
                         incap=incap, txqcap=16)
 
@@ -218,8 +233,18 @@ class Simulation:
                 cpu_cost[idx] = cost
                 cpu_threshold[idx] = scenario.cpu_threshold_ns
             if spec.processes:
-                # TPU app tier: one process per host for now (multi-process
-                # hosts arrive with the hosting milestone)
+                # One process per host: the modeled-app tier binds the
+                # host's behavior machine to one app kind. The bundled
+                # workloads express combined roles in a single process
+                # (a tgen graph can be server AND client, like the
+                # reference's tgen); refuse ambiguous configs loudly
+                # rather than silently dropping processes.
+                if len(spec.processes) > 1:
+                    raise NotImplementedError(
+                        f"host {name!r} declares {len(spec.processes)} "
+                        "processes; this engine runs one process per "
+                        "host (combine roles in one behavior graph, "
+                        "or split the host)")
                 proc = spec.processes[0]
                 kind, cfg_words = compile_app(proc.plugin, proc.arguments,
                                               self.dns, H,
@@ -286,13 +311,15 @@ class Simulation:
             import dataclasses as _dc
             self.cfg = _dc.replace(self.cfg, cpu_model=True)
 
-        # pcap capture needs the trace ring sized for a window chunk
+        # pcap capture needs the trace ring sized for a window chunk;
+        # bound the chunk so the ring stays modest (capture implies a
+        # drain to the host per chunk anyway)
         if pcap_on.any() and self.cfg.tracecap == 0:
             import dataclasses as _dc
+            chunk = min(self.cfg.chunk_windows, 16)
             self.cfg = _dc.replace(
-                self.cfg,
-                tracecap=self.cfg.chunk_windows *
-                (self.cfg.obcap + self.cfg.incap))
+                self.cfg, chunk_windows=chunk,
+                tracecap=chunk * (self.cfg.obcap + self.cfg.incap))
 
         min_jump = self.topo.min_latency_ns or DEFAULT_MIN_TIME_JUMP
         self.sh = make_shared(self.topo.latency_ns, self.topo.reliability,

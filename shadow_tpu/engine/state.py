@@ -42,7 +42,10 @@ class EngineConfig:
     obcap: int = 32         # outbox (per-window emit budget) per host
     incap: int = 32         # per-window inbound packet budget per host
     txqcap: int = 16        # NIC transmit-ring slots per host
-    chunk_windows: int = 16  # windows executed per jit invocation
+    chunk_windows: int = 64  # windows executed per jit invocation
+    #   (larger chunks amortize dispatch + host sync; measured ~1.6x
+    #   on-chip at 128 vs 32 — heartbeat/pcap/checkpoint granularity
+    #   is per chunk, so not unbounded)
     cc_kind: int = 2        # 0=aimd 1=reno 2=cubic (reference default cubic)
     hostedcap: int = 1      # hosted-app wake-ring slots per host (hosting/)
     # Dead-branch pruning: which app kinds exist in this scenario, and

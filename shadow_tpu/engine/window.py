@@ -74,7 +74,8 @@ def _make_handlers(cfg: EngineConfig):
         def deliver(r):
             r = r.replace(stats=r.stats.at[ST_PKTS_RECV].add(1))
             # delivery-status trail: admitted by the input buffer
-            pkt_in = pkt.at[P.STATUS].add(P.DS_RX_BUFFERED)
+            pkt_in = pkt.at[P.STATUS].set(pkt[P.STATUS] |
+                                          P.DS_RX_BUFFERED)
             proto = pkt[P.FLAGS] & P.PROTO_MASK
 
             def tcp_path(rr):
@@ -135,6 +136,26 @@ def step_one_host(row, hp, sh, wend, cfg: EngineConfig):
                        lambda r: r, row)
     row = jax.lax.switch(kind, _make_handlers(cfg), row, hp, sh, t, wend, pkt)
 
+    # Chain a due-now NIC-TX into the same lockstep pass: an app send
+    # kicks an EV_NIC_TX at the current time when the NIC is idle, and
+    # waiting a whole all-hosts pass to serve it doubles the pass count
+    # of every send-heavy window. Executing the queue head early is
+    # semantically identity (it would be the first pop of the next
+    # pass) and the Python differential engine drains per-host queues
+    # in exactly this order, so stats stay bit-identical. Disabled
+    # under the CPU model: there every pop re-checks the blocked-CPU
+    # threshold, which the chain would bypass.
+    due = jnp.zeros((), jnp.bool_)
+    if not cfg.cpu_model:
+        from ..net import nic as _nic
+        slot2, t2 = equeue.q_min(row)
+        due = ready & (t2 == t) & (rget(row.eq_kind, slot2) == EV_NIC_TX)
+        row = jax.lax.cond(
+            due,
+            lambda r: _nic.on_tx(equeue.q_clear_slot(r, slot2), hp, sh, t,
+                                 wend, pkt, qdisc=cfg.qdisc),
+            lambda r: r, row)
+
     if cfg.cpu_model:
         # charge this event's modeled CPU cost to the busy horizon
         row = row.replace(cpu_avail=jnp.where(
@@ -143,7 +164,8 @@ def step_one_host(row, hp, sh, wend, cfg: EngineConfig):
             row.cpu_avail))
 
     return row.replace(
-        stats=radd(row.stats, ST_EVENTS, jnp.where(ready, 1, 0)))
+        stats=radd(row.stats, ST_EVENTS,
+                   jnp.where(ready, 1, 0) + jnp.where(due, 1, 0)))
 
 
 def step_all_hosts(hosts, hp, sh, wend, cfg: EngineConfig):

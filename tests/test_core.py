@@ -55,3 +55,24 @@ def test_rng_determinism_and_independence():
     u2 = float(R.uniform_from(R.counter_key(k1, 1)))
     assert u1 != u2
     assert 0.0 <= u1 < 1.0
+
+
+def test_multi_process_host_rejected(simple_topology_xml):
+    """Multiple processes on one host are refused loudly (one behavior
+    machine per host; combined roles go in one tgen graph)."""
+    import pytest
+    from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario
+    from shadow_tpu.engine.sim import Simulation
+
+    scen = Scenario(
+        stop_time=10**9,
+        topology_graphml=simple_topology_xml,
+        hosts=[HostSpec(id="h", processes=[
+            ProcessSpec(plugin="pingserver", start_time=0,
+                        arguments="port=1"),
+            ProcessSpec(plugin="pingserver", start_time=0,
+                        arguments="port=2"),
+        ])],
+    )
+    with pytest.raises(NotImplementedError, match="2 processes"):
+        Simulation(scen)

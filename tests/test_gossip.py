@@ -1,0 +1,80 @@
+"""Block-gossip app tests (apps/gossip.py): the modeled counterpart of
+the Bitcoin block-propagation workload (BASELINE.json config #5)."""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario
+from shadow_tpu.engine import defs
+from shadow_tpu.engine.pyengine import PyEngine
+from shadow_tpu.engine.sim import Simulation
+from shadow_tpu.engine.state import EngineConfig
+
+from test_phold import MESH_TOPO
+
+
+def gossip_scenario(n=64, stop=22, fanout=6, interval="2s",
+                    topo=None):
+    return Scenario(
+        stop_time=stop * 10**9,
+        topology_graphml=topo or MESH_TOPO,
+        hosts=[
+            HostSpec(id="miner", processes=[
+                ProcessSpec(plugin="gossip", start_time=10**9,
+                            arguments=f"port=8333 fanout={fanout} "
+                                      f"interval={interval} miner=1 "
+                                      "size=500")]),
+            HostSpec(id="node", quantity=n - 1, processes=[
+                ProcessSpec(plugin="gossip", start_time=10**9,
+                            arguments=f"port=8333 fanout={fanout} "
+                                      f"interval={interval} size=500")]),
+        ],
+    )
+
+
+def test_gossip_propagates_to_all():
+    """Blocks mined every 2s starting t=3s reach (essentially) every
+    node well before the stop time; propagation delay is a few network
+    hops, not the mining interval."""
+    n = 64
+    cfg = EngineConfig(num_hosts=n, qcap=32, scap=4, obcap=16, incap=32,
+                       chunk_windows=32)
+    r = Simulation(gossip_scenario(n=n), engine_cfg=cfg).run()
+    s = r.summary()
+    # miner produced blocks at 3,5,...,21s = 10 heights
+    xf = r.stats[1:, defs.ST_XFER_DONE]
+    assert xf.max() == 10
+    # flood with fanout 6 over 64 nodes: everyone hears nearly all
+    # blocks (late blocks may still be in flight at the stop time)
+    assert (xf >= 8).all(), xf
+    # mean propagation delay: a few 25ms hops, far below the interval
+    assert 0 < s["mean_rtt_us"] < 1_000_000, s["mean_rtt_us"]
+    assert s["drop_net"] == 0
+
+
+def test_gossip_deterministic():
+    cfg = EngineConfig(num_hosts=32, qcap=32, scap=4, obcap=16, incap=32,
+                       chunk_windows=32)
+    r1 = Simulation(gossip_scenario(n=32, stop=12), engine_cfg=cfg).run()
+    r2 = Simulation(gossip_scenario(n=32, stop=12), engine_cfg=cfg).run()
+    assert np.array_equal(r1.stats, r2.stats)
+
+
+def test_differential_gossip():
+    """Compiled engine vs the pure-Python heap engine, bit for bit
+    (the dual-run pattern, SURVEY §4) on the gossip workload."""
+    from test_differential import CFG, COMPARE
+
+    n = 16
+
+    def scen():
+        return gossip_scenario(n=n, stop=10, fanout=4, interval="1500ms")
+
+    jax_stats = Simulation(scen(), engine_cfg=EngineConfig(
+        num_hosts=n, **CFG)).run().stats
+    py_stats = PyEngine(Simulation(scen(), engine_cfg=EngineConfig(
+        num_hosts=n, **CFG))).run()
+    for st in COMPARE:
+        assert np.array_equal(jax_stats[:, st], py_stats[:, st]), (
+            f"stat {st} diverges:\n jax={jax_stats[:, st]}\n "
+            f"py={py_stats[:, st]}")

@@ -218,25 +218,34 @@ def _path_jitter(g: Graph):
             best[key] = k
     ks = np.array(sorted(best.values()), dtype=np.int64)
     adj = csr_matrix((w[ks], (s[ks], d[ks])), shape=(V, V))
-    dist, pred = dijkstra(adj, directed=True, return_predecessors=True)
+    _, pred = dijkstra(adj, directed=True, return_predecessors=True)
     ej = np.zeros((V, V))
     ej[s[ks], d[ks]] = jv[ks]
     out = np.zeros((V, V))
+    # Accumulate in predecessor-tree depth order (memoized walk):
+    # exact even with equal-distance ties, one pass per source.
+    # (Tie-breaking among equal-cost paths follows scipy's dijkstra,
+    # which the latency/loss oracle also uses on the scipy path; the
+    # native oracle can differ only on equal-cost multipaths.)
     for a in range(V):
-        # fixpoint over the predecessor tree: robust to equal-distance
-        # ties (zero-latency edges), where distance order alone can
-        # visit a child before its predecessor
-        for _ in range(V):
-            changed = False
-            for b in range(V):
-                p = pred[a, b]
-                if b != a and p >= 0:
-                    v = out[a, p] + ej[p, b]
-                    if v != out[a, b]:
-                        out[a, b] = v
-                        changed = True
-            if not changed:
-                break
+        pr = pred[a]
+        depth = np.full(V, -1, dtype=np.int64)
+        depth[a] = 0
+        for b in range(V):
+            if depth[b] >= 0 or pr[b] < 0:
+                continue
+            chain = []
+            x = b
+            while depth[x] < 0 and pr[x] >= 0:
+                chain.append(x)
+                x = pr[x]
+            base = depth[x] if depth[x] >= 0 else 0
+            for i, y in enumerate(reversed(chain)):
+                depth[y] = base + i + 1
+        for b in np.argsort(depth, kind="stable"):
+            p = pr[b]
+            if b != a and p >= 0:
+                out[a, b] = out[a, p] + ej[p, b]
     return out
 
 
