@@ -39,7 +39,9 @@ APP_BULK = 5
 APP_BULK_SERVER = 6
 APP_HOSTED = 7    # CPU-hosted real app code (hosting/)
 APP_GOSSIP = 8    # block-gossip / tip propagation (apps/gossip.py)
-N_APP_KINDS = 9
+APP_SOCKS_CLIENT = 9  # proxy-chain fetch client (apps/socks.py)
+APP_SOCKS_PROXY = 10  # SOCKS relay proxy (apps/socks.py)
+N_APP_KINDS = 11
 
 
 def app_null(row, hp, sh, now, wake):
@@ -74,13 +76,15 @@ def _all_apps():
     from .tgen import app_tgen
     from .bulk import app_bulk, app_bulk_server
     from .gossip import app_gossip
+    from .socks import app_socks_client, app_socks_proxy
     from ..hosting.bridge import hosted_wake
 
     def app_hosted(row, hp, sh, now, wake):
         return hosted_wake(row, hp, sh, now, wake)
 
     return [app_null, app_ping, app_ping_server, app_phold, app_tgen,
-            app_bulk, app_bulk_server, app_hosted, app_gossip]
+            app_bulk, app_bulk_server, app_hosted, app_gossip,
+            app_socks_client, app_socks_proxy]
 
 
 def dispatch(row, hp, sh, now, wake, app_kinds=None):

@@ -21,7 +21,8 @@ import numpy as np
 
 from ..core.simtime import parse_time
 from .base import (APP_PING, APP_PING_SERVER, APP_PHOLD, APP_TGEN, APP_GOSSIP,
-                   APP_BULK, APP_BULK_SERVER, APP_HOSTED)
+                   APP_BULK, APP_BULK_SERVER, APP_HOSTED,
+                   APP_SOCKS_CLIENT, APP_SOCKS_PROXY)
 
 
 def parse_kv(args: str) -> dict:
@@ -80,6 +81,29 @@ def compile_app(plugin: str, args: str, dns, num_hosts: int,
         cfg[4] = int(kv.get("miner", 0))
         cfg[5] = int(kv.get("size", 500))
         return APP_GOSSIP, cfg
+    if plugin == "socksclient":
+        # proxy-chain fetch client (apps/socks.py). Host-id ranges name
+        # the proxy and server pools (hosts are id-ordered by their
+        # declaration order in the scenario).
+        cfg[0] = int(kv["proxy-lo"])
+        cfg[1] = int(kv["proxy-hi"])
+        cfg[2] = int(kv.get("proxy-port", 9050))
+        cfg[3] = int(kv["server-lo"])
+        cfg[4] = int(kv["server-hi"])
+        size_kib = max(1, int(kv.get("size", 51200)) >> 10)
+        if size_kib > 0x3FF:
+            # the SYN-tag CONNECT encoding carries 10 bits of size
+            raise ValueError(
+                f"socksclient size {kv.get('size')} exceeds the "
+                "1023 KiB per-fetch limit of the tag encoding")
+        cfg[5] = size_kib
+        cfg[6] = int(kv.get("count", 0))
+        cfg[7] = parse_time(kv.get("pause", "1s"))
+        return APP_SOCKS_CLIENT, cfg
+    if plugin == "socksproxy":
+        cfg[1] = int(kv.get("port", 9050))
+        cfg[2] = int(kv.get("server-port", 80))
+        return APP_SOCKS_PROXY, cfg
     if plugin.startswith("hosted:"):
         # CPU-hosted real app code (hosting/): the Simulation builds a
         # HostingRuntime instance per such host; nothing device-side to
@@ -104,4 +128,4 @@ def compile_app(plugin: str, args: str, dns, num_hosts: int,
         return APP_TGEN, cfg
     raise ValueError(f"unknown plugin {plugin!r} "
                      "(builtin: ping, pingserver, phold, bulk, bulkserver, "
-                     "tgen, gossip)")
+                     "tgen, gossip, socksclient, socksproxy)")

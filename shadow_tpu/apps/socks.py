@@ -1,0 +1,183 @@
+"""SOCKS-style proxy chains: client -> proxy -> server fetches.
+
+The modeled counterpart of the reference ecosystem's SOCKS workload
+(BASELINE.json config #3: "10k-node SOCKS-proxy chains on PlanetLab")
+— in the reference, tgen clients reach their servers through a SOCKS
+transport hop (shd-tgen-transport.c SOCKS handshake + relay). Here the
+proxy is a first-class vectorized app:
+
+- **client** picks a random proxy and a random target server, opens a
+  TCP connection to the proxy whose SYN tag encodes (target, size) —
+  the role of the SOCKS CONNECT header — and waits for the relayed
+  response; EOF completes the fetch (latency into the RTT stats).
+- **proxy** accepts the connection, opens an onward TCP connection to
+  the target (SYN tag = plain GET size, the tgen-server convention, so
+  targets can be tgen servers), and streams response bytes back to the
+  client as they arrive. Socket pairing lives in sk_app_ref: each side
+  of a relay points at its partner slot.
+
+Tag packing (31 usable SYN-tag bits): bits 11-30 target host id (up to
+~1M hosts), bits 1-10 response size in KiB (up to 1023 KiB), bit 0
+reserved (clear, so the onward GET convention is unambiguous).
+
+Client config: c0=proxy_lo, c1=proxy_hi, c2=proxy port, c3=server_lo,
+c4=server_hi, c5=size KiB, c6=count (0 = forever), c7=pause ns.
+Client registers: r0=socket, r1=fetches done, r2=fetch start time.
+Proxy config: c1=listen port, c2=server port.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.rowops import radd, rget, rset
+from ..engine.defs import (ST_XFER_DONE, ST_APP_DONE, ST_RTT_SUM_US,
+                           ST_RTT_COUNT)
+from ..net import packet as P
+from ..net.tcp import tcp_connect, tcp_listen, tcp_write, tcp_close_call
+from .base import draw, timer
+
+_I32 = jnp.int32
+_I64 = jnp.int64
+
+TAG_HOST_SHIFT = 11
+TAG_KIB_SHIFT = 1
+TAG_KIB_MASK = 0x3FF
+
+
+def pack_tag(target_host, size_kib):
+    return ((target_host.astype(_I32) << TAG_HOST_SHIFT) |
+            ((size_kib.astype(_I32) & TAG_KIB_MASK) << TAG_KIB_SHIFT))
+
+
+def _rand_in(row, hp, sh, lo, hi):
+    """Uniform host id in [lo, hi)."""
+    row, u = draw(row, hp, sh)
+    n = jnp.maximum(hi - lo, 1)
+    return row, (lo + jnp.minimum((u * n.astype(jnp.float32)).astype(_I64),
+                                  n - 1)).astype(_I32)
+
+
+def app_socks_client(row, hp, sh, now, wake):
+    reason = wake[P.ACK]
+    slot = wake[P.SEQ]
+    fresh = wake[P.WND] == rget(row.sk_timer_gen, slot)
+
+    def fetch(r):
+        r, proxy = _rand_in(r, hp, sh, hp.app_cfg[0], hp.app_cfg[1])
+        r, server = _rand_in(r, hp, sh, hp.app_cfg[3], hp.app_cfg[4])
+        tag = pack_tag(server, hp.app_cfg[5])
+        r, s, ok = tcp_connect(r, hp, sh, now, dst_host=proxy,
+                               dst_port=hp.app_cfg[2].astype(_I32),
+                               tag=tag)
+        r = r.replace(app_r=rset(rset(r.app_r, 0, s.astype(_I64)),
+                                 2, _I64(now)))
+        # connect failure: retry after the pause instead of stalling
+        return jax.lax.cond(ok, lambda rr: rr,
+                            lambda rr: timer(rr, now + hp.app_cfg[7]), r)
+
+    def on_eof(r):
+        is_mine = fresh & (slot == r.app_r[0].astype(_I32))
+        # a refused relay (proxy out of sockets) closes with ZERO bytes
+        # delivered: retry after the pause, never count it as a fetch
+        got_data = rget(r.sk_rcv_nxt, slot) > 0
+
+        def done(rr):
+            delay_us = jnp.maximum(now - rr.app_r[2], 0) // 1000
+            rr = tcp_close_call(rr, now, slot)
+            rr = rr.replace(
+                app_r=radd(rr.app_r, 1, 1),
+                stats=radd(radd(radd(rr.stats, ST_XFER_DONE, 1),
+                                ST_RTT_SUM_US, delay_us),
+                           ST_RTT_COUNT, 1))
+            fin = (hp.app_cfg[6] > 0) & (rr.app_r[1] >= hp.app_cfg[6])
+            return jax.lax.cond(
+                fin,
+                lambda r2: r2.replace(stats=radd(r2.stats, ST_APP_DONE, 1)),
+                lambda r2: timer(r2, now + hp.app_cfg[7]), rr)
+
+        def refused(rr):
+            rr = tcp_close_call(rr, now, slot)
+            return timer(rr, now + hp.app_cfg[7])
+
+        return jax.lax.cond(
+            is_mine,
+            lambda rr: jax.lax.cond(got_data, done, refused, rr),
+            lambda rr: rr, r)
+
+    def nop(r):
+        return r
+
+    # START=0 TIMER=1 SOCKET=2 CONNECTED=3 EOF=4 ACCEPT=5 SENT=6
+    return jax.lax.switch(
+        jnp.clip(reason, 0, 6),
+        [fetch, fetch, nop, nop, on_eof, nop, nop],
+        row)
+
+
+def app_socks_proxy(row, hp, sh, now, wake):
+    reason = wake[P.ACK]
+    slot = wake[P.SEQ]
+    fresh = wake[P.WND] == rget(row.sk_timer_gen, slot)
+    paired = rget(row.sk_app_ref, slot)
+    is_child = rget(row.sk_parent, slot) >= 0    # client-facing side
+
+    def on_start(r):
+        r, lslot, ok = tcp_listen(r, hp.app_cfg[1].astype(_I32))
+        return r
+
+    def on_accept(r):
+        # SOCKS CONNECT: open the onward leg to the tagged target
+        tag = rget(row.sk_syn_tag, slot)
+        target = (tag >> TAG_HOST_SHIFT).astype(_I32)
+        size = (((tag >> TAG_KIB_SHIFT) & TAG_KIB_MASK).astype(_I32)
+                << 10)
+
+        def go(rr):
+            rr, onward, ok = tcp_connect(rr, hp, sh, now,
+                                         dst_host=target,
+                                         dst_port=hp.app_cfg[2].astype(_I32),
+                                         tag=size)
+
+            def pair(r2):
+                return r2.replace(sk_app_ref=rset(
+                    rset(r2.sk_app_ref, onward, slot),
+                    slot, onward.astype(_I32)))
+
+            # onward socket table full: refuse the client (close child)
+            return jax.lax.cond(
+                ok, pair, lambda r2: tcp_close_call(r2, now, slot), rr)
+
+        return jax.lax.cond(fresh, go, lambda rr: rr, r)
+
+    def on_data(r):
+        # response bytes arriving on the onward leg: stream them back
+        relay = fresh & ~is_child & (paired >= 0)
+        ln = wake[P.LEN].astype(_I64)
+        return jax.lax.cond(
+            relay & (ln > 0),
+            lambda rr: tcp_write(rr, now, paired, ln),
+            lambda rr: rr, r)
+
+    def on_eof(r):
+        def close_pair(rr):
+            # clear the pairing, close this side now; the partner
+            # closes after its pending writes drain (close_after)
+            rr = rr.replace(sk_app_ref=rset(
+                rset(rr.sk_app_ref, slot, -1), paired, -1))
+            rr = tcp_close_call(rr, now, slot)
+            return jax.lax.cond(
+                paired >= 0,
+                lambda r2: tcp_close_call(r2, now, paired),
+                lambda r2: r2, rr)
+
+        return jax.lax.cond(fresh, close_pair, lambda rr: rr, r)
+
+    def nop(r):
+        return r
+
+    return jax.lax.switch(
+        jnp.clip(reason, 0, 6),
+        [on_start, nop, on_data, nop, on_eof, on_accept, nop],
+        row)

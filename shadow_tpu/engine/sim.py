@@ -268,9 +268,11 @@ class Simulation:
         if self.cfg.app_kinds is None:
             import dataclasses as _dc
             from ..apps.base import (APP_TGEN, APP_BULK, APP_BULK_SERVER,
-                                     APP_HOSTED)
+                                     APP_HOSTED, APP_SOCKS_CLIENT,
+                                     APP_SOCKS_PROXY)
             kinds = tuple(sorted(set(int(k) for k in app_kind.tolist())))
-            tcp_kinds = {APP_TGEN, APP_BULK, APP_BULK_SERVER, APP_HOSTED}
+            tcp_kinds = {APP_TGEN, APP_BULK, APP_BULK_SERVER, APP_HOSTED,
+                         APP_SOCKS_CLIENT, APP_SOCKS_PROXY}
             self.cfg = _dc.replace(
                 self.cfg, app_kinds=kinds,
                 uses_tcp=bool(tcp_kinds & set(kinds)))

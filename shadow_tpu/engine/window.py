@@ -147,13 +147,12 @@ def step_one_host(row, hp, sh, wend, cfg: EngineConfig):
     # threshold, which the chain would bypass.
     due = jnp.zeros((), jnp.bool_)
     if not cfg.cpu_model:
-        from ..net import nic as _nic
         slot2, t2 = equeue.q_min(row)
         due = ready & (t2 == t) & (rget(row.eq_kind, slot2) == EV_NIC_TX)
         row = jax.lax.cond(
             due,
-            lambda r: _nic.on_tx(equeue.q_clear_slot(r, slot2), hp, sh, t,
-                                 wend, pkt, qdisc=cfg.qdisc),
+            lambda r: nic.on_tx(equeue.q_clear_slot(r, slot2), hp, sh, t,
+                                wend, pkt, qdisc=cfg.qdisc),
             lambda r: r, row)
 
     if cfg.cpu_model:
