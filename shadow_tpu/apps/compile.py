@@ -90,19 +90,31 @@ def compile_app(plugin: str, args: str, dns, num_hosts: int,
         cfg[2] = int(kv.get("proxy-port", 9050))
         cfg[3] = int(kv["server-lo"])
         cfg[4] = int(kv["server-hi"])
-        size_kib = max(1, int(kv.get("size", 51200)) >> 10)
-        if size_kib > 0x3FF:
-            # the SYN-tag CONNECT encoding carries 10 bits of size
+        if max(cfg[1], cfg[4]) > 0xFFFFF:
+            raise ValueError(
+                "socksclient proxy/server host ids exceed the 20-bit "
+                "CONNECT-tag field (max ~1M hosts)")
+        # sizes round UP to the tag's 4 KiB units (never under-deliver)
+        size_u4k = max(1, (int(kv.get("size", 51200)) + 4095) >> 12)
+        if size_u4k > 0x1FF:
+            # the SYN-tag CONNECT encoding carries 9 bits of 4KiB units
             raise ValueError(
                 f"socksclient size {kv.get('size')} exceeds the "
-                "1023 KiB per-fetch limit of the tag encoding")
-        cfg[5] = size_kib
+                "~2 MiB per-fetch limit of the tag encoding")
+        cfg[5] = size_u4k
         cfg[6] = int(kv.get("count", 0))
-        cfg[7] = parse_time(kv.get("pause", "1s"))
+        hops = int(kv.get("hops", 1))
+        if not 1 <= hops <= 3:
+            raise ValueError("socksclient hops must be 1-3 "
+                             "(relays per circuit)")
+        cfg[7] = parse_time(kv.get("pause", "1s")) | (hops << 56)
         return APP_SOCKS_CLIENT, cfg
     if plugin == "socksproxy":
         cfg[1] = int(kv.get("port", 9050))
         cfg[2] = int(kv.get("server-port", 80))
+        # relay pool for multi-hop circuit extension (0,0 = none)
+        cfg[3] = int(kv.get("relay-lo", 0))
+        cfg[4] = int(kv.get("relay-hi", 0))
         return APP_SOCKS_PROXY, cfg
     if plugin.startswith("hosted:"):
         # CPU-hosted real app code (hosting/): the Simulation builds a

@@ -16,14 +16,25 @@ proxy is a first-class vectorized app:
   client as they arrive. Socket pairing lives in sk_app_ref: each side
   of a relay points at its partner slot.
 
-Tag packing (31 usable SYN-tag bits): bits 11-30 target host id (up to
-~1M hosts), bits 1-10 response size in KiB (up to 1023 KiB), bit 0
-reserved (clear, so the onward GET convention is unambiguous).
+**Multi-hop circuits** (the Tor shape — BASELINE.json config #4's
+relay/perfclient traffic model): the CONNECT tag carries a
+hops-remaining count; a relay receiving hops > 0 extends the chain to
+another RANDOM relay (tag hops-1) instead of the target, so a client
+with hops=3 builds client -> entry -> middle -> exit -> server, and
+response bytes stream back through every hop. This reproduces the
+bandwidth/latency structure of onion-routed downloads without
+per-circuit cryptographic state (which a DES doesn't model anyway).
 
-Client config: c0=proxy_lo, c1=proxy_hi, c2=proxy port, c3=server_lo,
-c4=server_hi, c5=size KiB, c6=count (0 = forever), c7=pause ns.
+Tag packing (31 usable SYN-tag bits): bits 29-30 relay hops remaining,
+bits 9-28 target host id (up to ~1M hosts), bits 0-8 response size in
+4 KiB units (up to ~2 MiB).
+
+Client config: c0=relay_lo, c1=relay_hi, c2=relay port, c3=server_lo,
+c4=server_hi, c5=size (4 KiB units), c6=count (0 = forever),
+c7=pause ns | (hops << 56).
 Client registers: r0=socket, r1=fetches done, r2=fetch start time.
-Proxy config: c1=listen port, c2=server port.
+Proxy config: c1=listen port, c2=server port, c3=relay_lo,
+c4=relay_hi (the pool for chain extension).
 """
 
 from __future__ import annotations
@@ -33,7 +44,7 @@ import jax.numpy as jnp
 
 from ..core.rowops import radd, rget, rset
 from ..engine.defs import (ST_XFER_DONE, ST_APP_DONE, ST_RTT_SUM_US,
-                           ST_RTT_COUNT)
+                           ST_RTT_COUNT, ST_CHAIN_SHORT)
 from ..net import packet as P
 from ..net.tcp import tcp_connect, tcp_listen, tcp_write, tcp_close_call
 from .base import draw, timer
@@ -41,20 +52,32 @@ from .base import draw, timer
 _I32 = jnp.int32
 _I64 = jnp.int64
 
-TAG_HOST_SHIFT = 11
-TAG_KIB_SHIFT = 1
-TAG_KIB_MASK = 0x3FF
+TAG_HOPS_SHIFT = 29          # bits 29-30: relay hops remaining (0-3)
+TAG_HOST_SHIFT = 9           # bits 9-28: target host id
+TAG_HOST_MASK = 0xFFFFF
+TAG_U4K_MASK = 0x1FF         # bits 0-8: size in 4 KiB units
 
 
-def pack_tag(target_host, size_kib):
-    return ((target_host.astype(_I32) << TAG_HOST_SHIFT) |
-            ((size_kib.astype(_I32) & TAG_KIB_MASK) << TAG_KIB_SHIFT))
+def pack_tag(target_host, size_u4k, hops=0):
+    return (((jnp.asarray(hops).astype(_I32) & 0x3) << TAG_HOPS_SHIFT) |
+            ((target_host.astype(_I32) & TAG_HOST_MASK) << TAG_HOST_SHIFT) |
+            (size_u4k.astype(_I32) & TAG_U4K_MASK))
 
 
-def _rand_in(row, hp, sh, lo, hi):
-    """Uniform host id in [lo, hi)."""
+def _rand_in(row, hp, sh, lo, hi, skip_self=False):
+    """Uniform host id in [lo, hi); with skip_self, this host is
+    excluded when it lies in the range (relays never pick themselves
+    as the next circuit hop — repeated DISTINCT relays remain
+    possible, unlike real Tor path selection)."""
     row, u = draw(row, hp, sh)
     n = jnp.maximum(hi - lo, 1)
+    if skip_self:
+        in_pool = (hp.hid >= lo) & (hp.hid < hi) & (n > 1)
+        n_eff = n - jnp.where(in_pool, 1, 0)
+        idx = jnp.minimum((u * n_eff.astype(jnp.float32)).astype(_I64),
+                          n_eff - 1)
+        idx = jnp.where(in_pool & (lo + idx >= hp.hid), idx + 1, idx)
+        return row, (lo + idx).astype(_I32)
     return row, (lo + jnp.minimum((u * n.astype(jnp.float32)).astype(_I64),
                                   n - 1)).astype(_I32)
 
@@ -64,10 +87,16 @@ def app_socks_client(row, hp, sh, now, wake):
     slot = wake[P.SEQ]
     fresh = wake[P.WND] == rget(row.sk_timer_gen, slot)
 
+    pause = hp.app_cfg[7] & ((1 << 56) - 1)
+    hops = (hp.app_cfg[7] >> 56).astype(_I32)
+
     def fetch(r):
         r, proxy = _rand_in(r, hp, sh, hp.app_cfg[0], hp.app_cfg[1])
         r, server = _rand_in(r, hp, sh, hp.app_cfg[3], hp.app_cfg[4])
-        tag = pack_tag(server, hp.app_cfg[5])
+        # hops=1 means one relay total: the first relay goes straight
+        # to the target (tag hops counts EXTENSIONS beyond it)
+        tag = pack_tag(server, hp.app_cfg[5],
+                       jnp.maximum(hops - 1, 0))
         r, s, ok = tcp_connect(r, hp, sh, now, dst_host=proxy,
                                dst_port=hp.app_cfg[2].astype(_I32),
                                tag=tag)
@@ -75,7 +104,7 @@ def app_socks_client(row, hp, sh, now, wake):
                                  2, _I64(now)))
         # connect failure: retry after the pause instead of stalling
         return jax.lax.cond(ok, lambda rr: rr,
-                            lambda rr: timer(rr, now + hp.app_cfg[7]), r)
+                            lambda rr: timer(rr, now + pause), r)
 
     def on_eof(r):
         is_mine = fresh & (slot == r.app_r[0].astype(_I32))
@@ -95,11 +124,11 @@ def app_socks_client(row, hp, sh, now, wake):
             return jax.lax.cond(
                 fin,
                 lambda r2: r2.replace(stats=radd(r2.stats, ST_APP_DONE, 1)),
-                lambda r2: timer(r2, now + hp.app_cfg[7]), rr)
+                lambda r2: timer(r2, now + pause), rr)
 
         def refused(rr):
             rr = tcp_close_call(rr, now, slot)
-            return timer(rr, now + hp.app_cfg[7])
+            return timer(rr, now + pause)
 
         return jax.lax.cond(
             is_mine,
@@ -128,17 +157,33 @@ def app_socks_proxy(row, hp, sh, now, wake):
         return r
 
     def on_accept(r):
-        # SOCKS CONNECT: open the onward leg to the tagged target
+        # SOCKS CONNECT: open the onward leg — to another relay while
+        # the tag still carries hops (circuit extension, the Tor
+        # shape), else to the tagged target
         tag = rget(row.sk_syn_tag, slot)
-        target = (tag >> TAG_HOST_SHIFT).astype(_I32)
-        size = (((tag >> TAG_KIB_SHIFT) & TAG_KIB_MASK).astype(_I32)
-                << 10)
+        hops = (tag >> TAG_HOPS_SHIFT) & 0x3
+        target = ((tag >> TAG_HOST_SHIFT) & TAG_HOST_MASK).astype(_I32)
+        size = ((tag & TAG_U4K_MASK).astype(_I32) << 12)
+        has_pool = hp.app_cfg[4] > hp.app_cfg[3]
+        extend = (hops > 0) & has_pool
+        # a hops>0 CONNECT at a relay with no extension pool degrades
+        # to a direct fetch — count it so the config mismatch is visible
+        r = r.replace(stats=radd(r.stats, ST_CHAIN_SHORT,
+                                 jnp.where((hops > 0) & ~has_pool & fresh,
+                                           1, 0)))
 
         def go(rr):
-            rr, onward, ok = tcp_connect(rr, hp, sh, now,
-                                         dst_host=target,
-                                         dst_port=hp.app_cfg[2].astype(_I32),
-                                         tag=size)
+            rr, nxt_relay = _rand_in(rr, hp, sh, hp.app_cfg[3],
+                                     hp.app_cfg[4], skip_self=True)
+            dst = jnp.where(extend, nxt_relay, target)
+            dport = jnp.where(extend, hp.app_cfg[1],
+                              hp.app_cfg[2]).astype(_I32)
+            otag = jnp.where(
+                extend,
+                pack_tag(target, (tag & TAG_U4K_MASK), hops - 1),
+                size)
+            rr, onward, ok = tcp_connect(rr, hp, sh, now, dst_host=dst,
+                                         dst_port=dport, tag=otag)
 
             def pair(r2):
                 return r2.replace(sk_app_ref=rset(

@@ -43,4 +43,6 @@ ST_RTT_SUM_US = 14     # accumulated app RTT measurements (microseconds)
 ST_RTT_COUNT = 15      # number of RTT samples
 ST_TXQ_DROP = 16       # dropped: NIC transmit ring full (sndbuf overflow)
 ST_TGEN_DROP = 17      # tgen walk forks lost to cursor-stack overflow
-N_STATS = 18
+ST_CHAIN_SHORT = 18    # socks circuits shortened: relay had no pool to
+#                        extend a hops>0 CONNECT (config mismatch)
+N_STATS = 19

@@ -69,3 +69,44 @@ def test_socks_deterministic():
     r1 = Simulation(socks_scenario(n_clients=1), engine_cfg=cfg).run()
     r2 = Simulation(socks_scenario(n_clients=1), engine_cfg=cfg).run()
     assert np.array_equal(r1.stats, r2.stats)
+
+
+def test_socks_three_hop_circuit():
+    """hops=3 builds client -> entry -> middle -> exit -> server (the
+    Tor circuit shape, BASELINE config #4): response bytes traverse
+    every relay, so total relay-sent bytes ~= 3x the payload."""
+    n = 2
+    size = 20480
+    cfg = EngineConfig(num_hosts=4 + n, qcap=64, scap=16, obcap=64,
+                       incap=128, chunk_windows=32)
+    scen = Scenario(
+        stop_time=60 * 10**9,
+        topology_graphml=MESH_TOPO,
+        hosts=[
+            HostSpec(id="server", quantity=2, processes=[
+                ProcessSpec(plugin="tgen", start_time=10**9,
+                            arguments=SERVER_GRAPH)]),
+            HostSpec(id="relay", quantity=2, processes=[
+                ProcessSpec(plugin="socksproxy", start_time=10**9,
+                            arguments="port=9050 server-port=80 "
+                                      "relay-lo=2 relay-hi=4")]),
+            HostSpec(id="client", quantity=n, processes=[
+                ProcessSpec(plugin="socksclient", start_time=2 * 10**9,
+                            arguments=f"proxy-lo=2 proxy-hi=4 "
+                                      f"proxy-port=9050 server-lo=0 "
+                                      f"server-hi=2 size={size} hops=3 "
+                                      "count=2 pause=1s")]),
+        ],
+    )
+    r = Simulation(scen, engine_cfg=cfg).run()
+    stats = r.stats
+    clients = slice(4, 4 + n)
+    assert (stats[clients, defs.ST_XFER_DONE] == 2).all(), \
+        stats[:, defs.ST_XFER_DONE]
+    assert (stats[clients, defs.ST_BYTES_RECV] >= 2 * size).all()
+    # every response crossed 3 relay hops: relays collectively sent
+    # ~3x what the clients received (entry+middle+exit forwarding)
+    relay_sent = stats[2:4, defs.ST_BYTES_SENT].sum()
+    client_got = stats[clients, defs.ST_BYTES_RECV].sum()
+    assert relay_sent >= 3 * client_got * 9 // 10, (relay_sent,
+                                                    client_got)
