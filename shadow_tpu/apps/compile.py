@@ -90,10 +90,12 @@ def compile_app(plugin: str, args: str, dns, num_hosts: int,
         cfg[2] = int(kv.get("proxy-port", 9050))
         cfg[3] = int(kv["server-lo"])
         cfg[4] = int(kv["server-hi"])
-        if max(cfg[1], cfg[4]) > 0xFFFFF:
+        if cfg[4] - 1 > 0xFFFFF:
+            # only server ids ride the 20-bit CONNECT-tag host field
+            # (relay hops are dialed directly, not packed)
             raise ValueError(
-                "socksclient proxy/server host ids exceed the 20-bit "
-                "CONNECT-tag field (max ~1M hosts)")
+                "socksclient server host ids exceed the 20-bit "
+                "CONNECT-tag field (max id 1048575)")
         # sizes round UP to the tag's 4 KiB units (never under-deliver)
         size_u4k = max(1, (int(kv.get("size", 51200)) + 4095) >> 12)
         if size_u4k > 0x1FF:
@@ -112,7 +114,10 @@ def compile_app(plugin: str, args: str, dns, num_hosts: int,
     if plugin == "socksproxy":
         cfg[1] = int(kv.get("port", 9050))
         cfg[2] = int(kv.get("server-port", 80))
-        # relay pool for multi-hop circuit extension (0,0 = none)
+        # relay pool for multi-hop circuit extension (0,0 = none).
+        # Chain extension dials the next relay on THIS relay's own
+        # port= value, so every relay in one pool must listen on the
+        # same port.
         cfg[3] = int(kv.get("relay-lo", 0))
         cfg[4] = int(kv.get("relay-hi", 0))
         return APP_SOCKS_PROXY, cfg

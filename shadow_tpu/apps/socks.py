@@ -164,7 +164,11 @@ def app_socks_proxy(row, hp, sh, now, wake):
         hops = (tag >> TAG_HOPS_SHIFT) & 0x3
         target = ((tag >> TAG_HOST_SHIFT) & TAG_HOST_MASK).astype(_I32)
         size = ((tag & TAG_U4K_MASK).astype(_I32) << 12)
-        has_pool = hp.app_cfg[4] > hp.app_cfg[3]
+        # a usable extension pool must offer a relay OTHER than this
+        # one (a pool of just ourselves would hairpin over loopback)
+        n_pool = hp.app_cfg[4] - hp.app_cfg[3]
+        self_in = ((hp.hid >= hp.app_cfg[3]) & (hp.hid < hp.app_cfg[4]))
+        has_pool = (n_pool > 1) | ((n_pool == 1) & ~self_in)
         extend = (hops > 0) & has_pool
         # a hops>0 CONNECT at a relay with no extension pool degrades
         # to a direct fetch — count it so the config mismatch is visible
@@ -176,6 +180,9 @@ def app_socks_proxy(row, hp, sh, now, wake):
             rr, nxt_relay = _rand_in(rr, hp, sh, hp.app_cfg[3],
                                      hp.app_cfg[4], skip_self=True)
             dst = jnp.where(extend, nxt_relay, target)
+            # NOTE: chain extension dials the next relay on THIS
+            # relay's own listen port — all relays in one pool must
+            # share their port= setting (see compile.py socksproxy)
             dport = jnp.where(extend, hp.app_cfg[1],
                               hp.app_cfg[2]).astype(_I32)
             otag = jnp.where(
