@@ -76,3 +76,26 @@ def test_multi_process_host_rejected(simple_topology_xml):
     )
     with pytest.raises(NotImplementedError, match="2 processes"):
         Simulation(scen)
+
+
+def test_engine_caps_cli_parsing(simple_topology_xml, tmp_path):
+    """--engine-caps overrides array capacities; malformed input gets a
+    clean argparse error, not a traceback."""
+    import pytest
+    from shadow_tpu.__main__ import main
+
+    cfgfile = tmp_path / "c.xml"
+    cfgfile.write_text(f"""<shadow stoptime="1">
+      <topology><![CDATA[{simple_topology_xml}]]></topology>
+      <host id="a"><process plugin="pingserver" starttime="0"
+          arguments="port=1"/></host>
+    </shadow>""")
+    # valid overrides run end to end
+    rc = main([str(cfgfile), "--engine-caps",
+               "qcap=32,scap=4,obcap=16,incap=32,chunk=8"])
+    assert rc == 0
+    # unknown key and non-integer value both exit via argparse
+    with pytest.raises(SystemExit):
+        main([str(cfgfile), "--engine-caps", "bogus=1"])
+    with pytest.raises(SystemExit):
+        main([str(cfgfile), "--engine-caps", "qcap=abc"])
