@@ -57,12 +57,16 @@ def draw(row, hp, sh):
     return row.replace(rng_ctr=row.rng_ctr + 1), u
 
 
-def schedule_wake(row, t, reason, sock=-1, aux=0):
-    """Push a future EV_APP (app timer) for this host."""
+def schedule_wake(row, t, reason, sock=-1, aux=0, wnd=0, ln=0):
+    """Push a future EV_APP (app timer) for this host. `wnd` and `ln`
+    ride the wake's WND/LEN words (socket generation + a small payload
+    — e.g. the tgen watchdog's progress mark)."""
     wake = jnp.zeros((P.PKT_WORDS,), jnp.int32)
     wake = rset(wake, P.ACK, jnp.int32(reason))
     wake = rset(wake, P.SEQ, jnp.int32(sock))
     wake = rset(wake, P.AUX, jnp.int32(aux))
+    wake = rset(wake, P.WND, jnp.int32(wnd))
+    wake = rset(wake, P.LEN, jnp.int32(ln))
     return equeue.q_push(row, t, EV_APP, wake)
 
 

@@ -8,7 +8,8 @@ fixed interval; every node relays a block the FIRST time it sees it to
 ``fanout`` uniformly random peers (UDP datagrams, the inv/announce
 role). Duplicate heights are ignored. Propagation latency needs no
 timestamp on the wire: height h was mined at
-``mine_start + (h - 1) * interval``, so each first sight contributes
+``mine_start + h * interval`` (the miner's first timer fires one
+interval after start), so each first sight contributes
 ``now - mined_at`` to the per-host latency accumulators
 (ST_RTT_SUM_US/ST_RTT_COUNT — summary()'s mean_rtt_us is the mean
 block-propagation delay).

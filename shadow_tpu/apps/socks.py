@@ -35,6 +35,8 @@ c7=pause ns | (hops << 56).
 Client registers: r0=socket, r1=fetches done, r2=fetch start time.
 Proxy config: c1=listen port, c2=server port, c3=relay_lo,
 c4=relay_hi (the pool for chain extension).
+Proxy registers: r0 = 1 + listener slot (0 = listen failed; pairs
+with a nonzero ST_SOCK_FAIL in the capacity report).
 """
 
 from __future__ import annotations
@@ -154,7 +156,10 @@ def app_socks_proxy(row, hp, sh, now, wake):
 
     def on_start(r):
         r, lslot, ok = tcp_listen(r, hp.app_cfg[1].astype(_I32))
-        return r
+        # record the listener (1+slot, 0 = failed) so a proxy whose
+        # listen failed (ST_SOCK_FAIL) is attributable from app_r
+        return r.replace(app_r=rset(
+            r.app_r, 0, jnp.where(ok, lslot + 1, 0).astype(_I64)))
 
     def on_accept(r):
         # SOCKS CONNECT: open the onward leg — to another relay while

@@ -31,8 +31,19 @@ across all hosts (state.Shared.tgen_*). Runtime (device side):
 the TCP stack with the request type+size carried on the SYN's APP word,
 exactly the role of tgen's command header on a real connection.
 
-``timeout``/``stallout`` attrs parse but are ignored for now (no
-transfer abort path yet).
+**Transfer timeout/stallout** (shd-tgen-transfer.c:9-11,918-961): every
+transfer carries a total-time limit (``timeout``, default 60s) and a
+no-progress limit (``stallout``, default 15s), settable per transfer
+node with graph-wide defaults on the start node. A per-transfer
+watchdog timer re-checks at stallout granularity (the reference checks
+from its 1s io heartbeat, tgenio_checkTimeouts): progress is the
+stream-offset sum of the transfer socket; a full stallout period with
+prior progress but none since, or age past the timeout, ABORTS the
+transfer — counted in ST_TGEN_ABORT, socket closed, and the walk
+continues through the node's out-edges exactly like a success
+(shd-tgen-driver.c:55-72 notifies completion with wasSuccess=FALSE and
+continues; failed transfers do not count toward end-node count/size
+conditions).
 """
 
 from __future__ import annotations
@@ -49,10 +60,11 @@ from ..core.rowops import radd, rget, rset
 from ..core.simtime import SIMTIME_ONE_SECOND
 from ..engine.defs import (WAKE_START, WAKE_TIMER, WAKE_SOCKET,
                            WAKE_CONNECTED, WAKE_EOF, WAKE_ACCEPT, WAKE_SENT,
-                           ST_XFER_DONE, ST_APP_DONE, ST_TGEN_DROP)
+                           ST_XFER_DONE, ST_APP_DONE, ST_TGEN_DROP,
+                           ST_TGEN_ABORT)
 from ..net import packet as P
 from ..net.tcp import tcp_connect, tcp_listen, tcp_write, tcp_close_call
-from .base import draw, timer
+from .base import draw, timer, schedule_wake
 
 # --- node table encoding (Shared.tgen_nodes: int64 [N, 10]) ---
 # [kind, a, b, c, next, peers_off, n_peers, sync_ref, edge_off, edge_cnt]
@@ -60,7 +72,8 @@ from .base import draw, timer
 # (tests walk it); the device walk routes ONLY through the edge pool
 # (edge_off/edge_cnt -> Shared.tgen_edges).
 NK_START = 0      # a=serverport, b=initial delay ns
-NK_TRANSFER = 1   # a=type (0 get, 1 put), b=size bytes
+NK_TRANSFER = 1   # a=type (0 get, 1 put), b=size bytes,
+#                   c=timeout ns, sync_ref=stallout ns
 NK_PAUSE = 2      # a=fixed time ns (or -1: draw from pool[b:b+c])
 NK_END = 3        # a=count limit, b=time-limit ns, c=size-limit bytes
 NK_SYNC = 4       # a=indegree (arrivals required), sync_ref=counter slot
@@ -82,6 +95,15 @@ REG_DONE = 5
 # transfer request tag riding the SYN (31 usable bits)
 TAG_PUT = 1 << 30
 TAG_SIZE_MASK = (1 << 30) - 1
+
+# transfer abort limits (shd-tgen-transfer.c:9-11); 0/unset in the
+# graph falls back to these, exactly like the reference
+DEFAULT_XFER_TIMEOUT_NS = 60 * SIMTIME_ONE_SECOND
+DEFAULT_XFER_STALLOUT_NS = 15 * SIMTIME_ONE_SECOND
+
+# watchdog timer wake: AUX sentinel (distinct from the walk
+# continuations, which use aux >= 0 / small negative retry encodings)
+WD_AUX = -(1 << 20)
 
 _SIZE_RE = re.compile(r"^\s*([0-9.]+)\s*([a-zA-Z]*)\s*$")
 _SIZE_UNITS = {
@@ -201,6 +223,21 @@ def compile_tgen_graph(source: str, dns, tab: TgenTables) -> int:
         raise ValueError(f"tgen node id {nid!r} names no known action")
 
     default_peers = None
+    # graph-wide abort limits from the start node (pre-scanned: file
+    # order does not guarantee start first); the reference's fallback
+    # chain is transfer attr -> start attr -> built-in default
+    # (shd-tgen-action.c:476-487,810, shd-tgen-transfer.c:972-973)
+    default_timeout = DEFAULT_XFER_TIMEOUT_NS
+    default_stallout = DEFAULT_XFER_STALLOUT_NS
+    for nid in order:
+        if action_of(nid) == "start":
+            a = raw[nid]
+            if a.get("timeout"):
+                default_timeout = (_parse_tgen_seconds(a["timeout"])
+                                   or DEFAULT_XFER_TIMEOUT_NS)
+            if a.get("stallout"):
+                default_stallout = (_parse_tgen_seconds(a["stallout"])
+                                    or DEFAULT_XFER_STALLOUT_NS)
     rows = []
     for nid in order:
         a = raw[nid]
@@ -235,8 +272,12 @@ def compile_tgen_graph(source: str, dns, tab: TgenTables) -> int:
                 raise ValueError(
                     f"tgen transfer node {nid!r} has no peers (set a "
                     "'peers' attr on it or on the start node)")
-            row = [NK_TRANSFER, ttype, size, 0, nxt, poff, pcnt, 0, eoff,
-                   ecnt]
+            tmo = (_parse_tgen_seconds(a["timeout"]) if a.get("timeout")
+                   else 0) or default_timeout
+            stl = (_parse_tgen_seconds(a["stallout"]) if a.get("stallout")
+                   else 0) or default_stallout
+            row = [NK_TRANSFER, ttype, size, tmo, nxt, poff, pcnt, stl,
+                   eoff, ecnt]
         elif act == "pause":
             t = a.get("time", "1")
             if "," in t:
@@ -364,12 +405,18 @@ def _exec_node(row, hp, sh, now, cur):
         tag = (size | jnp.where(ttype == 1, TAG_PUT, 0)).astype(_I32)
         r, slot, ok = tcp_connect(r, hp, sh, now, dst_host=peer_host,
                                   dst_port=peer_port, tag=tag)
+
         # client sockets remember their owning behavior node, so any
         # number of transfers (parallel walk branches) can be in flight
+        def connected(rr):
+            rr = rr.replace(
+                sk_app_ref=rset(rr.sk_app_ref, slot, cur.astype(_I32)))
+            # arm the timeout/stallout watchdog (limits in the node row)
+            return _wd_arm(rr, now, slot, jnp.zeros((), _I64),
+                           nd[COL_C], nd[COL_REF])
+
         r = jax.lax.cond(
-            ok,
-            lambda rr: rr.replace(
-                sk_app_ref=rset(rr.sk_app_ref, slot, cur.astype(_I32))),
+            ok, connected,
             # connect failure (socket table full): retry the transfer
             # after a 1s backoff instead of losing the walk branch
             # (negative timer aux = re-enter the node itself)
@@ -500,6 +547,34 @@ def _walk_succ(row, hp, sh, now, node):
     return _walk(row, hp, sh, now, stack, sp)
 
 
+def _wd_arm(row, now, slot, mark, timeout_ns, stallout_ns):
+    """Arm/re-arm the transfer watchdog for client socket `slot`: next
+    check at one stallout period out, clipped to the absolute timeout
+    instant (so timeouts abort exactly on time while stall checks keep
+    full-period spacing — any earlier fire IS the timeout instant).
+    `mark` (the progress metric at arm time) rides the wake's LEN word;
+    the slot generation rides WND so recycled slots ignore stale
+    watchdogs."""
+    gen = rget(row.sk_timer_gen, slot)
+    start = rget(row.sk_hs_time, slot)
+    t_next = jnp.minimum(now + stallout_ns, start + timeout_ns)
+    t_next = jnp.maximum(t_next, now + 1)
+    return schedule_wake(row, t_next, WAKE_TIMER, sock=slot, aux=WD_AUX,
+                         wnd=gen, ln=mark)
+
+
+def _abort_transfer(row, hp, sh, now, sock, node):
+    """Timeout/stallout hit: count it, tear the socket down, and walk
+    on from the owning node WITHOUT success accounting (the reference
+    notifies wasSuccess=FALSE and continues the graph walk,
+    shd-tgen-driver.c:55-72)."""
+    row = row.replace(
+        sk_app_ref=rset(row.sk_app_ref, sock, -1),
+        stats=radd(row.stats, ST_TGEN_ABORT, 1))
+    row = tcp_close_call(row, now, sock)
+    return _walk_succ(row, hp, sh, now, node)
+
+
 def _finish_transfer(row, hp, sh, now, sock):
     """A transfer completed on client socket `sock`: account it and walk
     on from its owning node."""
@@ -536,10 +611,36 @@ def app_tgen(row, hp, sh, now, wake):
 
     def on_timer(r):
         aux = wake[P.AUX]
-        return jax.lax.cond(
-            aux >= 0,
-            lambda rr: _walk_succ(rr, hp, sh, now, aux),
-            lambda rr: _walk_enter(rr, hp, sh, now, -aux - 1), r)
+
+        def wd(rr):
+            # transfer watchdog (module docstring): the wake carries
+            # the progress mark (LEN) and slot generation (WND)
+            node = rget(rr.sk_app_ref, slot)
+            live = fresh & (node >= 0) & rget(rr.sk_used, slot)
+            nd = _node(sh, jnp.maximum(node, 0).astype(_I32))
+            metric = (rget(rr.sk_rcv_nxt, slot) +
+                      rget(rr.sk_snd_una, slot))
+            mark = wake[P.LEN].astype(_I64)
+            took = now >= rget(rr.sk_hs_time, slot) + nd[COL_C]
+            stalled = (metric == mark) & (metric > 0)
+
+            def rearm(r2):
+                return _wd_arm(r2, now, slot, metric, nd[COL_C],
+                               nd[COL_REF])
+
+            return jax.lax.cond(
+                live & (took | stalled),
+                lambda r2: _abort_transfer(r2, hp, sh, now, slot, node),
+                lambda r2: jax.lax.cond(live, rearm, lambda r3: r3, r2),
+                rr)
+
+        def walk(rr):
+            return jax.lax.cond(
+                aux >= 0,
+                lambda r2: _walk_succ(r2, hp, sh, now, aux),
+                lambda r2: _walk_enter(r2, hp, sh, now, -aux - 1), rr)
+
+        return jax.lax.cond(aux == WD_AUX, wd, walk, r)
 
     def on_connected(r):
         # our client socket connected; PUT writes now, GET just waits

@@ -45,4 +45,9 @@ ST_TXQ_DROP = 16       # dropped: NIC transmit ring full (sndbuf overflow)
 ST_TGEN_DROP = 17      # tgen walk forks lost to cursor-stack overflow
 ST_CHAIN_SHORT = 18    # socks circuits shortened: relay had no pool to
 #                        extend a hops>0 CONNECT (config mismatch)
-N_STATS = 19
+ST_SACK_RENEGE = 19    # receiver OOO scoreboard overflow discarded a
+#                        range possibly already advertised (stall ends
+#                        at the RTO; see net/sack.py insert_counted)
+ST_TGEN_ABORT = 20     # tgen transfers aborted by timeout/stallout
+#                        (shd-tgen-transfer.c:918-961 semantics)
+N_STATS = 21

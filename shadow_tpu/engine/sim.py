@@ -97,7 +97,9 @@ class SimReport:
             "drop_q": self.total(defs.ST_PKTS_DROP_Q),
             "bytes_recv": self.total(defs.ST_BYTES_RECV),
             "retransmits": self.total(defs.ST_RETRANSMIT),
+            "sack_reneges": self.total(defs.ST_SACK_RENEGE),
             "transfers_done": self.total(defs.ST_XFER_DONE),
+            "transfers_aborted": self.total(defs.ST_TGEN_ABORT),
             "mean_rtt_us": mean_rtt_us,
         }
 
@@ -257,6 +259,22 @@ class Simulation:
                     hosted_specs.append(
                         (idx, name, proc.plugin[len("hosted:"):],
                          proc.arguments))
+        # gossip relay draws target uniformly random ids in [0, n);
+        # in a mixed scenario any non-gossip id inside that range eats
+        # its datagrams silently — validate here, where the whole
+        # scenario is visible (apps/compile.py only sees one process).
+        from ..apps.base import APP_GOSSIP as _APP_GOSSIP
+        gossip_mask = (app_kind == _APP_GOSSIP) & has_app
+        if gossip_mask.any():
+            n_draw = int(app_cfg[gossip_mask, 0].max())
+            bad = int((~gossip_mask[:n_draw]).sum())
+            if bad:
+                import sys as _sys
+                _sys.stderr.write(
+                    f"shadow_tpu: warning: gossip peer range n={n_draw} "
+                    f"covers {bad} non-gossip host id(s); their relay "
+                    "datagrams are silently dropped — pass an explicit "
+                    "n= and put the gossip hosts first\n")
         tg_nodes, tg_peers, tg_pool, tg_edges = tgen_tables.arrays()
         if tgen_tables.sync_slots > self.cfg.synccap:
             import dataclasses as _dc
@@ -528,8 +546,14 @@ class Simulation:
                     tr_cnt=jnp.zeros_like(hosts.tr_cnt))
             if tracker is not None and tracker.due(min(ws,
                                                        int(sh.stop_time))):
-                tracker.maybe_heartbeat(min(ws, int(sh.stop_time)),
-                                        dist.gather_stats(hosts.stats)[:H])
+                from ..obs.tracker import socket_columns
+                # [socket]/[ram] columns are per-process state; under a
+                # multi-process mesh only the stats all-gather exists,
+                # so those families are single-process only
+                tracker.maybe_heartbeat(
+                    min(ws, int(sh.stop_time)),
+                    dist.gather_stats(hosts.stats)[:H],
+                    socks=None if multiproc else socket_columns(hosts))
             if checkpoint_path and ckpt_at is not None and ws >= ckpt_at:
                 ckpt.save(checkpoint_path, hosts, ws, int(wend),
                           total_windows, fingerprint)

@@ -60,6 +60,16 @@ def insert(s, e, ns, ne):
     HIGHEST range is discarded — the least urgent for recovery; its
     bytes are simply no longer advertised/recorded and will be
     retransmitted if lost. Returns (s, e)."""
+    return insert_counted(s, e, ns, ne)[:2]
+
+
+def insert_counted(s, e, ns, ne):
+    """:func:`insert` that also reports the overflow: returns
+    (s, e, dropped) with dropped = 1 when a valid range was discarded
+    by the K-truncation. On the receiver side a dropped range may
+    already have been advertised to the peer (a SACK renege) — the
+    resulting stall is a silent RTO wait, so callers count it
+    (ST_SACK_RENEGE) to make it diagnosable."""
     valid = s >= 0
     new_ok = ne > ns
     ov = valid & new_ok & (ns <= e) & (ne >= s)
@@ -73,7 +83,9 @@ def insert(s, e, ns, ne):
                           jnp.where(new_ok, me, -1)[None]])
     key = jnp.where(cs < 0, _INF, cs)
     order = jnp.argsort(key)
-    return cs[order][:K], ce[order][:K]
+    cs, ce = cs[order], ce[order]
+    dropped = (cs[K] >= 0).astype(jnp.int32)
+    return cs[:K], ce[:K], dropped
 
 
 def consume(s, e, rcv):

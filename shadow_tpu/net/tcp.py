@@ -16,9 +16,11 @@ branch-masked row-level kernels instead of per-connection callbacks:
   1068-1128) becomes go-back-N from snd_una driven by one outstanding
   EV_TCP_TIMER per socket with a desired-deadline re-check, mirroring
   the reference's desiredTimerExpiration pattern (shd-tcp.c:1091-1100);
-- dupack-counting fast retransmit stands in for the SACK scoreboard
-  (shd-tcp-scoreboard.c) — the receiver drops out-of-order segments and
-  acks every arrival, so cumulative-ack recovery is exact go-back-N;
+- loss recovery carries the SACK scoreboard (net.sack, mirroring
+  shd-tcp-scoreboard.c): the receiver buffers out-of-order runs into a
+  K-range scoreboard advertised on every ACK, and dupack-triggered fast
+  retransmit resends only bytes inferably lost below the highest sacked
+  run; scoreboard overflow degrades (counted) to go-back-N at RTO;
 - congestion control is the pluggable aimd/reno/cubic family
   (net.congestion), entered via the same avoidance/packetLoss seams as
   the reference (shd-tcp.c:1809,1063-1064);
@@ -43,7 +45,7 @@ from ..engine.defs import (EV_APP, EV_TCP_TIMER, EV_TCP_CLOSE,
                            WAKE_CONNECTED, WAKE_ACCEPT, WAKE_SOCKET,
                            WAKE_EOF, WAKE_SENT,
                            ST_BYTES_RECV, ST_BYTES_SENT, ST_RETRANSMIT,
-                           ST_SOCK_FAIL)
+                           ST_SOCK_FAIL, ST_SACK_RENEGE)
 from . import congestion as CC
 from . import nic
 from . import packet as P
@@ -615,9 +617,10 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
     oos1, ooe1, rcv1 = sack.consume(oos0, ooe0, adv)
 
     is_ooo = has_data & (seq > rcv1)
-    oos2, ooe2 = sack.insert(oos1, ooe1,
-                             jnp.where(is_ooo, seq, -1),
-                             jnp.where(is_ooo, seg_end, -2))
+    oos2, ooe2, reneged = sack.insert_counted(
+        oos1, ooe1,
+        jnp.where(is_ooo, seq, -1),
+        jnp.where(is_ooo, seg_end, -2))
 
     delivered = rcv1 - rcv0
     row = _set(row, slot,
@@ -626,7 +629,9 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
                sk_ooo_e=ooe2,
                sk_ctl=rget(row.sk_ctl, slot) |
                jnp.where((ln > 0) | fin, CTL_ACKNOW, 0))
-    row = row.replace(stats=radd(row.stats, ST_BYTES_RECV, delivered))
+    row = row.replace(stats=radd(
+        radd(row.stats, ST_BYTES_RECV, delivered),
+        ST_SACK_RENEGE, reneged.astype(jnp.int64)))
     row = jax.lax.cond(
         delivered > 0,
         lambda r: _wake(r, now, WAKE_SOCKET, slot, pkt=pkt,

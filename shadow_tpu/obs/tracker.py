@@ -8,6 +8,28 @@ every metric as device-side counters (Hosts.stats); the tracker drains
 them at window-chunk boundaries, computes interval deltas, and emits the
 same style of lines — no device-side cost beyond the stats the engine
 maintains anyway.
+
+Line families (mirroring shd-tracker.c):
+
+- ``[node]``   per-host interval deltas of the engine counters
+  (shd-tracker.c:405-447's per-interval counter deltas).
+- ``[socket]`` per-host, ``|``-joined per-socket segments
+  ``slot,proto,peer:port;inbuflen,inbufsize,outbuflen,outbufsize;``
+  ``recv-bytes,send-bytes`` (shd-tracker.c:449-537). Buffer fill maps
+  to the offset model: out fill = written-but-unacked bytes
+  (snd_end - snd_una), in fill = out-of-order bytes held in the
+  receive scoreboard; recv/send byte totals are the stream offsets.
+- ``[ram]``    per-host ``alloc,dealloc,total,sockets`` where total is
+  the modeled buffered bytes (the engine has no malloc to track —
+  shd-tracker.c:539-546's allocated-RAM role is carried by buffer
+  occupancy) and alloc/dealloc are the interval's growth/release.
+- ``[summary]`` slave-level getrusage roll-up (shd-slave.c:374-395).
+
+Sampling note: stats are only observable at window-chunk boundaries,
+so when several intervals elapse within one chunk the tracker emits
+ONE heartbeat at the last elapsed boundary covering the whole span
+(the interval column carries the true span seconds) instead of one
+real delta followed by empty duplicates.
 """
 
 from __future__ import annotations
@@ -32,6 +54,7 @@ class Tracker:
         self.per_host = per_host
         self.next_ns = self.interval
         self._prev = None
+        self._prev_ram = None
         self.lines = []          # retained for tools/tests
 
     def _emit(self, line: str):
@@ -45,43 +68,130 @@ class Tracker:
         multi-process mesh) when no interval boundary has passed."""
         return self.interval > 0 and sim_ns >= self.next_ns
 
-    def maybe_heartbeat(self, sim_ns: int, stats: np.ndarray):
+    def maybe_heartbeat(self, sim_ns: int, stats: np.ndarray,
+                        socks: dict | None = None):
         """Called after each window chunk with current cumulative stats;
-        emits one heartbeat per elapsed interval boundary."""
-        if self.interval <= 0:
+        emits one heartbeat covering all interval boundaries elapsed
+        since the last call (see module docstring on sampling).
+
+        socks: optional dict of per-socket numpy columns (sk_used,
+        sk_proto, sk_rhost, sk_rport, sk_snd_una, sk_snd_end,
+        sk_sndbuf, sk_rcv_nxt, sk_rcvbuf, ooo_held) enabling the
+        [socket] and [ram] line families.
+        """
+        if self.interval <= 0 or sim_ns < self.next_ns:
             return
-        while sim_ns >= self.next_ns:
-            cur = stats.astype(np.int64)
-            prev = (self._prev if self._prev is not None
-                    else np.zeros_like(cur))
-            d = cur - prev
-            self._prev = cur.copy()
-            t = self.next_ns // 10**9
+        # collapse all elapsed boundaries into one emission at the last
+        elapsed = (sim_ns - self.next_ns) // self.interval + 1
+        self.next_ns += (elapsed - 1) * self.interval
+        # true covered span in seconds ("%g": sub-second intervals must
+        # not truncate to 0 — consumers compute rates as delta/interval)
+        span_s = f"{elapsed * self.interval / 1e9:g}"
 
-            if self.per_host:
-                for i, name in enumerate(self.names):
-                    if d[i, defs.ST_EVENTS] == 0:
-                        continue
-                    self._emit(
-                        f"[shadow-heartbeat] [node] {t},{name},"
-                        f"{d[i, defs.ST_EVENTS]},"
-                        f"{d[i, defs.ST_PKTS_SENT]},"
-                        f"{d[i, defs.ST_PKTS_RECV]},"
-                        f"{d[i, defs.ST_BYTES_SENT]},"
-                        f"{d[i, defs.ST_BYTES_RECV]},"
-                        f"{d[i, defs.ST_RETRANSMIT]},"
-                        f"{d[i, defs.ST_PKTS_DROP_NET]},"
-                        f"{d[i, defs.ST_PKTS_DROP_BUF]},"
-                        f"{d[i, defs.ST_XFER_DONE]}")
+        cur = stats.astype(np.int64)
+        prev = (self._prev if self._prev is not None
+                else np.zeros_like(cur))
+        d = cur - prev
+        self._prev = cur.copy()
+        t = self.next_ns // 10**9
 
-            ru = resource.getrusage(resource.RUSAGE_SELF)
-            tot = d.sum(axis=0)
-            self._emit(
-                f"[shadow-heartbeat] [summary] {t},"
-                f"events={tot[defs.ST_EVENTS]},"
-                f"pkts={tot[defs.ST_PKTS_SENT]}/{tot[defs.ST_PKTS_RECV]},"
-                f"bytes={tot[defs.ST_BYTES_SENT]}/{tot[defs.ST_BYTES_RECV]},"
-                f"maxrss-gib={ru.ru_maxrss / (1 << 20):.3f},"
-                f"utime-min={ru.ru_utime / 60:.3f},"
-                f"stime-min={ru.ru_stime / 60:.3f}")
-            self.next_ns += self.interval
+        if self.per_host:
+            for i, name in enumerate(self.names):
+                if d[i, defs.ST_EVENTS] == 0:
+                    continue
+                self._emit(
+                    f"[shadow-heartbeat] [node] {t},{name},"
+                    f"{d[i, defs.ST_EVENTS]},"
+                    f"{d[i, defs.ST_PKTS_SENT]},"
+                    f"{d[i, defs.ST_PKTS_RECV]},"
+                    f"{d[i, defs.ST_BYTES_SENT]},"
+                    f"{d[i, defs.ST_BYTES_RECV]},"
+                    f"{d[i, defs.ST_RETRANSMIT]},"
+                    f"{d[i, defs.ST_PKTS_DROP_NET]},"
+                    f"{d[i, defs.ST_PKTS_DROP_BUF]},"
+                    f"{d[i, defs.ST_XFER_DONE]}")
+        if socks is not None:
+            self._heartbeat_sockets(t, span_s, socks)
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        tot = d.sum(axis=0)
+        self._emit(
+            f"[shadow-heartbeat] [summary] {t},"
+            f"interval={span_s},"
+            f"events={tot[defs.ST_EVENTS]},"
+            f"pkts={tot[defs.ST_PKTS_SENT]}/{tot[defs.ST_PKTS_RECV]},"
+            f"bytes={tot[defs.ST_BYTES_SENT]}/{tot[defs.ST_BYTES_RECV]},"
+            f"maxrss-gib={ru.ru_maxrss / (1 << 20):.3f},"
+            f"utime-min={ru.ru_utime / 60:.3f},"
+            f"stime-min={ru.ru_stime / 60:.3f}")
+        self.next_ns += self.interval
+
+    def _heartbeat_sockets(self, t: int, span_s: str, socks: dict):
+        used = socks["sk_used"]
+        proto = socks["sk_proto"]
+        is_tcp = proto == 6
+        # buffer fill is a TCP notion here: UDP datagrams leave the
+        # socket at txq-push (snd_una never advances for UDP, so
+        # snd_end - snd_una would read as an ever-growing "leak")
+        out_fill = np.where(
+            is_tcp,
+            np.maximum(socks["sk_snd_end"] - socks["sk_snd_una"], 0), 0)
+        in_fill = socks["ooo_held"]
+        # cumulative send-bytes: acked stream offset for TCP, datagram
+        # bytes handed to the NIC for UDP
+        sent_bytes = np.where(is_tcp, socks["sk_snd_una"],
+                              socks["sk_snd_end"])
+        # modeled RAM per host: all buffered bytes across sockets
+        ram_total = (np.where(used, out_fill + in_fill, 0)).sum(axis=1)
+        prev_ram = (self._prev_ram if self._prev_ram is not None
+                    else np.zeros_like(ram_total))
+        ram_delta = ram_total - prev_ram
+        self._prev_ram = ram_total.copy()
+
+        for i, name in enumerate(self.names):
+            (slots,) = np.nonzero(used[i])
+            if slots.size:
+                segs = []
+                for s in slots:
+                    pname = "tcp" if proto[i, s] == 6 else "udp"
+                    rh = int(socks["sk_rhost"][i, s])
+                    peer = (f"{self.names[rh]}:{int(socks['sk_rport'][i, s])}"
+                            if 0 <= rh < len(self.names) else "-:0")
+                    segs.append(
+                        f"{int(s)},{pname},{peer};"
+                        f"{int(in_fill[i, s])},"
+                        f"{int(socks['sk_rcvbuf'][i, s])},"
+                        f"{int(out_fill[i, s])},"
+                        f"{int(socks['sk_sndbuf'][i, s])};"
+                        f"{int(socks['sk_rcv_nxt'][i, s])},"
+                        f"{int(sent_bytes[i, s])}")
+                self._emit(f"[shadow-heartbeat] [socket] {t},{name},"
+                           + "|".join(segs))
+            if ram_total[i] or ram_delta[i]:
+                alloc = max(int(ram_delta[i]), 0)
+                dealloc = max(-int(ram_delta[i]), 0)
+                self._emit(
+                    f"[shadow-heartbeat] [ram] {t},{name},"
+                    f"{alloc},{dealloc},{int(ram_total[i])},"
+                    f"{int(used[i].sum())}")
+
+
+def socket_columns(hosts) -> dict:
+    """Extract the tracker's per-socket columns from device state as
+    numpy arrays (one transfer per heartbeat, not per window)."""
+    ooo_held = np.maximum(
+        np.where(np.asarray(hosts.sk_ooo_s) >= 0,
+                 np.asarray(hosts.sk_ooo_e) - np.asarray(hosts.sk_ooo_s),
+                 0), 0).sum(axis=-1)
+    return {
+        "sk_used": np.asarray(hosts.sk_used),
+        "sk_proto": np.asarray(hosts.sk_proto),
+        "sk_rhost": np.asarray(hosts.sk_rhost),
+        "sk_rport": np.asarray(hosts.sk_rport),
+        "sk_snd_una": np.asarray(hosts.sk_snd_una),
+        "sk_snd_end": np.asarray(hosts.sk_snd_end),
+        "sk_sndbuf": np.asarray(hosts.sk_sndbuf),
+        "sk_rcv_nxt": np.asarray(hosts.sk_rcv_nxt),
+        "sk_rcvbuf": np.asarray(hosts.sk_rcvbuf),
+        "ooo_held": ooo_held,
+    }

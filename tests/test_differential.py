@@ -82,3 +82,95 @@ def test_differential_phold_lossy():
         )
 
     _diff(scen, 12)
+
+
+# --- TCP tier (the reference's tcp test matrix idea: same scenario,
+# lossless AND lossy, both engines must agree bit for bit — the dual
+# run applied to the hard path: handshake, windows, SACK recovery,
+# RTO go-back-N, cubic, close) --------------------------------------------
+
+TCP_COMPARE = COMPARE + [defs.ST_BYTES_SENT, defs.ST_RETRANSMIT,
+                         defs.ST_SACK_RENEGE, defs.ST_TGEN_DROP,
+                         defs.ST_TGEN_ABORT]
+
+
+def _diff_tcp(scenario_fn, n_hosts, cfg=None):
+    cfg = dict(CFG) if cfg is None else cfg
+    jax_stats = Simulation(scenario_fn(),
+                           engine_cfg=EngineConfig(num_hosts=n_hosts,
+                                                   **cfg)).run().stats
+    py_stats = PyEngine(Simulation(scenario_fn(),
+                                   engine_cfg=EngineConfig(
+                                       num_hosts=n_hosts, **cfg))).run()
+    for st in TCP_COMPARE:
+        assert np.array_equal(jax_stats[:, st], py_stats[:, st]), (
+            f"stat {st} diverges:\n jax={jax_stats[:, st]}\n "
+            f"py={py_stats[:, st]}")
+    return jax_stats
+
+
+def _bulk_scen(loss, size, count, clients=1, stop=60):
+    from test_tcp import poi_topology
+
+    def scen():
+        return Scenario(
+            stop_time=stop * 10**9,
+            topology_graphml=poi_topology(loss=loss),
+            hosts=[
+                HostSpec(id="server", processes=[
+                    ProcessSpec(plugin="bulkserver", start_time=10**9,
+                                arguments="port=80")]),
+                HostSpec(id="client", quantity=clients, processes=[
+                    ProcessSpec(plugin="bulk", start_time=2 * 10**9,
+                                arguments=f"peer=server port=80 "
+                                          f"size={size} count={count} "
+                                          f"pause=1s")]),
+            ],
+        )
+
+    return scen
+
+
+def test_differential_tcp_lossless():
+    stats = _diff_tcp(_bulk_scen(loss=0.0, size=120_000, count=2), 2)
+    assert stats[:, defs.ST_XFER_DONE].sum() == 4   # both ends, 2 xfers
+
+
+def test_differential_tcp_lossy():
+    """5% loss: handshake retries, SACK fast recovery, RTO go-back-N,
+    FIN retransmission — all must agree bit for bit."""
+    stats = _diff_tcp(_bulk_scen(loss=0.05, size=120_000, count=2,
+                                 stop=90), 2)
+    assert stats[:, defs.ST_RETRANSMIT].sum() > 0   # loss actually bit
+
+
+def test_differential_tgen_web(simple_topology_xml):
+    """tgen behavior graph (GET walk + pauses) over a lossy link: the
+    walk machinery, transfer tags, watchdogs and server children agree
+    across engines."""
+    from test_tgen import SERVER_GRAPH, WEB_GRAPH
+
+    lossy = simple_topology_xml.replace('<data key="d9">0.0</data>',
+                                        '<data key="d9">0.03</data>')
+
+    def scen():
+        return Scenario(
+            stop_time=40 * 10**9,
+            topology_graphml=lossy,
+            hosts=[
+                HostSpec(id="server1", processes=[
+                    ProcessSpec(plugin="tgen", start_time=10**9,
+                                arguments=SERVER_GRAPH)]),
+                HostSpec(id="server2", processes=[
+                    ProcessSpec(plugin="tgen", start_time=10**9,
+                                arguments=SERVER_GRAPH)]),
+                HostSpec(id="web", quantity=2, processes=[
+                    ProcessSpec(plugin="tgen", start_time=2 * 10**9,
+                                arguments=WEB_GRAPH)]),
+            ],
+        )
+
+    stats = _diff_tcp(scen, 4, cfg=dict(qcap=24, scap=6, obcap=12,
+                                        incap=16, txqcap=8,
+                                        chunk_windows=8))
+    assert stats[2:, defs.ST_XFER_DONE].sum() > 0

@@ -245,3 +245,166 @@ def test_tgen_nonblocking_cycle_rejected():
     tab = TgenTables()
     with pytest.raises(ValueError, match="cycle never blocks"):
         tab.compile(bad, dns)
+
+
+# --- transfer timeout / stallout (shd-tgen-transfer.c:918-961) -------------
+
+# client whose first GET targets a host with no listener: nothing ever
+# answers the SYN, so only the watchdog timeout can unstick the walk;
+# the second GET targets a live server and must still complete
+TIMEOUT_GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="count" attr.type="string" for="node" id="d6" />
+  <key attr.name="size" attr.type="string" for="node" id="d5" />
+  <key attr.name="type" attr.type="string" for="node" id="d4" />
+  <key attr.name="timeout" attr.type="string" for="node" id="d2" />
+  <key attr.name="peers" attr.type="string" for="node" id="d0" />
+  <graph edgedefault="directed">
+    <node id="start">
+      <data key="d0">dead:30080</data>
+    </node>
+    <node id="transfer1">
+      <data key="d4">get</data><data key="d5">10 KiB</data>
+      <data key="d2">2</data>
+    </node>
+    <node id="transfer2">
+      <data key="d4">get</data><data key="d5">10 KiB</data>
+      <data key="d0">server1:30080</data>
+    </node>
+    <node id="end"><data key="d6">1</data></node>
+    <edge source="start" target="transfer1" />
+    <edge source="transfer1" target="transfer2" />
+    <edge source="transfer2" target="end" />
+  </graph>
+</graphml>"""
+
+
+def test_tgen_timeout_parse(simple_topology_xml):
+    """timeout/stallout compile into the transfer node row, with the
+    reference's defaults when unset (shd-tgen-transfer.c:9-11)."""
+    from shadow_tpu.apps.tgen import (COL_C, COL_REF,
+                                      DEFAULT_XFER_TIMEOUT_NS,
+                                      DEFAULT_XFER_STALLOUT_NS)
+    from shadow_tpu.routing.dns import DNS
+    dns = DNS()
+    dns.register(0, "server1", None)
+    dns.register(1, "dead", None)
+    tab = TgenTables()
+    tab.compile(TIMEOUT_GRAPH, dns)
+    nodes, _, _, _ = tab.arrays()
+    xfers = nodes[nodes[:, COL_KIND] == NK_TRANSFER]
+    assert set(xfers[:, COL_C].tolist()) == {2 * 10**9,
+                                            DEFAULT_XFER_TIMEOUT_NS}
+    assert (xfers[:, COL_REF] == DEFAULT_XFER_STALLOUT_NS).all()
+
+
+def test_tgen_timeout_aborts_and_walk_continues(simple_topology_xml):
+    """A GET whose peer never answers aborts at its 2s timeout (counted
+    in ST_TGEN_ABORT), and the walk proceeds to the next transfer,
+    which completes (the reference's wasSuccess=FALSE notify +
+    continueNextActions, shd-tgen-driver.c:55-72)."""
+    scen = Scenario(
+        stop_time=30 * 10**9,
+        topology_graphml=simple_topology_xml,
+        hosts=[
+            HostSpec(id="server1", processes=[
+                ProcessSpec(plugin="tgen", start_time=10**9,
+                            arguments=SERVER_GRAPH)]),
+            HostSpec(id="dead"),   # attached, resolvable, no listener
+            HostSpec(id="client", processes=[
+                ProcessSpec(plugin="tgen", start_time=2 * 10**9,
+                            arguments=TIMEOUT_GRAPH)]),
+        ],
+    )
+    report = Simulation(scen).run()
+    stats = report.stats
+    cli = 2
+    assert stats[cli, defs.ST_TGEN_ABORT] == 1
+    assert stats[cli, defs.ST_XFER_DONE] == 1       # only transfer2
+    # the abort did NOT count toward the end condition; the successful
+    # transfer2 did, so the walk ends with exactly count=1
+    assert stats[cli, defs.ST_APP_DONE] == 1
+    assert report.summary()["transfers_aborted"] == 1
+    # the client actually received transfer2's payload
+    assert stats[cli, defs.ST_BYTES_RECV] >= 10 * 1024
+
+
+def test_tgen_stallout_unit(simple_topology_xml):
+    """Row-level watchdog check: same progress mark across a full
+    stallout period aborts (reference stall rule lastProgress > 0 &&
+    now >= lastProgress + stallout); advancing progress re-arms."""
+    import jax
+    import jax.numpy as jnp
+    from shadow_tpu.apps.tgen import app_tgen, WD_AUX, COL_C, COL_REF
+    from shadow_tpu.engine.defs import WAKE_TIMER
+    from shadow_tpu.net import packet as P
+    from shadow_tpu.net.socket import TCPS_ESTABLISHED
+    from shadow_tpu.core.simtime import SIMTIME_MAX
+
+    scen = Scenario(
+        stop_time=30 * 10**9,
+        topology_graphml=simple_topology_xml,
+        hosts=[
+            HostSpec(id="server1", processes=[
+                ProcessSpec(plugin="tgen", start_time=10**9,
+                            arguments=SERVER_GRAPH)]),
+            HostSpec(id="dead"),
+            HostSpec(id="client", processes=[
+                ProcessSpec(plugin="tgen", start_time=2 * 10**9,
+                            arguments=TIMEOUT_GRAPH)]),
+        ],
+    )
+    sim = Simulation(scen)
+    sh = sim.sh
+    nodes = np.asarray(sh.tgen_nodes)
+    # the long-timeout transfer node (transfer2: default 60s timeout)
+    node = int(np.nonzero((nodes[:, COL_KIND] == NK_TRANSFER) &
+                          (nodes[:, COL_C] == 60 * 10**9))[0][0])
+    cli = 2
+    row = jax.tree.map(lambda x: x[cli], sim.hosts)
+    hpr = jax.tree.map(lambda x: x[cli], sim.hp)
+    slot = 0
+    row = row.replace(
+        sk_used=row.sk_used.at[slot].set(True),
+        sk_proto=row.sk_proto.at[slot].set(P.PROTO_TCP),
+        sk_state=row.sk_state.at[slot].set(TCPS_ESTABLISHED),
+        sk_app_ref=row.sk_app_ref.at[slot].set(node),
+        sk_rcv_nxt=row.sk_rcv_nxt.at[slot].set(5000),
+        sk_hs_time=row.sk_hs_time.at[slot].set(10**9),
+    )
+    gen = int(row.sk_timer_gen[slot])
+
+    def wd_wake(mark):
+        w = np.zeros(P.PKT_WORDS, np.int32)
+        w[P.ACK] = WAKE_TIMER
+        w[P.SEQ] = slot
+        w[P.AUX] = WD_AUX
+        w[P.WND] = gen
+        w[P.LEN] = mark
+        return jnp.asarray(w)
+
+    now = 20 * 10**9
+    # no progress since the mark -> abort + walk continues (the
+    # successor is the end node; count unmet so no APP_DONE)
+    r2 = app_tgen(row, hpr, sh, jnp.int64(now), wd_wake(5000))
+    assert int(r2.stats[defs.ST_TGEN_ABORT]) == 1
+    assert int(r2.sk_app_ref[slot]) == -1
+
+    # progress advanced since the mark -> no abort, watchdog re-armed
+    r3 = app_tgen(row, hpr, sh, jnp.int64(now), wd_wake(1000))
+    assert int(r3.stats[defs.ST_TGEN_ABORT]) == 0
+    assert int(r3.sk_app_ref[slot]) == node
+    q_before = int((np.asarray(row.eq_time) != SIMTIME_MAX).sum())
+    q_after = int((np.asarray(r3.eq_time) != SIMTIME_MAX).sum())
+    assert q_after == q_before + 1
+    # re-armed one stallout period out (the queue's new entry)
+    before = np.asarray(row.eq_time)
+    after = np.asarray(r3.eq_time)
+    new_times = after[after != before]
+    assert new_times.tolist() == [now + int(nodes[node, COL_REF])]
+
+    # stale generation (recycled slot) -> watchdog is a no-op
+    w = np.asarray(wd_wake(5000)).copy()
+    w[P.WND] = gen + 5
+    r4 = app_tgen(row, hpr, sh, jnp.int64(now), jnp.asarray(w))
+    assert int(r4.stats[defs.ST_TGEN_ABORT]) == 0
+    assert int(r4.sk_app_ref[slot]) == node
