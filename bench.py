@@ -1,27 +1,38 @@
-"""Benchmark: PHOLD events/sec on one chip.
+"""Benchmark matrix: events/sec on one chip vs a measured baseline.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints one JSON line PER config:
+  {"metric": ..., "value": N, "unit": "events/s", "vs_baseline": N,
+   "realtime_x": N, "baseline": {...}}
 
-The reference publishes no performance numbers (BASELINE.md); the
-recorded value is raw engine throughput (events/sec/chip) on the PHOLD
-DES stress workload, and vs_baseline reports the simulated-seconds per
-wallclock-second ratio (the north-star metric per BASELINE.json).
+- `value`: compiled-engine event throughput on this platform.
+- `realtime_x`: simulated-seconds per wallclock-second.
+- `vs_baseline`: value / the measured baseline events/sec. The
+  reference publishes no numbers and cannot be built here (no
+  GLib/igraph in the image — BASELINE.md), so the denominator is the
+  pure-Python reference engine (engine.pyengine, the differential
+  oracle) timed on the same workload at a scale it can complete; its
+  config and throughput are recorded in `baseline` so the ratio is
+  auditable. Per-event cost in a heap-loop DES is roughly
+  scale-independent, which is what makes the small-scale denominator
+  meaningful.
+
+Configs (one line each, most important LAST so a tail-parser sees it):
+  phold-4096      UDP DES stress (scheduler/queue hot loop)
+  gossip-100k     BASELINE #5 shape: 100k-host block gossip
+  tgen-1k-tcp     BASELINE #2 shape: 1k-host tgen web+bulk over TCP
+
+Legacy single-config mode (used by smoke tests):
+  python bench.py 512 5     -> phold-512, 5 sim-seconds, one line
 """
 
+import copy
 import json
 import sys
 import time
 
 
-def main():
-    num_hosts = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
-    stop_s = int(sys.argv[2]) if len(sys.argv) > 2 else 10
-
-    import jax
+def _phold_scenario(num_hosts, stop_s):
     from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario
-    from shadow_tpu.engine.sim import Simulation
-    from shadow_tpu.engine.state import EngineConfig
 
     topo = """
 <graphml xmlns="http://graphml.graphdrawing.org/xmlns">
@@ -38,7 +49,7 @@ def main():
   </graph>
 </graphml>
 """
-    scen = Scenario(
+    return Scenario(
         stop_time=stop_s * 10**9,
         topology_graphml=topo,
         hosts=[HostSpec(id="node", quantity=num_hosts, processes=[
@@ -46,26 +57,121 @@ def main():
                         arguments="port=9000 mean=500ms size=64 init=1")])],
     )
 
-    cfg = EngineConfig(num_hosts=num_hosts, qcap=16, scap=4, obcap=8,
-                       incap=16, chunk_windows=512)
 
-    # Warm-up run at identical array shapes but a tiny stop time:
-    # stop_time is a dynamic scalar, so this compiles the full window
-    # program without recompiling for the measured run below.
-    import copy
-    warm_scen = copy.deepcopy(scen)
-    warm_scen.stop_time = int(1.2 * 10**9)
-    Simulation(warm_scen, engine_cfg=cfg).run()
+def _phold_cfg(num_hosts):
+    from shadow_tpu.engine.state import EngineConfig
+    return EngineConfig(num_hosts=num_hosts, qcap=16, scap=4, obcap=8,
+                        incap=16, chunk_windows=512)
 
+
+def _run_compiled(scen, cfg, warm_stop_ns=int(1.2 * 10**9)):
+    """Warm-up at identical shapes (tiny stop; stop_time is a dynamic
+    scalar so no recompile for the measured run), then measure."""
+    from shadow_tpu.engine.sim import Simulation
+
+    warm = copy.deepcopy(scen)
+    warm.stop_time = warm_stop_ns
+    Simulation(warm, engine_cfg=cfg).run()
     report = Simulation(scen, engine_cfg=cfg).run()
-    s = report.summary()
+    return report.summary()
 
-    print(json.dumps({
-        "metric": f"phold-{num_hosts} events/sec/chip",
-        "value": round(s["events_per_sec"], 1),
+
+def _run_pyengine(scen, cfg):
+    """The measured baseline: the pure-Python engine on the same
+    workload shape, timed end to end."""
+    from shadow_tpu.engine.pyengine import PyEngine
+    from shadow_tpu.engine.sim import Simulation
+
+    eng = PyEngine(Simulation(scen, engine_cfg=cfg))
+    t0 = time.perf_counter()
+    stats = eng.run()
+    wall = time.perf_counter() - t0
+    from shadow_tpu.engine import defs
+    events = int(stats[:, defs.ST_EVENTS].sum())
+    return {"events": events, "wall_seconds": round(wall, 2),
+            "events_per_sec": round(events / max(wall, 1e-9), 1)}
+
+
+def _emit(metric, summary, baseline, baseline_cfg):
+    vs = (summary["events_per_sec"] / baseline["events_per_sec"]
+          if baseline and baseline["events_per_sec"] else None)
+    line = {
+        "metric": metric,
+        "value": round(summary["events_per_sec"], 1),
         "unit": "events/s",
-        "vs_baseline": round(s["speedup"], 3),
-    }))
+        "vs_baseline": round(vs, 2) if vs else None,
+        "realtime_x": round(summary["speedup"], 3),
+        "events": summary["events"],
+        "baseline": ({"engine": "pyengine (pure-Python reference "
+                      "engine; C reference unbuildable here — see "
+                      "BASELINE.md)",
+                      "config": baseline_cfg, **baseline}
+                     if baseline else None),
+    }
+    print(json.dumps(line), flush=True)
+
+
+def bench_phold():
+    base = _run_pyengine(_phold_scenario(512, 4), _phold_cfg(512))
+    s = _run_compiled(_phold_scenario(4096, 10), _phold_cfg(4096))
+    _emit("phold-4096 events/sec/chip", s, base, "phold-512, 4 sim-s")
+
+
+def bench_gossip():
+    from shadow_tpu.core.config import load_xml
+    from shadow_tpu.engine.state import EngineConfig
+
+    # lean caps per the example's own recipe (gossip traffic is sparse
+    # per host; auto-sizing from bandwidth balloons at 100k hosts)
+    def caps(n):
+        return EngineConfig(num_hosts=n, qcap=16, scap=2, obcap=16,
+                            incap=32, chunk_windows=256)
+
+    scen = load_xml("examples/gossip-100k.xml")
+
+    base_scen = load_xml("examples/gossip-100k.xml")
+    base_scen.hosts[1].quantity = 999     # miner + 999 nodes
+    # gossip peer draws target ids [0, n); shrink n with the host count
+    for h in base_scen.hosts:
+        for p in h.processes:
+            p.arguments += " n=1000"
+    base = _run_pyengine(base_scen, caps(1000))
+    s = _run_compiled(scen, caps(100_000))
+    _emit("gossip-100k events/sec/chip", s, base,
+          "gossip-1000, 30 sim-s")
+
+
+def bench_tgen_tcp():
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.baseline_configs import build_bulk_1k, socks_caps
+
+    base = _run_pyengine(build_bulk_1k(20, stop=20), socks_caps(20, scap=32))
+    s = _run_compiled(build_bulk_1k(1000, stop=30),
+                      socks_caps(1000, scap=32),
+                      warm_stop_ns=int(2.2 * 10**9))
+    _emit("tgen-1k-tcp events/sec/chip", s, base, "tgen-20, 20 sim-s")
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1].isdigit():
+        # legacy single-config mode: phold-N [stop_s]
+        n = int(sys.argv[1])
+        stop_s = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+        base = _run_pyengine(_phold_scenario(min(n, 512), 4),
+                             _phold_cfg(min(n, 512)))
+        s = _run_compiled(_phold_scenario(n, stop_s), _phold_cfg(n))
+        _emit(f"phold-{n} events/sec/chip", s, base,
+              f"phold-{min(n, 512)}, 4 sim-s")
+        return
+
+    # full matrix: isolate configs so one failure doesn't hide the rest
+    for fn in (bench_phold, bench_gossip, bench_tgen_tcp):
+        try:
+            fn()
+        except Exception as e:  # pragma: no cover
+            print(json.dumps({"metric": fn.__name__, "error": repr(e)}),
+                  flush=True)
 
 
 if __name__ == "__main__":

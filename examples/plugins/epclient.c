@@ -1,0 +1,118 @@
+/* epclient: a plain, UNMODIFIED epoll-based TCP upload client.
+ *
+ * Uses only ordinary libc networking (getaddrinfo, nonblocking
+ * connect, epoll, send, shutdown, recv-until-EOF) — no simulator
+ * headers. The same binary runs:
+ *   natively:   ./epclient <host> <port> <bytes> <count>
+ *               against any TCP sink that closes after EOF;
+ *   simulated:  plugin="hosted:shim" cmd=.../epclient <server> <port>...
+ *               via the LD_PRELOAD shim (shadow_tpu/hosting/shim*).
+ *
+ * Per transfer: connect, send <bytes>, shutdown(WR), wait for the
+ * server's close (recv == 0), close. Prints one summary line:
+ *   epclient done transfers=N bytes=B
+ * which must match between native and simulated runs — the dual-run
+ * check the reference applies to its own test plugins (SURVEY §4).
+ */
+#include <errno.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+#include <fcntl.h>
+
+static int fatal(const char *msg) { perror(msg); exit(1); }
+
+int main(int argc, char **argv) {
+    if (argc < 5) {
+        fprintf(stderr,
+                "usage: %s <host> <port> <bytes-per-transfer> <count>\n",
+                argv[0]);
+        return 2;
+    }
+    const char *host = argv[1], *port = argv[2];
+    long nbytes = atol(argv[3]);
+    int count = atoi(argv[4]);
+
+    struct addrinfo hints, *ai;
+    memset(&hints, 0, sizeof hints);
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(host, port, &hints, &ai) != 0)
+        fatal("getaddrinfo");
+
+    int ep = epoll_create1(0);
+    if (ep < 0) fatal("epoll_create1");
+
+    char *buf = calloc(1, 65536);
+    long total = 0;
+    int done = 0;
+
+    struct timespec t0;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+
+    for (int i = 0; i < count; i++) {
+        int fd = socket(AF_INET, SOCK_STREAM, 0);
+        if (fd < 0) fatal("socket");
+        fcntl(fd, F_SETFL, O_NONBLOCK);
+        int rc = connect(fd, ai->ai_addr, ai->ai_addrlen);
+        if (rc < 0 && errno != EINPROGRESS) fatal("connect");
+
+        struct epoll_event ev, out;
+        ev.events = EPOLLOUT;
+        ev.data.fd = fd;
+        if (epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ev) < 0) fatal("epoll_ctl");
+        if (epoll_wait(ep, &out, 1, -1) != 1) fatal("epoll_wait(conn)");
+        int soerr = 0;
+        socklen_t slen = sizeof soerr;
+        getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen);
+        if (soerr) { errno = soerr; fatal("connect(completion)"); }
+
+        long sent = 0;
+        while (sent < nbytes) {
+            long want = nbytes - sent;
+            if (want > 65536) want = 65536;
+            ssize_t n = send(fd, buf, (size_t)want, 0);
+            if (n < 0) {
+                if (errno == EAGAIN) {          /* wait for writability */
+                    if (epoll_wait(ep, &out, 1, -1) != 1)
+                        fatal("epoll_wait(send)");
+                    continue;
+                }
+                fatal("send");
+            }
+            sent += n;
+        }
+        shutdown(fd, SHUT_WR);
+
+        /* wait for the server to consume everything and close */
+        ev.events = EPOLLIN;
+        ev.data.fd = fd;
+        epoll_ctl(ep, EPOLL_CTL_MOD, fd, &ev);
+        for (;;) {
+            if (epoll_wait(ep, &out, 1, -1) != 1) fatal("epoll_wait(eof)");
+            ssize_t n = recv(fd, buf, 65536, 0);
+            if (n == 0) break;                   /* clean EOF */
+            if (n < 0 && errno != EAGAIN) fatal("recv");
+        }
+        epoll_ctl(ep, EPOLL_CTL_DEL, fd, NULL);
+        close(fd);
+        total += sent;
+        done++;
+    }
+
+    struct timespec t1;
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    double secs = (double)(t1.tv_sec - t0.tv_sec) +
+                  (double)(t1.tv_nsec - t0.tv_nsec) / 1e9;
+    printf("epclient done transfers=%d bytes=%ld secs=%.3f\n",
+           done, total, secs);
+    freeaddrinfo(ai);
+    free(buf);
+    return done == count ? 0 : 1;
+}

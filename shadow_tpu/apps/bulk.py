@@ -20,7 +20,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..core.rowops import radd, rset
+from ..core.rowops import radd, rget, rset
 from ..engine.defs import (WAKE_START, WAKE_TIMER, WAKE_SOCKET,
                            WAKE_CONNECTED, WAKE_EOF, WAKE_ACCEPT, WAKE_SENT,
                            ST_XFER_DONE, ST_APP_DONE)
@@ -73,22 +73,50 @@ def app_bulk(row, hp, sh, now, wake):
 
 def app_bulk_server(row, hp, sh, now, wake):
     reason = wake[P.ACK]
+    slot = wake[P.SEQ]
 
     def on_start(r):
-        r, slot, ok = tcp_listen(r, hp.app_cfg[1])
-        return r.replace(app_r=rset(r.app_r, 0, slot.astype(jnp.int64)))
+        r, lslot, ok = tcp_listen(r, hp.app_cfg[1])
+        return r.replace(app_r=rset(r.app_r, 0, lslot.astype(jnp.int64)))
+
+    def on_accept(r):
+        # GET-tagged SYN (the tgen-server wire convention — a request
+        # size riding the handshake APP word): serve it. Lets SOCKS /
+        # Tor-shape configs use this lean server instead of compiling
+        # the whole tgen walk machinery; plain bulk clients connect
+        # with tag 0 and are unaffected.
+        tag = rget(row.sk_syn_tag, slot)
+        fresh = wake[P.WND] == rget(row.sk_timer_gen, slot)
+        size = (tag & ((1 << 30) - 1)).astype(jnp.int64)
+        is_get = fresh & ((tag & (1 << 30)) == 0) & (size > 0)
+
+        def serve(rr):
+            rr = tcp_write(rr, now, slot, size)
+            return tcp_close_call(rr, now, slot)
+
+        return jax.lax.cond(is_get, serve, lambda rr: rr, r)
 
     def on_eof(r):
         # client finished sending: close our side (LAST_ACK path) and
-        # count the completed inbound transfer
-        child = wake[P.SEQ]
-        r = tcp_close_call(r, now, child)
-        return r.replace(stats=radd(r.stats, ST_XFER_DONE, 1))
+        # count the completed inbound transfer. EOFs on served-GET
+        # children are teardown noise (the fetcher counts those), like
+        # tgen's server side. Stale-wake guard: a recycled slot's tag
+        # belongs to the NEW incarnation (generation rides WND).
+        fresh = wake[P.WND] == rget(row.sk_timer_gen, slot)
+        tag = rget(row.sk_syn_tag, slot)
+        served_get = (tag != 0) & ((tag & (1 << 30)) == 0)
+
+        def put_done(rr):
+            rr = tcp_close_call(rr, now, slot)
+            return rr.replace(stats=radd(rr.stats, ST_XFER_DONE, 1))
+
+        return jax.lax.cond(fresh & ~served_get, put_done,
+                            lambda rr: rr, r)
 
     def nop(r):
         return r
 
     return jax.lax.switch(
         jnp.clip(reason, 0, 6),
-        [on_start, nop, nop, nop, on_eof, nop, nop],
+        [on_start, nop, nop, nop, on_eof, on_accept, nop],
         row)

@@ -1126,13 +1126,26 @@ class PyEngine:
     def _app_bulk_server(self, host, now, wake):
         cfg = self.hp_app_cfg[host.hid]
         reason = min(max(int(wake[P.ACK]), 0), 6)
+        slot = int(wake[P.SEQ])
         if reason == 0:
-            slot, _ok = self._tcp_listen(host, int(cfg[1]))
-            host.app_r[0] = slot
+            lslot, _ok = self._tcp_listen(host, int(cfg[1]))
+            host.app_r[0] = lslot
+        elif reason == 5:           # accept: serve a GET-tagged SYN
+            tag = self._rg(host, slot, "syn_tag", 0)
+            fresh = int(wake[P.WND]) == self._rg(host, slot,
+                                                 "timer_gen", 0)
+            size = tag & ((1 << 30) - 1)
+            if fresh and (tag & (1 << 30)) == 0 and size > 0:
+                self._tcp_write(host, now, slot, size)
+                self._tcp_close_call(host, now, slot)
         elif reason == 4:           # eof: inbound transfer done
-            child = int(wake[P.SEQ])
-            self._tcp_close_call(host, now, child)
-            self.stats[host.hid, defs.ST_XFER_DONE] += 1
+            fresh = int(wake[P.WND]) == self._rg(host, slot,
+                                                 "timer_gen", 0)
+            tag = self._rg(host, slot, "syn_tag", 0)
+            served_get = tag != 0 and (tag & (1 << 30)) == 0
+            if fresh and not served_get:
+                self._tcp_close_call(host, now, slot)
+                self.stats[host.hid, defs.ST_XFER_DONE] += 1
 
     # --- tgen walk (apps.tgen mirror) ---------------------------------------
     def _rg(self, host, slot, key, default=0):

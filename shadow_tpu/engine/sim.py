@@ -568,6 +568,8 @@ class Simulation:
         stats = dist.gather_stats(hosts.stats)[:H]
         wall = _time.perf_counter() - wall0
         self.final_hosts = hosts
+        if self.hosting is not None:
+            self.hosting.shutdown()
         peaks = dist.gather_stats(hosts.cap_peaks)[:H].max(axis=0)
         capacity = {"rows": [
             ("event_queue", cfg.qcap, int(peaks[0])),

@@ -67,6 +67,14 @@ class EngineConfig:
     #   0 disables tracing entirely — the exchange compiles no trace code)
     synccap: int = 1        # tgen synchronize-barrier counters per host
     #   (sized by the Simulation to the compiled graphs' sync-node count)
+    exchange_a2a: bool = True  # sharded exchange protocol: bucketed
+    #   ragged all-to-all (v2, per-shard wire bytes ~flat in shard
+    #   count) vs the v1 all_gather (O(shards x outbox); set False to
+    #   fall back). Single-chip runs ignore this.
+    a2acap: int = 0         # all-to-all bucket slots per (src shard ->
+    #   dst shard) pair; 0 = auto (4x the uniform-traffic share,
+    #   clamped to the shard outbox). Bucket overflow is counted in
+    #   ST_PKTS_DROP_Q (see parallel.shard.exchange_sharded).
 
 
 @chex.dataclass

@@ -199,6 +199,10 @@ def register(name: str, factory):
 
 def lookup(name: str):
     if name not in _REGISTRY:
+        # built-in hosted apps register at import; pull them in before
+        # giving up (the LD_PRELOAD shim bridge lives in .shim)
+        from . import shim  # noqa: F401
+    if name not in _REGISTRY:
         raise ValueError(
             f"no hosted app {name!r} registered "
             f"(have: {sorted(_REGISTRY)}); call hosting.register first")

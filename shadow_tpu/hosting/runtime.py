@@ -34,6 +34,15 @@ class HostingRuntime:
             for hid in apps
         }
 
+    def shutdown(self):
+        """End-of-run teardown: release apps holding OS resources
+        (e.g. the LD_PRELOAD shim's child process) — a stop_time
+        truncation otherwise leaks them."""
+        for app in self.apps.values():
+            terminate = getattr(app, "terminate", None)
+            if terminate is not None:
+                terminate()
+
     def has_hosts(self) -> bool:
         return bool(self.apps)
 

@@ -1,0 +1,352 @@
+"""Unmodified-binary hosting: the LD_PRELOAD shim bridge.
+
+The reference's defining trick is running real, unmodified binaries by
+interposing 262 libc symbols (/root/reference/src/preload/
+shd-interposer.c:211-222, shd-preload-defs.h) and re-entering blocked
+app code with green threads (shd-process.c:1076-1263). This module is
+the TPU build's minimal realization of that capability for epoll-style
+network clients:
+
+- the REAL binary runs as a separate OS process with
+  ``libshadow_shim.so`` LD_PRELOADed (hosting/shim_preload.c);
+- the shim interposes the socket/epoll/clock libc surface and forwards
+  each call over an inherited socketpair to :class:`ShimApp`, a hosted
+  app (hosting.api) inside the simulator;
+- blocking semantics replace rpth: the binary only ever blocks inside
+  a forwarded ``epoll_wait``; the simulator answers it when a device
+  wake (connection established, bytes delivered, EOF) maps to a
+  registered epoll interest — so simulated time never advances while
+  app code runs, exactly the reference's cooperative model;
+- payload bytes are not materialized (the engine models byte counts);
+  ``recv`` returns the delivered COUNT and the shim hands the app a
+  zero-filled buffer. Clients that parse payloads need the modeled-app
+  tier; clients that move/measure bytes (tgen-style) run unmodified.
+
+Scenario usage: plugin="hosted:shim" with arguments
+``[out=<stdout file>] cmd=<binary> [child args...]`` — cmd paths
+resolve like any exec (absolute, or relative to the process CWD). The
+preload library builds on demand with cc into SHADOW_SHIM_BUILD or the
+temp dir (hosting.shim.build_shim).
+
+Protocol (one request, one response, in lockstep — the child is
+single-threaded between epoll_waits):
+  request  = <iiqq64s>  op, a, b, c, name  (88 bytes)
+  response = <qqq>      r0, r1, r2         (24 bytes)
+"""
+
+from __future__ import annotations
+
+import os as _os
+import struct
+import subprocess
+
+from .api import HostedApp, register
+
+REQ = struct.Struct("<iiqq64s")
+RSP = struct.Struct("<qqq")
+
+OP_SOCKET = 1
+OP_CONNECT = 2
+OP_SEND = 3
+OP_RECV = 4
+OP_CLOSE = 5
+OP_SHUTDOWN = 6
+OP_EPOLL_CREATE = 7
+OP_EPOLL_CTL = 8
+OP_EPOLL_WAIT = 9
+OP_CLOCK = 10
+OP_RESOLVE = 11
+
+EPOLLIN = 0x001
+EPOLLOUT = 0x004
+EPOLLRDHUP = 0x2000
+EPOLLHUP = 0x010
+EINPROGRESS = 115
+EAGAIN = 11
+
+EPOLL_CTL_ADD = 1
+EPOLL_CTL_DEL = 2
+EPOLL_CTL_MOD = 3
+
+_SRC = _os.path.dirname(_os.path.abspath(__file__))
+SHIM_C = _os.path.join(_SRC, "shim_preload.c")
+
+
+def build_shim(out_dir: str = None) -> str:
+    """Compile the preload library (cached). -> .so path
+
+    Builds into SHADOW_SHIM_BUILD or the system temp dir — never next
+    to the target binary, which may live somewhere read-only."""
+    if out_dir is None:
+        import tempfile
+        out_dir = _os.environ.get("SHADOW_SHIM_BUILD",
+                                  tempfile.gettempdir())
+    so = _os.path.join(out_dir, "libshadow_shim.so")
+    if (_os.path.exists(so) and
+            _os.path.getmtime(so) >= _os.path.getmtime(SHIM_C)):
+        return so
+    subprocess.run(["cc", "-shared", "-fPIC", "-O2", "-o", so, SHIM_C,
+                    "-ldl"], check=True)
+    return so
+
+
+class _VSock:
+    """Shim-side view of one virtual socket fd."""
+
+    __slots__ = ("sock", "avail", "eof", "connected", "closed", "key")
+
+    def __init__(self):
+        self.sock = None        # hosting.api.Sock once connect issued
+        self.avail = 0          # delivered-but-unread byte count
+        self.eof = False
+        self.connected = False
+        self.closed = False
+        self.key = None         # (slot, gen) once resolved
+
+
+class ShimApp(HostedApp):
+    """Hosts one real binary behind the LD_PRELOAD shim (module doc)."""
+
+    def __init__(self, args: str):
+        parts = args.split()
+        i = next((j for j, p in enumerate(parts)
+                  if p.startswith("cmd=")), None)
+        if i is None:
+            raise ValueError("hosted:shim needs cmd=<binary> argument")
+        # shim options (out=...) precede cmd=; everything AFTER cmd= is
+        # the child's argv verbatim
+        kv = dict(p.split("=", 1) for p in parts[:i + 1] if "=" in p)
+        self.argv = [kv["cmd"]] + parts[i + 1:]
+        self.out_path = kv.get("out")   # child stdout -> file (tests)
+        self.proc = None
+        self.chan = None          # our end of the socketpair
+        self.vfds = {}            # vfd -> _VSock
+        self.by_sock = {}         # id(Sock) -> vfd (pre-resolution)
+        self.by_key = {}          # (slot, gen) -> vfd: wakes arriving
+        # after os.close() carry a FRESH Sock object for the same
+        # incarnation (HostOS retires closed handles), so identity
+        # lookup alone would drop e.g. the post-shutdown EOF
+        self.epolls = {}          # vepfd -> {vfd: events}
+        self.next_fd = 1 << 20
+        self.parked = None        # vepfd the child is blocked in, or None
+        self.park_seq = 0         # increments per park: stale-timeout guard
+        self.exited = False
+
+    # --- child lifecycle ---
+    def _spawn(self):
+        import socket as pysock
+        ours, theirs = pysock.socketpair()
+        env = dict(_os.environ)
+        env["LD_PRELOAD"] = build_shim()
+        env["SHADOW_SHIM_FD"] = str(theirs.fileno())
+        stdout = (open(self.out_path, "w") if self.out_path else None)
+        self.proc = subprocess.Popen(self.argv, env=env,
+                                     pass_fds=(theirs.fileno(),),
+                                     stdout=stdout)
+        if stdout is not None:
+            stdout.close()
+        theirs.close()
+        self.chan = ours
+
+    def _read_req(self):
+        buf = b""
+        while len(buf) < REQ.size:
+            chunk = self.chan.recv(REQ.size - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return REQ.unpack(buf)
+
+    def _rsp(self, r0=0, r1=0, r2=0):
+        self.chan.sendall(RSP.pack(int(r0), int(r1), int(r2)))
+
+    # --- epoll readiness ---
+    def _events_of(self, vfd):
+        vs = self.vfds.get(vfd)
+        if vs is None:
+            return 0
+        ev = 0
+        if vs.avail > 0 or vs.eof:
+            ev |= EPOLLIN | (EPOLLRDHUP if vs.eof else 0)
+        if vs.connected:
+            ev |= EPOLLOUT
+        return ev
+
+    def _ready(self, vepfd):
+        for vfd, interest in self.epolls.get(vepfd, {}).items():
+            ev = self._events_of(vfd) & (interest | EPOLLRDHUP | EPOLLHUP)
+            if ev:
+                return vfd, ev
+        return None
+
+    def _maybe_unpark(self):
+        if self.parked is None:
+            return False
+        hit = self._ready(self.parked)
+        if hit is None:
+            return False
+        self.parked = None
+        self._rsp(1, hit[0], hit[1])
+        return True
+
+    # --- the service loop: run the child until it blocks ---
+    def _service(self, os):
+        if self.exited:
+            return
+        self._maybe_unpark()
+        while self.parked is None and not self.exited:
+            req = self._read_req()
+            if req is None:
+                self.exited = True
+                if self.proc is not None:
+                    self.proc.wait()
+                return
+            self._handle(os, *req)
+
+    def _handle(self, os, op, a, b, c, name):
+        if op == OP_SOCKET:
+            vfd = self.next_fd
+            self.next_fd += 1
+            self.vfds[vfd] = _VSock()
+            self._rsp(vfd)
+        elif op == OP_CONNECT:
+            vs = self.vfds[a]
+            vs.sock = os.tcp_connect(int(b), int(c))
+            self.by_sock[id(vs.sock)] = a
+            self._rsp(-1, EINPROGRESS)   # completes via EPOLLOUT
+        elif op == OP_SEND:
+            vs = self.vfds[a]
+            os.write(vs.sock, int(b))
+            self._rsp(b)
+        elif op == OP_RECV:
+            vs = self.vfds[a]
+            n = min(vs.avail, int(b))
+            vs.avail -= n
+            if n == 0 and not vs.eof:
+                self._rsp(-1, EAGAIN)
+            else:
+                self._rsp(n)             # 0 = EOF
+        elif op in (OP_CLOSE, OP_SHUTDOWN):
+            vs = self.vfds.get(a)
+            if vs is not None and vs.sock is not None and not vs.closed:
+                os.close(vs.sock)
+                vs.closed = True
+            if op == OP_CLOSE:
+                gone = self.vfds.pop(a, None)
+                if gone is not None and gone.key is not None:
+                    self.by_key.pop(gone.key, None)
+                if gone is not None:
+                    self.by_sock.pop(id(gone.sock), None)
+                for watch in self.epolls.values():
+                    watch.pop(a, None)
+            self._rsp(0)
+        elif op == OP_EPOLL_CREATE:
+            vfd = self.next_fd
+            self.next_fd += 1
+            self.epolls[vfd] = {}
+            self._rsp(vfd)
+        elif op == OP_EPOLL_CTL:
+            ctl = int(b) & 0xFFFFFFFF
+            events = int(b) >> 32
+            watch = self.epolls.setdefault(a, {})
+            if ctl == EPOLL_CTL_DEL:
+                watch.pop(int(c), None)
+            else:
+                watch[int(c)] = events
+            self._rsp(0)
+        elif op == OP_EPOLL_WAIT:
+            hit = self._ready(a)
+            if hit is not None:
+                self._rsp(1, hit[0], hit[1])
+            elif b == 0:
+                self._rsp(0)             # pure poll: never parks
+            else:
+                self.parked = a          # block until a wake readies it
+                self.park_seq += 1
+                if b > 0:                # bounded wait: sim-time timer,
+                    # tagged with this park's sequence so a stale timer
+                    # from an earlier (already answered) wait cannot
+                    # cut a later one short
+                    os.timer(int(b) * 1_000_000,
+                             tag=(self.park_seq << 24) | (a & 0xFFFFFF))
+        elif op == OP_CLOCK:
+            self._rsp(os.now())
+        elif op == OP_RESOLVE:
+            try:
+                hid = os.resolve(name.rstrip(b"\0").decode())
+            except Exception:
+                hid = -1
+            self._rsp(hid)
+        else:
+            self._rsp(-1)
+
+    # --- hosted-app callbacks: map device wakes to epoll readiness ---
+    def on_start(self, os):
+        self._spawn()
+        self._service(os)
+
+    def _vs_of(self, sock):
+        vfd = self.by_sock.get(id(sock))
+        if vfd is None and sock is not None and sock.slot is not None:
+            vfd = self.by_key.get((sock.slot, sock.gen))
+        if vfd is None:
+            return None, None
+        vs = self.vfds.get(vfd)
+        if (sock.slot is not None and vs is not None):
+            self.by_key[(sock.slot, sock.gen)] = vfd
+            vs.key = (sock.slot, sock.gen)
+        return vfd, vs
+
+    def on_connected(self, os, sock):
+        _, vs = self._vs_of(sock)
+        if vs is not None:
+            vs.connected = True
+        self._service(os)
+
+    def on_dgram(self, os, sock, src, sport, nbytes, aux):
+        # TCP delivered-bytes wake (reason WAKE_SOCKET)
+        _, vs = self._vs_of(sock)
+        if vs is not None:
+            vs.avail += int(nbytes)
+        self._service(os)
+
+    def on_eof(self, os, sock):
+        _, vs = self._vs_of(sock)
+        if vs is not None:
+            vs.eof = True
+        self._service(os)
+
+    def on_sent(self, os, sock):
+        self._service(os)
+
+    def on_timer(self, os, tag):
+        # epoll_wait timeout expiry: answer 0 events iff the child is
+        # still parked in the SAME wait that armed this timer
+        epfd = tag & 0xFFFFFF
+        seq = tag >> 24
+        if (self.parked is not None and
+                (self.parked & 0xFFFFFF) == epfd and
+                seq == self.park_seq):
+            self.parked = None
+            self._rsp(0)
+        self._service(os)
+
+    def terminate(self):
+        """End-of-run cleanup: release the child and the channel (a
+        stop_time truncation can leave the child parked forever)."""
+        if self.chan is not None:
+            try:
+                self.chan.close()
+            except OSError:
+                pass
+            self.chan = None
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except Exception:
+                self.proc.kill()
+        self.exited = True
+
+
+register("shim", ShimApp)

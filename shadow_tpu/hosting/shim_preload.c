@@ -1,0 +1,260 @@
+/* LD_PRELOAD shim: run an unmodified epoll-based network client under
+ * the simulator.
+ *
+ * The minimal realization of the reference's interposition library
+ * (/root/reference/src/preload/shd-interposer.c: 262 PRELOADDEF
+ * wrappers dispatching to process_emu_* or the real libc): this shim
+ * interposes the socket/epoll/clock surface a typical nonblocking
+ * client uses and forwards each call as a fixed-size request over the
+ * socketpair inherited in SHADOW_SHIM_FD; the simulator-side peer is
+ * shadow_tpu/hosting/shim.py (protocol defined there).
+ *
+ * Virtualization boundary: only fds >= VFD_BASE (handed out by the
+ * simulator) are virtual; everything else falls through to the real
+ * libc via dlsym(RTLD_NEXT) — same split as the reference's
+ * shadow-fd vs OS-fd descriptor tables (shd-host.c fd mapping).
+ *
+ * Payload note: the engine models byte counts, not contents; recv()
+ * zero-fills the buffer and returns the simulated delivered count.
+ */
+#define _GNU_SOURCE
+#include <dlfcn.h>
+#include <errno.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#define VFD_BASE (1 << 20)
+
+enum {
+    OP_SOCKET = 1, OP_CONNECT, OP_SEND, OP_RECV, OP_CLOSE, OP_SHUTDOWN,
+    OP_EPOLL_CREATE, OP_EPOLL_CTL, OP_EPOLL_WAIT, OP_CLOCK, OP_RESOLVE,
+};
+
+struct req { int32_t op; int32_t a; int64_t b; int64_t c; char name[64]; };
+struct rsp { int64_t r0; int64_t r1; int64_t r2; };
+
+static int chan_fd = -1;
+static ssize_t (*real_send)(int, const void *, size_t, int);
+static ssize_t (*real_recv)(int, void *, size_t, int);
+static ssize_t (*real_read)(int, void *, size_t);
+static ssize_t (*real_write)(int, const void *, size_t);
+static int (*real_close)(int);
+static int (*real_socket)(int, int, int);
+static int (*real_connect)(int, const struct sockaddr *, socklen_t);
+static int (*real_shutdown)(int, int);
+static int (*real_epoll_create1)(int);
+static int (*real_epoll_ctl)(int, int, int, struct epoll_event *);
+static int (*real_epoll_wait)(int, struct epoll_event *, int, int);
+static int (*real_clock_gettime)(clockid_t, struct timespec *);
+static int (*real_getaddrinfo)(const char *, const char *,
+                               const struct addrinfo *,
+                               struct addrinfo **);
+
+static void shim_init(void) {
+    static int done = 0;
+    if (done) return;
+    done = 1;
+    real_send = dlsym(RTLD_NEXT, "send");
+    real_recv = dlsym(RTLD_NEXT, "recv");
+    real_read = dlsym(RTLD_NEXT, "read");
+    real_write = dlsym(RTLD_NEXT, "write");
+    real_close = dlsym(RTLD_NEXT, "close");
+    real_socket = dlsym(RTLD_NEXT, "socket");
+    real_connect = dlsym(RTLD_NEXT, "connect");
+    real_shutdown = dlsym(RTLD_NEXT, "shutdown");
+    real_epoll_create1 = dlsym(RTLD_NEXT, "epoll_create1");
+    real_epoll_ctl = dlsym(RTLD_NEXT, "epoll_ctl");
+    real_epoll_wait = dlsym(RTLD_NEXT, "epoll_wait");
+    real_clock_gettime = dlsym(RTLD_NEXT, "clock_gettime");
+    real_getaddrinfo = dlsym(RTLD_NEXT, "getaddrinfo");
+    const char *env = getenv("SHADOW_SHIM_FD");
+    if (env) chan_fd = atoi(env);
+}
+
+static int active(void) {
+    shim_init();
+    return chan_fd >= 0;
+}
+
+/* one lockstep request/response on the control channel */
+static struct rsp call(int32_t op, int32_t a, int64_t b, int64_t c,
+                       const char *name) {
+    struct req q;
+    struct rsp r = {-1, 0, 0};
+    memset(&q, 0, sizeof q);
+    q.op = op; q.a = a; q.b = b; q.c = c;
+    if (name) strncpy(q.name, name, sizeof q.name - 1);
+    size_t off = 0;
+    while (off < sizeof q) {
+        ssize_t n = real_write(chan_fd, (char *)&q + off, sizeof q - off);
+        if (n <= 0) { errno = EPIPE; return r; }
+        off += (size_t)n;
+    }
+    off = 0;
+    while (off < sizeof r) {
+        ssize_t n = real_read(chan_fd, (char *)&r + off, sizeof r - off);
+        if (n <= 0) { errno = EPIPE; struct rsp bad = {-1, 0, 0}; return bad; }
+        off += (size_t)n;
+    }
+    return r;
+}
+
+static int is_vfd(int fd) { return fd >= VFD_BASE; }
+
+/* --- interposed surface ------------------------------------------------ */
+
+int socket(int domain, int type, int protocol) {
+    if (!active() || domain != AF_INET)
+        return real_socket(domain, type, protocol);
+    return (int)call(OP_SOCKET, 0, 0, 0, NULL).r0;
+}
+
+int connect(int fd, const struct sockaddr *addr, socklen_t len) {
+    if (!active() || !is_vfd(fd)) return real_connect(fd, addr, len);
+    const struct sockaddr_in *a = (const struct sockaddr_in *)addr;
+    /* sin_addr carries the virtual host id verbatim (stamped by our
+     * getaddrinfo); sin_port is network order */
+    struct rsp r = call(OP_CONNECT, fd, (int64_t)a->sin_addr.s_addr,
+                        ntohs(a->sin_port), NULL);
+    if (r.r0 < 0) { errno = (int)r.r1; return -1; }
+    return 0;
+}
+
+ssize_t send(int fd, const void *buf, size_t n, int flags) {
+    if (!active() || !is_vfd(fd)) return real_send(fd, buf, n, flags);
+    (void)buf;
+    return (ssize_t)call(OP_SEND, fd, (int64_t)n, 0, NULL).r0;
+}
+
+ssize_t recv(int fd, void *buf, size_t n, int flags) {
+    if (!active() || !is_vfd(fd)) return real_recv(fd, buf, n, flags);
+    struct rsp r = call(OP_RECV, fd, (int64_t)n, 0, NULL);
+    if (r.r0 < 0) { errno = (int)r.r1; return -1; }
+    memset(buf, 0, (size_t)r.r0);  /* counts are modeled, bytes are not */
+    return (ssize_t)r.r0;
+}
+
+ssize_t write(int fd, const void *buf, size_t n) {
+    if (active() && is_vfd(fd)) return send(fd, buf, n, 0);
+    shim_init();
+    return real_write(fd, buf, n);
+}
+
+ssize_t read(int fd, void *buf, size_t n) {
+    if (active() && is_vfd(fd)) return recv(fd, buf, n, 0);
+    shim_init();
+    return real_read(fd, buf, n);
+}
+
+int shutdown(int fd, int how) {
+    if (!active() || !is_vfd(fd)) return real_shutdown(fd, how);
+    return (int)call(OP_SHUTDOWN, fd, how, 0, NULL).r0;
+}
+
+int close(int fd) {
+    if (!active() || !is_vfd(fd)) { shim_init(); return real_close(fd); }
+    return (int)call(OP_CLOSE, fd, 0, 0, NULL).r0;
+}
+
+int epoll_create1(int flags) {
+    if (!active()) return real_epoll_create1(flags);
+    return (int)call(OP_EPOLL_CREATE, 0, 0, 0, NULL).r0;
+}
+
+int epoll_create(int size) { (void)size; return epoll_create1(0); }
+
+int epoll_ctl(int epfd, int op, int fd, struct epoll_event *ev) {
+    if (!active() || !is_vfd(epfd)) return real_epoll_ctl(epfd, op, fd, ev);
+    int64_t packed = (int64_t)op |
+        ((int64_t)(ev ? ev->events : 0) << 32);
+    return (int)call(OP_EPOLL_CTL, epfd, packed, fd, NULL).r0;
+}
+
+int epoll_wait(int epfd, struct epoll_event *evs, int maxevents,
+               int timeout) {
+    if (!active() || !is_vfd(epfd))
+        return real_epoll_wait(epfd, evs, maxevents, timeout);
+    (void)maxevents;
+    struct rsp r = call(OP_EPOLL_WAIT, epfd, timeout, 0, NULL);
+    if (r.r0 <= 0) return (int)r.r0;
+    evs[0].events = (uint32_t)r.r2;
+    evs[0].data.fd = (int)r.r1;
+    return 1;
+}
+
+int clock_gettime(clockid_t clk, struct timespec *ts) {
+    if (!active()) return real_clock_gettime(clk, ts);
+    int64_t ns = call(OP_CLOCK, (int32_t)clk, 0, 0, NULL).r0;
+    ts->tv_sec = ns / 1000000000LL;
+    ts->tv_nsec = ns % 1000000000LL;
+    return 0;
+}
+
+int getaddrinfo(const char *node, const char *service,
+                const struct addrinfo *hints, struct addrinfo **res) {
+    if (!active()) return real_getaddrinfo(node, service, hints, res);
+    struct rsp r = call(OP_RESOLVE, 0, 0, 0, node);
+    if (r.r0 < 0) return EAI_NONAME;
+    struct addrinfo *ai = calloc(1, sizeof *ai);
+    struct sockaddr_in *sa = calloc(1, sizeof *sa);
+    sa->sin_family = AF_INET;
+    sa->sin_addr.s_addr = (uint32_t)r.r0;   /* virtual host id */
+    sa->sin_port = service ? htons((uint16_t)atoi(service)) : 0;
+    ai->ai_family = AF_INET;
+    ai->ai_socktype = hints ? hints->ai_socktype : SOCK_STREAM;
+    ai->ai_addrlen = sizeof *sa;
+    ai->ai_addr = (struct sockaddr *)sa;
+    *res = ai;
+    return 0;
+}
+
+void freeaddrinfo(struct addrinfo *res) {
+    /* frees only what our getaddrinfo allocated; pass through others */
+    if (!active()) {
+        void (*real_fai)(struct addrinfo *) =
+            dlsym(RTLD_NEXT, "freeaddrinfo");
+        real_fai(res);
+        return;
+    }
+    if (res) { free(res->ai_addr); free(res); }
+}
+
+/* harmless accepted no-ops on virtual fds */
+int setsockopt(int fd, int level, int optname, const void *optval,
+               socklen_t optlen) {
+    if (active() && is_vfd(fd)) return 0;
+    static int (*real_sso)(int, int, int, const void *, socklen_t);
+    if (!real_sso) real_sso = dlsym(RTLD_NEXT, "setsockopt");
+    return real_sso(fd, level, optname, optval, optlen);
+}
+
+int getsockopt(int fd, int level, int optname, void *optval,
+               socklen_t *optlen) {
+    if (active() && is_vfd(fd)) {
+        /* SO_ERROR after EPOLLOUT: connection is established */
+        if (optval && optlen && *optlen >= sizeof(int))
+            *(int *)optval = 0;
+        return 0;
+    }
+    static int (*real_gso)(int, int, int, void *, socklen_t *);
+    if (!real_gso) real_gso = dlsym(RTLD_NEXT, "getsockopt");
+    return real_gso(fd, level, optname, optval, optlen);
+}
+
+int fcntl(int fd, int cmd, ...) {
+    __builtin_va_list ap;
+    __builtin_va_start(ap, cmd);
+    long arg = __builtin_va_arg(ap, long);
+    __builtin_va_end(ap);
+    if (active() && is_vfd(fd)) return 0;   /* O_NONBLOCK etc: accepted */
+    static int (*real_fcntl)(int, int, ...);
+    if (!real_fcntl) real_fcntl = dlsym(RTLD_NEXT, "fcntl");
+    return real_fcntl(fd, cmd, arg);
+}

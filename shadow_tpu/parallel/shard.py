@@ -22,11 +22,27 @@ by (src, uid) counters, not by execution placement; the gathered global
 packet order equals the single-chip outbox order because shards are
 contiguous host blocks; and every per-host transition is local.
 
-The all-gather exchange is the v1 wire protocol: simple, deterministic,
-bandwidth O(shards x total outbox) over ICI. The planned v2 is a
-bucketed ragged all-to-all (each shard sends only what the destination
-needs), which drops the factor of `shards`; the seam is
-:func:`exchange_sharded` only — nothing else changes.
+Two wire protocols (EngineConfig.exchange_a2a selects; both live in
+:func:`exchange_sharded`, nothing else changes):
+
+- **v1 all-gather**: every shard receives every shard's whole outbox —
+  simple, exact, but per-shard ICI bytes grow as O(shards x outbox).
+- **v2 bucketed ragged all-to-all** (default): each shard stable-sorts
+  its surviving outbox by destination shard, packs it into fixed
+  [shards, B] buckets and `lax.all_to_all`s them — each shard receives
+  only traffic addressed to its hosts, so per-shard wire bytes are
+  O(shards x B) ~= O(4 x outbox), FLAT in shard count (B defaults to
+  4x the uniform-traffic share). Determinism: bucket packing and the
+  post-exchange merge are stable sorts keyed exactly like v1, so the
+  delivered order (and therefore every downstream bit) matches v1 and
+  the single-chip engine. A bucket overflow (one shard bursting more
+  than B packets at one other shard in a single window) drops the
+  burst tail and counts it in ST_PKTS_DROP_Q against the sending
+  host — beyond that bound the single-chip engine is also dropping
+  (a destination shard can absorb at most Hl x incap per window), but
+  may pick different victims, so bit-equality holds only under the
+  bucket bound; size a2acap for the workload's burst, or set
+  exchange_a2a=False for the exact-at-any-burst v1.
 """
 
 from __future__ import annotations
@@ -91,12 +107,17 @@ def exchange_sharded(hosts, hp, sh, cfg: EngineConfig,
     deliver = valid & reachable & (u <= rel)
     net_dropped = valid & ~deliver
 
-    # --- cross-shard hop: gather all shards' surviving traffic ---
     sortkey_l = jnp.where(deliver, dst, H)
-    g_key = jax.lax.all_gather(sortkey_l, AXIS).reshape(n_shards * Nl)
-    g_arr = jax.lax.all_gather(arrival, AXIS).reshape(n_shards * Nl)
-    g_pkt = jax.lax.all_gather(pkts, AXIS).reshape(n_shards * Nl,
-                                                   P.PKT_WORDS)
+
+    if cfg.exchange_a2a and n_shards > 1:
+        hosts, g_key, g_arr, g_pkt = _a2a_hop(
+            hosts, cfg, lcfg, sortkey_l, arrival, pkts, n_shards)
+    else:
+        # --- v1: gather all shards' surviving traffic ---
+        g_key = jax.lax.all_gather(sortkey_l, AXIS).reshape(n_shards * Nl)
+        g_arr = jax.lax.all_gather(arrival, AXIS).reshape(n_shards * Nl)
+        g_pkt = jax.lax.all_gather(pkts, AXIS).reshape(n_shards * Nl,
+                                                       P.PKT_WORDS)
 
     # identical group-by-destination + gather-based delivery as the
     # single-chip exchange (engine.window._deliver_dense — ONE
@@ -109,6 +130,71 @@ def exchange_sharded(hosts, hp, sh, cfg: EngineConfig,
 
     hosts = trace_and_merge(hosts, hp, cfg, in_pkt, in_time)
     return hosts.replace(ob_cnt=jnp.zeros_like(hosts.ob_cnt))
+
+
+def a2a_bucket_cap(cfg: EngineConfig, lcfg: EngineConfig) -> int:
+    """Bucket slots per (src shard, dst shard) pair for the v2
+    exchange: explicit cfg.a2acap, else 4x the uniform-traffic share
+    of the shard outbox (min 64), never more than the whole outbox."""
+    Nl = lcfg.num_hosts * cfg.obcap
+    n_shards = cfg.num_hosts // lcfg.num_hosts
+    if cfg.a2acap:
+        return min(cfg.a2acap, Nl)
+    return min(max(64, (4 * Nl) // n_shards), Nl)
+
+
+def _a2a_hop(hosts, cfg, lcfg, sortkey_l, arrival, pkts, n_shards):
+    """v2 cross-shard hop (module docstring): bucket by destination
+    shard, exchange buckets, return the received (key, arrival, pkt)
+    triple in the same global source order v1's gather produces.
+
+    Order argument: the local stable sort is keyed by destination
+    SHARD only, so packets for one shard stay in local outbox order;
+    all_to_all concatenates buckets in source-shard order; hence the
+    received sequence is source-shard-major, source-outbox-minor —
+    exactly v1's gathered order filtered to this shard's traffic. The
+    caller's stable sort by destination then matches v1 bit for bit.
+    """
+    Hl, O = lcfg.num_hosts, cfg.obcap
+    Nl = Hl * O
+    B = a2a_bucket_cap(cfg, lcfg)
+
+    dshard = jnp.where(sortkey_l < cfg.num_hosts, sortkey_l // Hl,
+                       n_shards)  # n_shards = invalid/dropped bucket
+    order_l = jnp.argsort(dshard, stable=True)
+    sds = dshard[order_l]
+
+    shards_r = jnp.arange(n_shards, dtype=sds.dtype)
+    first_of = jnp.searchsorted(sds, shards_r, side="left")
+    count_of = jnp.searchsorted(sds, shards_r, side="right") - first_of
+
+    r = jnp.arange(B)
+    j = jnp.clip(first_of[:, None] + r[None, :], 0, Nl - 1)  # [S, B]
+    oj = order_l[j]
+    cell_ok = r[None, :] < jnp.minimum(count_of, B)[:, None]
+    bkt_key = jnp.where(cell_ok, sortkey_l[oj], cfg.num_hosts)
+    bkt_arr = jnp.where(cell_ok, arrival[oj], 0)
+    bkt_pkt = jnp.where(cell_ok[:, :, None], pkts[oj], jnp.int32(0))
+
+    # bucket overflow: the burst tail past B never ships — count it
+    # against the sending host (rank within bucket >= B)
+    rank = jnp.arange(Nl) - first_of[jnp.clip(sds, 0, n_shards - 1)]
+    lost = (sds < n_shards) & (rank >= B)
+    src_host = order_l // O  # local host id of each sorted entry
+    per_host = jnp.zeros((Hl,), jnp.int64).at[src_host].add(
+        lost.astype(jnp.int64))
+    hosts = hosts.replace(
+        stats=hosts.stats.at[:, ST_PKTS_DROP_Q].add(per_host))
+
+    g_key = jax.lax.all_to_all(bkt_key, AXIS, split_axis=0,
+                               concat_axis=0, tiled=False)
+    g_arr = jax.lax.all_to_all(bkt_arr, AXIS, split_axis=0,
+                               concat_axis=0, tiled=False)
+    g_pkt = jax.lax.all_to_all(bkt_pkt, AXIS, split_axis=0,
+                               concat_axis=0, tiled=False)
+    N2 = n_shards * B
+    return (hosts, g_key.reshape(N2), g_arr.reshape(N2),
+            g_pkt.reshape(N2, P.PKT_WORDS))
 
 
 def _windows_body(hosts, hp, sh, wstart, wend, cfg, lcfg, max_windows):
