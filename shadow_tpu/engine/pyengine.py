@@ -580,6 +580,11 @@ class PyEngine:
         ack_no = _i32(sk["rcv_nxt"])
         wnd = _i32(min(sk["rcvbuf"], 2**31 - 1))
         aux, sack2 = self._finack_aux(sk)
+        if sel in (1, 2):
+            # handshake bandwidth stamp (net.tcp.tcp_pull)
+            aux = ((min(int(self.hp_bw_up[host.hid]) >> 10, 0xFFFF)
+                    << 16) |
+                   min(int(self.hp_bw_down[host.hid]) >> 10, 0xFFFF))
 
         rex_cap = min(lost_end,
                       int(sack.next_start_after(np.int64(rex_nxt),
@@ -655,6 +660,29 @@ class PyEngine:
             self._arm_timer(host, slot, now)
         return (pkt if has else None), has
 
+    def _autotune(self, host, slot, pkt):
+        """Mirror of net.tcp._autotune: peer bandwidths from the
+        handshake AUX stamp, RTT = 2x the SEQ latency stamp."""
+        sk = host.socks[slot]
+        peer = int(pkt[P.SRC])
+        rtt_us = 2 * max(int(pkt[P.SEQ]), 0)
+        peer_up = ((int(pkt[P.AUX]) >> 16) & 0xFFFF) << 10
+        peer_dn = (int(pkt[P.AUX]) & 0xFFFF) << 10
+        bw_cap = 1 << 38
+        snd_bw = min(int(self.hp_bw_up[host.hid]), peer_dn, bw_cap)
+        rcv_bw = min(int(self.hp_bw_down[host.hid]), peer_up, bw_cap)
+        buf_cap = 1 << 30
+        sndbuf_auto = min(max((snd_bw * rtt_us // 1_000_000) * 5 // 4,
+                              SEND_BUFFER_MIN_SIZE), buf_cap)
+        rcvbuf_auto = min(max((rcv_bw * rtt_us // 1_000_000) * 5 // 4,
+                              RECV_BUFFER_MIN_SIZE), buf_cap)
+        if peer == host.hid:
+            sndbuf_auto = rcvbuf_auto = 16 * 1024 * 1024
+        sb0 = int(self.hp_sndbuf0[host.hid])
+        rb0 = int(self.hp_rcvbuf0[host.hid])
+        sk["sndbuf"] = sb0 if sb0 >= 0 else sndbuf_auto
+        sk["rcvbuf"] = rb0 if rb0 >= 0 else rcvbuf_auto
+
     @staticmethod
     def _rfc6298(srtt, rttvar, sample):
         first = srtt < 0
@@ -681,6 +709,7 @@ class PyEngine:
         sk["peer_rwnd"] = max(int(pkt[P.WND]), 1)
         sk["hs_time"] = now
         sk["syn_tag"] = int(pkt[P.APP])
+        self._autotune(host, child, pkt)
         self._arm_timer(host, child, now)
 
     def _rx_conn(self, host, now, slot, pkt):
@@ -720,30 +749,10 @@ class PyEngine:
                        WAKE_CONNECTED if estA else WAKE_ACCEPT, slot,
                        pkt=pkt)
 
-        # --- A2. buffer autotuning at establishment ---
-        if est:
-            peer = int(pkt[P.SRC])
-            v_self = int(self.hp_vertex[host.hid])
-            v_peer = int(self.hp_vertex[min(max(peer, 0), self.H - 1)])
-            rtt_ns = int(self.lat[v_self, v_peer]) + \
-                int(self.lat[v_peer, v_self])
-            peer_up = int(self.hp_bw_up[min(max(peer, 0), self.H - 1)])
-            peer_dn = int(self.hp_bw_down[min(max(peer, 0), self.H - 1)])
-            bw_cap = 1 << 38
-            snd_bw = min(int(self.hp_bw_up[host.hid]), peer_dn, bw_cap)
-            rcv_bw = min(int(self.hp_bw_down[host.hid]), peer_up, bw_cap)
-            rtt_us = rtt_ns // 1000
-            buf_cap = 1 << 30
-            sndbuf_auto = min(max((snd_bw * rtt_us // 1_000_000) * 5 // 4,
-                                  SEND_BUFFER_MIN_SIZE), buf_cap)
-            rcvbuf_auto = min(max((rcv_bw * rtt_us // 1_000_000) * 5 // 4,
-                                  RECV_BUFFER_MIN_SIZE), buf_cap)
-            if peer == host.hid:
-                sndbuf_auto = rcvbuf_auto = 16 * 1024 * 1024
-            sb0 = int(self.hp_sndbuf0[host.hid])
-            rb0 = int(self.hp_rcvbuf0[host.hid])
-            sk["sndbuf"] = sb0 if sb0 >= 0 else sndbuf_auto
-            sk["rcvbuf"] = rb0 if rb0 >= 0 else rcvbuf_auto
+        # --- A2. buffer autotuning: active side on the SYN|ACK; the
+        # passive side tuned at child creation (_accept_syn) ---
+        if estA:
+            self._autotune(host, slot, pkt)
 
         # --- B. ACK processing ---
         conn = state1 >= TCPS_ESTABLISHED
@@ -1362,7 +1371,12 @@ class PyEngine:
             dst = min(max(int(pkt[P.DST]), 0), self.H - 1)
             sv, dv = self.hp_vertex[src], self.hp_vertex[dst]
             rel = np.float32(self.rel[sv, dv])
-            arrival = stime + int(self.lat[sv, dv])
+            lat = int(self.lat[sv, dv])
+            arrival = stime + lat
+            if int(pkt[P.FLAGS]) & P.F_SYN:
+                # one-way latency stamp (engine.window.exchange)
+                pkt = pkt.copy()
+                pkt[P.SEQ] = _i32(lat // 1000)
             u = self._cheap_uniform(self._stream_of(R.DOMAIN_DROP, src),
                                     int(pkt[P.UID]))
             if rel > 0 and u <= rel:

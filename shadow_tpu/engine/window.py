@@ -213,6 +213,13 @@ def exchange(hosts, hp, sh, cfg: EngineConfig):
     lat = sh.lat_ns[sv, dv]
     rel = sh.rel[sv, dv]
     arrival = stimes + lat
+    # handshake segments carry the one-way path latency (us) in SEQ:
+    # the receiver's buffer autotuning reads RTT off the packet
+    # instead of a per-row [V,V] table lookup (net.tcp._autotune)
+    is_syn = (pkts[:, P.FLAGS] & P.F_SYN) != 0
+    pkts = pkts.at[:, P.SEQ].set(
+        jnp.where(is_syn, (lat // 1000).astype(jnp.int32),
+                  pkts[:, P.SEQ]))
 
     # Deterministic per-packet drop roll keyed by the globally unique
     # (src, uid) stamped at NIC emit — the counter-based analogue of

@@ -283,6 +283,14 @@ def tcp_pull(row, hp, sh, now, slot):
     ack_no = rget(row.sk_rcv_nxt, slot).astype(_I32)
     wnd = jnp.minimum(rget(row.sk_rcvbuf, slot), _I64(2**31 - 1)).astype(_I32)
     aux, sack2 = _finack_aux(row, slot)
+    # handshake segments carry this end's bandwidths in AUX (KiB/s,
+    # 16 bits each) — the peer autotunes its buffers from the wire
+    # instead of indexing a replicated [H] table, which under vmap
+    # broadcasts to [H, H] (20 GB at 50k hosts). SYN/SYNACK never
+    # carry SACK blocks (scoreboards are empty at handshake).
+    bw_stamp = ((jnp.minimum(hp.bw_up >> 10, 0xFFFF).astype(_I32) << 16) |
+                jnp.minimum(hp.bw_down >> 10, 0xFFFF).astype(_I32))
+    aux = jnp.where((sel == 1) | (sel == 2), bw_stamp, aux)
 
     # a recovery send stops at the next sacked run (no overlap with
     # bytes the peer already holds) and at the loss boundary
@@ -382,6 +390,45 @@ def _rfc6298(srtt, rttvar, sample):
     return srtt1, rttvar1, rto
 
 
+def _autotune(row, hp, slot, pkt, apply):
+    """Buffer autotuning from a handshake segment (shd-tcp.c:340-433):
+    size the buffers to 1.25x the delay-bandwidth product over the
+    true path (bottleneck of the two ends), min-bounded; loopback
+    pairs get the reference's 16 MiB. Explicit per-host buffer sizes
+    (hp.rcvbuf0/sndbuf0 >= 0) disable autotuning, like the reference's
+    user-set socket buffer options.
+
+    Inputs ride the packet: the peer's up/down bandwidths in AUX
+    (KiB/s halves, stamped by tcp_pull on SYN/SYNACK) and the one-way
+    path latency in SEQ (microseconds, stamped by the exchange —
+    topologies are undirected so RTT = 2x one-way). Table-free by
+    design: per-row dynamic indexing of replicated [H] or [V,V]
+    tables broadcasts them per host under vmap (tens of GB at 50k
+    hosts)."""
+    peer = pkt[P.SRC]
+    rtt_us = 2 * jnp.maximum(pkt[P.SEQ].astype(_I64), 0)
+    peer_up = ((pkt[P.AUX] >> 16) & 0xFFFF).astype(_I64) << 10
+    peer_dn = (pkt[P.AUX] & 0xFFFF).astype(_I64) << 10
+    bw_cap = jnp.int64(1) << 38
+    snd_bw = jnp.minimum(jnp.minimum(hp.bw_up, peer_dn), bw_cap)
+    rcv_bw = jnp.minimum(jnp.minimum(hp.bw_down, peer_up), bw_cap)
+    buf_cap = jnp.int64(1) << 30
+    sndbuf_auto = jnp.clip((snd_bw * rtt_us // 1_000_000) * 5 // 4,
+                           SEND_BUFFER_MIN_SIZE, buf_cap)
+    rcvbuf_auto = jnp.clip((rcv_bw * rtt_us // 1_000_000) * 5 // 4,
+                           RECV_BUFFER_MIN_SIZE, buf_cap)
+    is_loop = peer == hp.hid
+    sndbuf_auto = jnp.where(is_loop, 16 * 1024 * 1024, sndbuf_auto)
+    rcvbuf_auto = jnp.where(is_loop, 16 * 1024 * 1024, rcvbuf_auto)
+    sndbuf1 = jnp.where(hp.sndbuf0 >= 0, hp.sndbuf0, sndbuf_auto)
+    rcvbuf1 = jnp.where(hp.rcvbuf0 >= 0, hp.rcvbuf0, rcvbuf_auto)
+    return _set(row, slot,
+                sk_sndbuf=jnp.where(apply, sndbuf1,
+                                    rget(row.sk_sndbuf, slot)),
+                sk_rcvbuf=jnp.where(apply, rcvbuf1,
+                                    rget(row.sk_rcvbuf, slot)))
+
+
 def _accept_syn(row, hp, sh, now, lslot, pkt):
     """Listener got a SYN: allocate a child connection row in
     SYN_RECEIVED owing a SYN|ACK — the reference's multiplexed-children
@@ -401,6 +448,8 @@ def _accept_syn(row, hp, sh, now, lslot, pkt):
                  sk_peer_rwnd=jnp.maximum(pkt[P.WND].astype(_I64), 1),
                  sk_hs_time=_I64(now),
                  sk_syn_tag=pkt[P.APP])
+        # passive-side autotuning straight from the SYN's stamps
+        r = _autotune(r, hp, child, pkt, jnp.bool_(True))
         return _arm_timer(r, child, now)
 
     return jax.lax.cond(ok, setup,
@@ -457,42 +506,12 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
                         pkt=pkt),
         lambda r: r, row)
 
-    # --- A2. buffer autotuning at establishment (shd-tcp.c:340-433):
-    # size the buffers to 1.25x the delay-bandwidth product over the
-    # true path (bottleneck of the two ends), min-bounded; loopback
-    # pairs get the reference's 16 MiB. Explicit per-host buffer sizes
-    # (hp.rcvbuf0/sndbuf0 >= 0) disable autotuning, like the
-    # reference's user-set socket buffer options.
-    peer = pkt[P.SRC]
-    v_self = hp.vertex
-    v_peer = sh.host_vertex[jnp.clip(peer, 0,
-                                     sh.host_vertex.shape[0] - 1)]
-    rtt_ns = sh.lat_ns[v_self, v_peer] + sh.lat_ns[v_peer, v_self]
-    peer_up = sh.host_bw_up[jnp.clip(peer, 0,
-                                     sh.host_bw_up.shape[0] - 1)]
-    peer_dn = sh.host_bw_down[jnp.clip(peer, 0,
-                                       sh.host_bw_down.shape[0] - 1)]
-    # clamp bandwidth and compute via microseconds so the product
-    # cannot overflow int64 even for "unlimited" (1<<40 B/s) hosts
-    bw_cap = jnp.int64(1) << 38
-    snd_bw = jnp.minimum(jnp.minimum(hp.bw_up, peer_dn), bw_cap)
-    rcv_bw = jnp.minimum(jnp.minimum(hp.bw_down, peer_up), bw_cap)
-    rtt_us = rtt_ns // 1000
-    buf_cap = jnp.int64(1) << 30
-    sndbuf_auto = jnp.clip((snd_bw * rtt_us // 1_000_000) * 5 // 4,
-                           SEND_BUFFER_MIN_SIZE, buf_cap)
-    rcvbuf_auto = jnp.clip((rcv_bw * rtt_us // 1_000_000) * 5 // 4,
-                           RECV_BUFFER_MIN_SIZE, buf_cap)
-    is_loop = peer == hp.hid
-    sndbuf_auto = jnp.where(is_loop, 16 * 1024 * 1024, sndbuf_auto)
-    rcvbuf_auto = jnp.where(is_loop, 16 * 1024 * 1024, rcvbuf_auto)
-    sndbuf1 = jnp.where(hp.sndbuf0 >= 0, hp.sndbuf0, sndbuf_auto)
-    rcvbuf1 = jnp.where(hp.rcvbuf0 >= 0, hp.rcvbuf0, rcvbuf_auto)
-    row = _set(row, slot,
-               sk_sndbuf=jnp.where(est, sndbuf1,
-                                   rget(row.sk_sndbuf, slot)),
-               sk_rcvbuf=jnp.where(est, rcvbuf1,
-                                   rget(row.sk_rcvbuf, slot)))
+    # --- A2. buffer autotuning: the active opener tunes on the
+    # SYN|ACK (estA); the passive side tuned at child creation
+    # (_accept_syn) from the SYN — both read the peer's stamped
+    # bandwidths and the path latency off the handshake packet itself
+    # (see _autotune and the tcp_pull/exchange stamps).
+    row = _autotune(row, hp, slot, pkt, estA)
 
     # --- B. ACK processing ---
     conn = state1 >= TCPS_ESTABLISHED

@@ -97,6 +97,12 @@ def exchange_sharded(hosts, hp, sh, cfg: EngineConfig,
     lat = sh.lat_ns[sv, dv]
     rel = sh.rel[sv, dv]
     arrival = stimes + lat
+    # one-way latency stamp on handshake segments (us, SEQ word) —
+    # identical to the single-chip exchange (net.tcp._autotune)
+    is_syn = (pkts[:, P.FLAGS] & P.F_SYN) != 0
+    pkts = pkts.at[:, P.SEQ].set(
+        jnp.where(is_syn, (lat // 1000).astype(jnp.int32),
+                  pkts[:, P.SEQ]))
 
     # Loss roll at the source (keyed by the globally unique (src, uid),
     # so placement-independent — same rolls as the single-chip run).
