@@ -78,14 +78,26 @@ def _run_compiled(scen, cfg, warm_stop_ns=int(1.2 * 10**9)):
 
 def _run_pyengine(scen, cfg):
     """The measured baseline: the pure-Python engine on the same
-    workload shape, timed end to end."""
+    workload shape, timed end to end.
+
+    Pinned to the CPU backend: the heap engine's per-event eager jnp
+    calls (RNG/float mirrors) would otherwise each round-trip to the
+    accelerator when bench runs on a real chip, understating the
+    baseline by ~500x."""
+    import contextlib
+    import jax
     from shadow_tpu.engine.pyengine import PyEngine
     from shadow_tpu.engine.sim import Simulation
 
-    eng = PyEngine(Simulation(scen, engine_cfg=cfg))
-    t0 = time.perf_counter()
-    stats = eng.run()
-    wall = time.perf_counter() - t0
+    try:
+        ctx = jax.default_device(jax.devices("cpu")[0])
+    except Exception:
+        ctx = contextlib.nullcontext()
+    with ctx:
+        eng = PyEngine(Simulation(scen, engine_cfg=cfg))
+        t0 = time.perf_counter()
+        stats = eng.run()
+        wall = time.perf_counter() - t0
     from shadow_tpu.engine import defs
     events = int(stats[:, defs.ST_EVENTS].sum())
     return {"events": events, "wall_seconds": round(wall, 2),
