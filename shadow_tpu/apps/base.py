@@ -60,13 +60,17 @@ def draw(row, hp, sh):
 def schedule_wake(row, t, reason, sock=-1, aux=0, wnd=0, ln=0):
     """Push a future EV_APP (app timer) for this host. `wnd` and `ln`
     ride the wake's WND/LEN words (socket generation + a small payload
-    — e.g. the tgen watchdog's progress mark)."""
+    — e.g. the tgen watchdog's progress mark). The SRC word carries
+    the scheduling process slot (row.app_proc) so slotless wakes
+    (sock=-1) route back to the same process; sock>=0 wakes route by
+    the socket's owner instead (engine.window._on_app)."""
     wake = jnp.zeros((P.PKT_WORDS,), jnp.int32)
     wake = rset(wake, P.ACK, jnp.int32(reason))
     wake = rset(wake, P.SEQ, jnp.int32(sock))
     wake = rset(wake, P.AUX, jnp.int32(aux))
     wake = rset(wake, P.WND, jnp.int32(wnd))
     wake = rset(wake, P.LEN, jnp.int32(ln))
+    wake = rset(wake, P.SRC, row.app_proc)
     return equeue.q_push(row, t, EV_APP, wake)
 
 

@@ -622,7 +622,13 @@ def app_tgen(row, hp, sh, now, wake):
                       rget(rr.sk_snd_una, slot))
             mark = wake[P.LEN].astype(_I64)
             took = now >= rget(rr.sk_hs_time, slot) + nd[COL_C]
-            stalled = (metric == mark) & (metric > 0)
+            # no metric>0 gate: a transfer that never makes ANY
+            # progress (server never responds after connect) stalls
+            # out one stallout period after arming, matching the
+            # reference's time-since-start stall semantics
+            # (shd-tgen-transfer.c:918-961) instead of waiting for
+            # the full timeout
+            stalled = metric == mark
 
             def rearm(r2):
                 return _wd_arm(r2, now, slot, metric, nd[COL_C],

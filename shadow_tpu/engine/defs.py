@@ -31,6 +31,9 @@ ST_PKTS_RECV = 2       # packets arriving at NIC
 ST_PKTS_DROP_NET = 3   # dropped by topology reliability roll
 ST_PKTS_DROP_BUF = 4   # dropped: receiver NIC input buffer full
 ST_PKTS_DROP_Q = 5     # dropped: destination event queue overflow
+#                        (exchange belt-and-braces only since round 3 —
+#                        arrivals that cannot merge DEFER at the source
+#                        instead; a nonzero value here is an engine bug)
 ST_BYTES_SENT = 6      # payload bytes sent (first transmission)
 ST_BYTES_RECV = 7      # payload bytes received in order (delivered to app)
 ST_RETRANSMIT = 8      # TCP segments retransmitted
@@ -50,4 +53,19 @@ ST_SACK_RENEGE = 19    # receiver OOO scoreboard overflow discarded a
 #                        at the RTO; see net/sack.py insert_counted)
 ST_TGEN_ABORT = 20     # tgen transfers aborted by timeout/stallout
 #                        (shd-tgen-transfer.c:918-961 semantics)
-N_STATS = 21
+ST_DEFER_FANIN = 21    # packets deferred to the next window at the
+#                        SOURCE because the destination's per-window
+#                        intake (incap or queue headroom) was spent —
+#                        exact carry, arrival times unchanged; counted
+#                        per window deferred (a packet carried 3
+#                        windows counts 3). The engine-artifact
+#                        replacement for what used to be a drop: the
+#                        only modeled-semantics drop point is the NIC
+#                        input buffer (ST_PKTS_DROP_BUF,
+#                        shd-network-interface.c:288-311)
+ST_DEFER_A2A = 22      # packets deferred at the source because the
+#                        sharded exchange's per-(src,dst)-shard bucket
+#                        was full (parallel.shard; raise a2acap if this
+#                        grows — deferral is exact but delays delivery
+#                        processing by a window)
+N_STATS = 23

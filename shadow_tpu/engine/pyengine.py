@@ -16,7 +16,8 @@ through the same eager jax.random calls, making float rounding
 identical.
 
 Covered app tiers: the UDP tier (ping, pingserver, phold, gossip) AND
-the TCP tier (bulk, bulkserver, tgen behavior graphs). The TCP machine
+the TCP tier (bulk, bulkserver, tgen behavior graphs, socks
+client/proxy chains — the at-scale flagship). The TCP machine
 here is a per-socket-dict transliteration of net.tcp's masked kernels —
 handshake, data, SACK scoreboard recovery, RTO go-back-N, congestion
 control, FIN/TIME_WAIT — with all float32 congestion math and the SACK
@@ -52,7 +53,8 @@ from .defs import (EV_APP, EV_PKT, EV_NIC_TX, EV_TCP_TIMER, EV_TCP_CLOSE,
                    WAKE_START, WAKE_TIMER, WAKE_SOCKET, WAKE_CONNECTED,
                    WAKE_ACCEPT, WAKE_EOF, WAKE_SENT)
 from ..apps.base import (APP_NULL, APP_PING, APP_PING_SERVER, APP_PHOLD,
-                         APP_GOSSIP, APP_BULK, APP_BULK_SERVER, APP_TGEN)
+                         APP_GOSSIP, APP_BULK, APP_BULK_SERVER, APP_TGEN,
+                         APP_SOCKS_CLIENT, APP_SOCKS_PROXY)
 from ..apps import tgen as TG
 
 AUX_FINACK = 1          # net.tcp.AUX_FINACK
@@ -85,13 +87,14 @@ def _new_sock():
         "peer_rwnd": RECV_BUFFER_SIZE,
         "sndbuf": SEND_BUFFER_SIZE, "rcvbuf": RECV_BUFFER_SIZE,
         "hs_time": 0, "last_tx": 0, "syn_tag": 0, "app_ref": -1,
+        "proc": 0,
         "cc_wmax": np.float32(0.0), "cc_epoch": -1,
         "cc_k": np.float32(0.0),
     }
 
 
 class _Host:
-    def __init__(self, hid, qcap, scap, txqcap, obcap):
+    def __init__(self, hid, qcap, scap, txqcap, obcap, procs=1):
         self.hid = hid
         self.qcap = qcap
         self.events = {}      # slot -> (time, seq, kind, pkt)
@@ -108,7 +111,13 @@ class _Host:
         self.socks = [_new_sock() for _ in range(scap)]
         self.obcap = obcap
         self.outbox = []             # (send_time, pkt)
-        self.app_r = [0] * 8
+        self.ob_next = SIMTIME_MAX   # earliest carried arrival (mirror
+        #                              of Hosts.ob_next)
+        # per-process app registers (engine app_r [H, PP, 8]); app_r
+        # aliases the CURRENT process's list during a dispatch
+        self.app_rp = [[0] * 8 for _ in range(max(procs, 1))]
+        self.app_r = self.app_rp[0]
+        self.cur_proc = 0            # dispatch context (Hosts.app_proc)
         self.tgen_sync = None        # np per-host sync counters (tgen)
         self.free_slots = list(range(qcap))
 
@@ -150,7 +159,8 @@ class PyEngine:
         self.tg_edges = np.asarray(sim.sh.tgen_edges)
 
         self.stats = np.zeros((H, defs.N_STATS), dtype=np.int64)
-        self.hosts = [_Host(h, cfg.qcap, cfg.scap, cfg.txqcap, cfg.obcap)
+        self.hosts = [_Host(h, cfg.qcap, cfg.scap, cfg.txqcap, cfg.obcap,
+                            procs=cfg.procs_per_host)
                       for h in range(H)]
         sync0 = np.asarray(sim.hosts.tgen_sync)
         for h in range(H):
@@ -244,6 +254,7 @@ class PyEngine:
             host.socks[slot]["used"] = True
             host.socks[slot]["proto"] = proto
             host.socks[slot]["timer_gen"] = gen
+            host.socks[slot]["proc"] = host.cur_proc
         return slot, ok
 
     @staticmethod
@@ -703,6 +714,7 @@ class PyEngine:
         sk["rport"] = int(pkt[P.SPORT])
         sk["rhost"] = int(pkt[P.SRC])
         sk["parent"] = lslot
+        sk["proc"] = host.socks[lslot]["proc"]   # inherit owner
         sk["ctl"] = CTL_SYNACK
         sk["cwnd"] = self.tcp_init_wnd
         sk["ssthresh"] = self.tcp_ssthresh0
@@ -721,7 +733,8 @@ class PyEngine:
         seq = int(pkt[P.SEQ])
         ackno = int(pkt[P.ACK])
         ln = int(pkt[P.LEN])
-        finack = (int(pkt[P.AUX]) & AUX_FINACK) != 0
+        # AUX is the bw stamp on handshake segments: FINACK only on ~syn
+        finack = (not syn) and (int(pkt[P.AUX]) & AUX_FINACK) != 0
 
         state0 = sk["state"]
 
@@ -795,7 +808,7 @@ class PyEngine:
             cw_a, ep_a, k_a = CC.on_ack(
                 jnp.int32(self.cc_kind), jnp.float32(cw0), jnp.float32(ss0),
                 jnp.float32(wm0), jnp.int64(ep0), jnp.float32(k0),
-                jnp.int64(npkts), jnp.int64(now))
+                jnp.int64(npkts), jnp.int64(now), jnp.int64(sk["srtt"]))
             cw_a, ep_a, k_a = (np.float32(cw_a), int(ep_a), np.float32(k_a))
         if fast_rx:
             cw_l, ss_l, wm_l, ep_l = CC.on_loss(
@@ -979,7 +992,20 @@ class PyEngine:
 
     # --- apps: UDP tier -----------------------------------------------------
     def _app(self, host, now, wake):
-        kind = int(self.hp_app_kind[host.hid])
+        # process routing mirror (engine.window._on_app): socket wakes
+        # go to the socket's owner, slotless wakes to the SRC-stamped
+        # process slot
+        PP = len(host.app_rp)
+        slot = int(wake[P.SEQ])
+        if PP == 1:
+            proc = 0
+        else:
+            proc = (self._rg(host, slot, "proc", 0) if slot >= 0
+                    else int(wake[P.SRC]))
+            proc = min(max(proc, 0), PP - 1)
+        host.cur_proc = proc
+        host.app_r = host.app_rp[proc]
+        kind = int(self.hp_app_kind[host.hid, proc])
         if kind == APP_PING:
             self._app_ping(host, now, wake)
         elif kind == APP_PING_SERVER:
@@ -994,12 +1020,19 @@ class PyEngine:
             self._app_bulk_server(host, now, wake)
         elif kind == APP_TGEN:
             self._app_tgen(host, now, wake)
+        elif kind == APP_SOCKS_CLIENT:
+            self._app_socks_client(host, now, wake)
+        elif kind == APP_SOCKS_PROXY:
+            self._app_socks_proxy(host, now, wake)
+        host.cur_proc = 0                 # mirror app_proc reset
+        host.app_r = host.app_rp[0]
 
     def _timer(self, host, t, aux=0):
         wake = np.zeros(P.PKT_WORDS, np.int32)
         wake[P.ACK] = WAKE_TIMER
         wake[P.SEQ] = -1
         wake[P.AUX] = np.int32(np.int64(aux) & 0xFFFFFFFF)
+        wake[P.SRC] = host.cur_proc       # route back to this process
         self._q_push(host, t, EV_APP, wake)
 
     @staticmethod
@@ -1007,7 +1040,7 @@ class PyEngine:
         return (t_ns // SIMTIME_ONE_MICROSECOND) % (2**31)
 
     def _app_ping(self, host, now, wake):
-        cfg = self.hp_app_cfg[host.hid]
+        cfg = self.hp_app_cfg[host.hid, host.cur_proc]
         reason = min(max(int(wake[P.ACK]), 0), 2)
         if reason == WAKE_START:
             host.app_r[0] = self._udp_open(host)
@@ -1025,7 +1058,7 @@ class PyEngine:
                 self.stats[host.hid, defs.ST_APP_DONE] += 1
 
     def _ping_send(self, host, now):
-        cfg = self.hp_app_cfg[host.hid]
+        cfg = self.hp_app_cfg[host.hid, host.cur_proc]
         self._udp_sendto(host, now, host.app_r[0], cfg[0], cfg[1], cfg[3],
                          aux=self._us31(now))
         host.app_r[1] += 1
@@ -1034,7 +1067,7 @@ class PyEngine:
             self._timer(host, now + int(cfg[2]))
 
     def _app_ping_server(self, host, now, wake):
-        cfg = self.hp_app_cfg[host.hid]
+        cfg = self.hp_app_cfg[host.hid, host.cur_proc]
         if int(wake[P.ACK]) == WAKE_START:
             host.app_r[0] = self._udp_open(host, port=int(cfg[1]))
         elif int(wake[P.ACK]) == WAKE_SOCKET:
@@ -1044,12 +1077,13 @@ class PyEngine:
 
     def _exp_delay(self, host):
         u = self._draw(host)
-        mean = jnp.float32(float(self.hp_app_cfg[host.hid][2]))
+        mean = jnp.float32(float(
+            self.hp_app_cfg[host.hid, host.cur_proc][2]))
         d = int(jnp.maximum((-mean * jnp.log1p(-u)).astype(jnp.int64), 1))
         return d
 
     def _app_phold(self, host, now, wake):
-        cfg = self.hp_app_cfg[host.hid]
+        cfg = self.hp_app_cfg[host.hid, host.cur_proc]
         reason = min(max(int(wake[P.ACK]), 0), 2)
         if reason == WAKE_START:
             host.app_r[0] = self._udp_open(host, port=int(cfg[1]))
@@ -1070,7 +1104,7 @@ class PyEngine:
     def _relay_gossip(self, host, now, height):
         """Mirror of apps.gossip._relay: always MAX_FANOUT (8) draws,
         identical float32 peer math, sends only the first `fanout`."""
-        cfg = self.hp_app_cfg[host.hid]
+        cfg = self.hp_app_cfg[host.hid, host.cur_proc]
         n = max(int(cfg[0]), 2)
         k = min(max(int(cfg[2]), 0), 8)
         for j in range(8):
@@ -1085,7 +1119,7 @@ class PyEngine:
 
     def _app_gossip(self, host, now, wake):
         """Mirror of apps.gossip.app_gossip (block-gossip workload)."""
-        cfg = self.hp_app_cfg[host.hid]
+        cfg = self.hp_app_cfg[host.hid, host.cur_proc]
         reason = min(max(int(wake[P.ACK]), 0), 2)
         interval = int(cfg[3])
         if reason == WAKE_START:
@@ -1113,7 +1147,7 @@ class PyEngine:
 
     # --- apps: TCP tier (apps.bulk / apps.tgen mirrors) ---------------------
     def _app_bulk(self, host, now, wake):
-        cfg = self.hp_app_cfg[host.hid]
+        cfg = self.hp_app_cfg[host.hid, host.cur_proc]
         reason = min(max(int(wake[P.ACK]), 0), 6)
         sock = _i32(host.app_r[0])
         if reason in (0, 1):        # start / timer -> (re)connect
@@ -1133,7 +1167,7 @@ class PyEngine:
                 self._timer(host, now + int(cfg[4]))
 
     def _app_bulk_server(self, host, now, wake):
-        cfg = self.hp_app_cfg[host.hid]
+        cfg = self.hp_app_cfg[host.hid, host.cur_proc]
         reason = min(max(int(wake[P.ACK]), 0), 6)
         slot = int(wake[P.SEQ])
         if reason == 0:
@@ -1155,6 +1189,116 @@ class PyEngine:
             if fresh and not served_get:
                 self._tcp_close_call(host, now, slot)
                 self.stats[host.hid, defs.ST_XFER_DONE] += 1
+
+    # --- socks proxy chains (apps.socks mirror) -----------------------------
+    def _socks_rand_in(self, host, lo, hi, skip_self=False):
+        """Mirror of apps.socks._rand_in: identical draw order and
+        float32 index math."""
+        u = self._draw(host)
+        n = max(hi - lo, 1)
+        if skip_self:
+            in_pool = (lo <= host.hid < hi) and (n > 1)
+            n_eff = n - (1 if in_pool else 0)
+            idx = min(int(np.int64(u * np.float32(n_eff))), n_eff - 1)
+            if in_pool and (lo + idx >= host.hid):
+                idx += 1
+            return lo + idx
+        return lo + min(int(np.int64(u * np.float32(n))), n - 1)
+
+    @staticmethod
+    def _socks_pack_tag(target, size_u4k, hops=0):
+        return (((hops & 0x3) << 29) | ((target & 0xFFFFF) << 9) |
+                (size_u4k & 0x1FF))
+
+    def _app_socks_client(self, host, now, wake):
+        cfg = self.hp_app_cfg[host.hid, host.cur_proc]
+        reason = min(max(int(wake[P.ACK]), 0), 6)
+        slot = int(wake[P.SEQ])
+        fresh = int(wake[P.WND]) == self._rg(host, slot, "timer_gen", 0)
+        pause = int(cfg[7]) & ((1 << 56) - 1)
+        hops = int(cfg[7]) >> 56
+
+        if reason in (0, 1):            # start / timer -> fetch
+            proxy = self._socks_rand_in(host, int(cfg[0]), int(cfg[1]))
+            server = self._socks_rand_in(host, int(cfg[3]), int(cfg[4]))
+            tag = self._socks_pack_tag(server, int(cfg[5]),
+                                       max(hops - 1, 0))
+            s, ok = self._tcp_connect(host, now, proxy, int(cfg[2]),
+                                      tag=tag)
+            host.app_r[0] = s
+            host.app_r[2] = now
+            if not ok:
+                self._timer(host, now + pause)
+        elif reason == 4:               # eof
+            is_mine = fresh and slot == _i32(host.app_r[0])
+            got_data = self._rg(host, slot, "rcv_nxt", 0) > 0
+            if is_mine and got_data:
+                delay_us = max(now - host.app_r[2], 0) // 1000
+                self._tcp_close_call(host, now, slot)
+                host.app_r[1] += 1
+                self.stats[host.hid, defs.ST_XFER_DONE] += 1
+                self.stats[host.hid, defs.ST_RTT_SUM_US] += delay_us
+                self.stats[host.hid, defs.ST_RTT_COUNT] += 1
+                fin = int(cfg[6]) > 0 and host.app_r[1] >= int(cfg[6])
+                if fin:
+                    self.stats[host.hid, defs.ST_APP_DONE] += 1
+                else:
+                    self._timer(host, now + pause)
+            elif is_mine:               # refused: zero bytes delivered
+                self._tcp_close_call(host, now, slot)
+                self._timer(host, now + pause)
+
+    def _app_socks_proxy(self, host, now, wake):
+        cfg = self.hp_app_cfg[host.hid, host.cur_proc]
+        reason = min(max(int(wake[P.ACK]), 0), 6)
+        slot = int(wake[P.SEQ])
+        fresh = int(wake[P.WND]) == self._rg(host, slot, "timer_gen", 0)
+        paired = self._rg(host, slot, "app_ref", 0)
+        is_child = self._rg(host, slot, "parent", 0) >= 0
+
+        if reason == 0:                 # start: listen
+            lslot, ok = self._tcp_listen(host, int(cfg[1]))
+            host.app_r[0] = (lslot + 1) if ok else 0
+        elif reason == 5:               # accept: SOCKS CONNECT
+            tag = self._rg(host, slot, "syn_tag", 0)
+            hops = (tag >> 29) & 0x3
+            target = (tag >> 9) & 0xFFFFF
+            size = (tag & 0x1FF) << 12
+            n_pool = int(cfg[4]) - int(cfg[3])
+            self_in = int(cfg[3]) <= host.hid < int(cfg[4])
+            has_pool = (n_pool > 1) or (n_pool == 1 and not self_in)
+            extend = (hops > 0) and has_pool
+            if (hops > 0) and not has_pool and fresh:
+                self.stats[host.hid, defs.ST_CHAIN_SHORT] += 1
+            if fresh:
+                nxt = self._socks_rand_in(host, int(cfg[3]), int(cfg[4]),
+                                          skip_self=True)
+                dst = nxt if extend else target
+                dport = int(cfg[1]) if extend else int(cfg[2])
+                otag = (self._socks_pack_tag(target, tag & 0x1FF,
+                                             hops - 1)
+                        if extend else size)
+                onward, ok = self._tcp_connect(host, now, dst, dport,
+                                               tag=otag)
+                if ok:
+                    host.socks[onward]["app_ref"] = slot
+                    host.socks[slot]["app_ref"] = onward
+                else:
+                    self._tcp_close_call(host, now, slot)
+        elif reason == 2:               # data on the onward leg: relay
+            relay = fresh and not is_child and paired >= 0
+            ln = int(wake[P.LEN])
+            if relay and ln > 0:
+                self._tcp_write(host, now, paired, ln)
+        elif reason == 4:               # eof: tear down the pair
+            if fresh:
+                if 0 <= slot < len(host.socks):
+                    host.socks[slot]["app_ref"] = -1
+                if 0 <= paired < len(host.socks):
+                    host.socks[paired]["app_ref"] = -1
+                self._tcp_close_call(host, now, slot)
+                if paired >= 0:
+                    self._tcp_close_call(host, now, paired)
 
     # --- tgen walk (apps.tgen mirror) ---------------------------------------
     def _rg(self, host, slot, key, default=0):
@@ -1296,7 +1440,7 @@ class PyEngine:
     def _app_tgen(self, host, now, wake):
         reason = min(max(int(wake[P.ACK]), 0), 6)
         slot = int(wake[P.SEQ])
-        start_node = int(self.hp_app_cfg[host.hid][0])
+        start_node = int(self.hp_app_cfg[host.hid, host.cur_proc][0])
         fresh = int(wake[P.WND]) == self._rg(host, slot, "timer_gen", 0)
         is_client = fresh and self._rg(host, slot, "app_ref", 0) >= 0
 
@@ -1318,7 +1462,7 @@ class PyEngine:
                 mark = int(wake[P.LEN])
                 took = now >= (self._rg(host, slot, "hs_time", 0) +
                                int(nd[TG.COL_C]))
-                stalled = metric == mark and metric > 0
+                stalled = metric == mark
                 if live and (took or stalled):
                     host.socks[slot]["app_ref"] = -1
                     self.stats[host.hid, defs.ST_TGEN_ABORT] += 1
@@ -1359,15 +1503,24 @@ class PyEngine:
 
     # --- exchange (identical math to engine.window.exchange) ---
     def _exchange(self):
+        """Route/loss-roll/deliver this window's outboxes. Mirrors the
+        round-3 deferral semantics: a destination takes at most
+        min(incap, queue headroom) arrivals per window (headroom =
+        free slots - reserve, but never below one when any slot is
+        free); the rest STAY in the source outbox with unchanged send
+        times and re-exchange next window (ST_DEFER_FANIN). Returns
+        the number of packets that departed an outbox (delivered or
+        reliability-dropped) — the engines' shared progress signal."""
         all_pkts = []  # (global outbox order) host-major
         for host in self.hosts:
-            for stime, pkt in host.outbox:
-                all_pkts.append((host.hid, stime, pkt))
-            host.outbox = []
+            for i, (stime, pkt) in enumerate(host.outbox):
+                all_pkts.append([host.hid, i, stime, pkt, None, False])
         if not all_pkts:
-            return
-        delivered = {}  # dst -> list of (arrival, pkt) in source order
-        for src, stime, pkt in all_pkts:
+            return 0
+        delivered = {}  # dst -> list of entry refs, in source order
+        departed = 0
+        for ent in all_pkts:
+            src, _i, stime, pkt = ent[0], ent[1], ent[2], ent[3]
             dst = min(max(int(pkt[P.DST]), 0), self.H - 1)
             sv, dv = self.hp_vertex[src], self.hp_vertex[dst]
             rel = np.float32(self.rel[sv, dv])
@@ -1377,26 +1530,42 @@ class PyEngine:
                 # one-way latency stamp (engine.window.exchange)
                 pkt = pkt.copy()
                 pkt[P.SEQ] = _i32(lat // 1000)
+                ent[3] = pkt
+            ent[4] = arrival
             u = self._cheap_uniform(self._stream_of(R.DOMAIN_DROP, src),
                                     int(pkt[P.UID]))
             if rel > 0 and u <= rel:
-                delivered.setdefault(dst, []).append((arrival, pkt))
+                delivered.setdefault(dst, []).append(ent)
             else:
                 self.stats[src, defs.ST_PKTS_DROP_NET] += 1
+                ent[5] = True        # departed (lost on the wire)
+                departed += 1
         for dst, lst in delivered.items():
             host = self.hosts[dst]
-            accepted = lst[: self.cfg.incap]
-            self.stats[dst, defs.ST_PKTS_DROP_Q] += len(lst) - len(accepted)
-            k = len(accepted)
             nfree = len(host.free_slots)
-            k2 = min(k, max(nfree - self.reserve, 0))
-            self.stats[dst, defs.ST_PKTS_DROP_Q] += k - k2
-            for arrival, pkt in accepted[:k2]:
+            allow = min(self.cfg.incap,
+                        max(nfree - self.reserve, min(nfree, 1)))
+            for ent in lst[:allow]:
                 slot = min(host.free_slots)
                 host.free_slots.remove(slot)
-                host.events[slot] = (arrival, host.eq_ctr, EV_PKT,
-                                     pkt.copy())
+                host.events[slot] = (ent[4], host.eq_ctr, EV_PKT,
+                                     ent[3].copy())
                 host.eq_ctr += 1
+                ent[5] = True
+                departed += 1
+        # source-side carry: everything not departed stays, original
+        # order; earliest carried arrival bounds the window advance
+        for host in self.hosts:
+            host.outbox = []
+            host.ob_next = SIMTIME_MAX
+        for ent in all_pkts:
+            if not ent[5]:
+                src = ent[0]
+                host = self.hosts[src]
+                host.outbox.append((ent[2], ent[3]))
+                host.ob_next = min(host.ob_next, ent[4])
+                self.stats[src, defs.ST_DEFER_FANIN] += 1
+        return departed
 
     # --- main loop ---
     def run(self):
@@ -1404,6 +1573,7 @@ class PyEngine:
         windows = 0
         while nt < self.stop and nt < SIMTIME_MAX:
             wend = min(nt + self.min_jump, self.stop)
+            executed = False
             progressed = True
             while progressed:
                 progressed = False
@@ -1422,8 +1592,16 @@ class PyEngine:
                         elif kind == EV_TCP_CLOSE:
                             self._on_tcp_close(host, t, pkt)
                         progressed = True
-            self._exchange()
+                        executed = True
+            shipped = self._exchange()
             windows += 1
-            nt = min(self._next_time(h) for h in self.hosts)
+            nt_eq = min(self._next_time(h) for h in self.hosts)
+            if executed or shipped:
+                # window-advance bound includes carried arrivals
+                nt = min(nt_eq, min(h.ob_next for h in self.hosts))
+            else:
+                # anti-livelock (engine.window.win_body): advance to
+                # the earliest queue event so jammed queues drain
+                nt = nt_eq
         self.windows = windows
         return self.stats

@@ -65,7 +65,10 @@ class SimReport:
         """End-of-run capacity accounting (the reference's
         ObjectCounter shutdown report, shd-slave.c:207-211, recast for
         fixed arrays): per array, configured capacity, peak occupancy
-        across hosts, and events lost to overflow."""
+        across hosts, and events lost to overflow — each overflow
+        class named separately. Cross-host arrivals never drop on
+        capacity (they defer at the source, `deferred` column); the
+        drop columns cover local pushes and the NIC rings only."""
         drops = {
             "event_queue": (self.total(defs.ST_PKTS_DROP_Q) +
                             self.total(defs.ST_EQ_FULL_LOCAL)),
@@ -73,10 +76,15 @@ class SimReport:
             "outbox": self.total(defs.ST_OUTBOX_DROP),
             "nic_txq": self.total(defs.ST_TXQ_DROP),
         }
+        defers = {
+            "event_queue": self.total(defs.ST_DEFER_FANIN),
+            "outbox": self.total(defs.ST_DEFER_A2A),
+        }
         out = []
         for name, cap, peak in self.capacity.get("rows", []):
             out.append({"array": name, "capacity": cap, "peak": peak,
-                        "overflow": drops.get(name, 0)})
+                        "overflow": drops.get(name, 0),
+                        "deferred": defers.get(name, 0)})
         return out
 
     def summary(self) -> dict:
@@ -95,6 +103,8 @@ class SimReport:
             "drop_net": self.total(defs.ST_PKTS_DROP_NET),
             "drop_buf": self.total(defs.ST_PKTS_DROP_BUF),
             "drop_q": self.total(defs.ST_PKTS_DROP_Q),
+            "defer_fanin": self.total(defs.ST_DEFER_FANIN),
+            "defer_a2a": self.total(defs.ST_DEFER_A2A),
             "bytes_recv": self.total(defs.ST_BYTES_RECV),
             "retransmits": self.total(defs.ST_RETRANSMIT),
             "sack_reneges": self.total(defs.ST_SACK_RENEGE),
@@ -195,10 +205,20 @@ class Simulation:
         cpu_threshold = np.full(H, -1, dtype=np.int64)
         rcvbuf0 = np.full(H, -1, dtype=np.int64)   # -1 = autotune
         sndbuf0 = np.full(H, -1, dtype=np.int64)
-        app_kind = np.zeros(H, dtype=np.int32)
-        app_cfg = np.zeros((H, 8), dtype=np.int64)
-        start_times = np.zeros((H,), dtype=np.int64)
-        has_app = np.zeros(H, dtype=bool)
+        # process slots: the reference's per-host process LIST
+        # (shd-configuration.h:36-95, slave_addNewVirtualProcess
+        # shd-slave.c:293) — e.g. a Tor host runs tor + tgen together
+        PP = max((len(s.processes) for _, _, s in
+                  scenario.expand_hosts() if s.processes), default=1)
+        PP = max(PP, 1)
+        if self.cfg.procs_per_host < PP:
+            import dataclasses as _dc
+            self.cfg = _dc.replace(self.cfg, procs_per_host=PP)
+        PP = self.cfg.procs_per_host
+        app_kind = np.zeros((H, PP), dtype=np.int32)
+        app_cfg = np.zeros((H, PP, 8), dtype=np.int64)
+        start_times = np.zeros((H, PP), dtype=np.int64)
+        has_app = np.zeros((H, PP), dtype=bool)
         pcap_on = np.zeros(H, dtype=bool)
 
         from ..apps.tgen import TgenTables
@@ -234,28 +254,22 @@ class Simulation:
                         "for it\n")
                 cpu_cost[idx] = cost
                 cpu_threshold[idx] = scenario.cpu_threshold_ns
-            if spec.processes:
-                # One process per host: the modeled-app tier binds the
-                # host's behavior machine to one app kind. The bundled
-                # workloads express combined roles in a single process
-                # (a tgen graph can be server AND client, like the
-                # reference's tgen); refuse ambiguous configs loudly
-                # rather than silently dropping processes.
-                if len(spec.processes) > 1:
-                    raise NotImplementedError(
-                        f"host {name!r} declares {len(spec.processes)} "
-                        "processes; this engine runs one process per "
-                        "host (combine roles in one behavior graph, "
-                        "or split the host)")
-                proc = spec.processes[0]
+            for p, proc in enumerate(spec.processes):
                 kind, cfg_words = compile_app(proc.plugin, proc.arguments,
                                               self.dns, H,
                                               tgen_tables=tgen_tables)
-                app_kind[idx] = kind
-                app_cfg[idx] = cfg_words
-                start_times[idx] = proc.start_time
-                has_app[idx] = True
+                app_kind[idx, p] = kind
+                app_cfg[idx, p] = cfg_words
+                start_times[idx, p] = proc.start_time
+                has_app[idx, p] = True
                 if proc.plugin.startswith("hosted:"):
+                    if len(spec.processes) > 1:
+                        # hosted op replay runs outside the dispatch
+                        # context, so its sockets would bind to slot 0
+                        raise NotImplementedError(
+                            f"host {name!r} mixes a hosted process "
+                            "with other processes; hosted apps must "
+                            "be their host's only process")
                     hosted_specs.append(
                         (idx, name, proc.plugin[len("hosted:"):],
                          proc.arguments))
@@ -264,9 +278,10 @@ class Simulation:
         # its datagrams silently — validate here, where the whole
         # scenario is visible (apps/compile.py only sees one process).
         from ..apps.base import APP_GOSSIP as _APP_GOSSIP
-        gossip_mask = (app_kind == _APP_GOSSIP) & has_app
+        gossip_mask = ((app_kind == _APP_GOSSIP) & has_app).any(axis=1)
         if gossip_mask.any():
-            n_draw = int(app_cfg[gossip_mask, 0].max())
+            gsel = (app_kind == _APP_GOSSIP) & has_app
+            n_draw = int(app_cfg[gsel, 0].max())
             bad = int((~gossip_mask[:n_draw]).sum())
             if bad:
                 import sys as _sys
@@ -288,7 +303,8 @@ class Simulation:
             from ..apps.base import (APP_TGEN, APP_BULK, APP_BULK_SERVER,
                                      APP_HOSTED, APP_SOCKS_CLIENT,
                                      APP_SOCKS_PROXY)
-            kinds = tuple(sorted(set(int(k) for k in app_kind.tolist())))
+            kinds = tuple(sorted(set(
+                int(k) for k in app_kind.reshape(-1).tolist())))
             tcp_kinds = {APP_TGEN, APP_BULK, APP_BULK_SERVER, APP_HOSTED,
                          APP_SOCKS_CLIENT, APP_SOCKS_PROXY}
             self.cfg = _dc.replace(
@@ -350,21 +366,27 @@ class Simulation:
                               host_vertex=vertex,
                               host_bw_up=bw_up, host_bw_down=bw_down)
 
-        # --- initial events: process starts (reference process_schedule) ---
+        # --- initial events: process starts (reference process_schedule;
+        # one start event per process slot, in slot order) ---
         hosts = alloc_hosts(self.cfg)
         eq_time = np.array(hosts.eq_time)
         eq_kind = np.array(hosts.eq_kind)
         eq_pkt = np.array(hosts.eq_pkt)
+        eq_seq = np.array(hosts.eq_seq)
         eq_ctr = np.array(hosts.eq_ctr)
-        idxs = np.flatnonzero(has_app)
-        eq_time[idxs, 0] = start_times[idxs]
-        eq_kind[idxs, 0] = EV_APP
-        eq_pkt[idxs, 0, P.ACK] = WAKE_START
-        eq_pkt[idxs, 0, P.SEQ] = -1
-        eq_ctr[idxs] = 1
+        for p in range(PP):
+            idxs = np.flatnonzero(has_app[:, p])
+            eq_time[idxs, p] = start_times[idxs, p]
+            eq_kind[idxs, p] = EV_APP
+            eq_seq[idxs, p] = eq_ctr[idxs]
+            eq_pkt[idxs, p, P.ACK] = WAKE_START
+            eq_pkt[idxs, p, P.SEQ] = -1
+            eq_pkt[idxs, p, P.SRC] = p      # slotless wake: proc slot
+            eq_ctr[idxs] += 1
         self.hosts = hosts.replace(
             eq_time=jnp.asarray(eq_time), eq_kind=jnp.asarray(eq_kind),
-            eq_pkt=jnp.asarray(eq_pkt), eq_ctr=jnp.asarray(eq_ctr))
+            eq_seq=jnp.asarray(eq_seq), eq_pkt=jnp.asarray(eq_pkt),
+            eq_ctr=jnp.asarray(eq_ctr))
 
         self._ran = False
 
@@ -395,10 +417,14 @@ class Simulation:
                                    jnp.ones(pad, jnp.int64)]),
             bw_down=jnp.concatenate([self.hp.bw_down,
                                      jnp.ones(pad, jnp.int64)]),
-            app_kind=jnp.concatenate([self.hp.app_kind,
-                                      jnp.zeros(pad, jnp.int32)]),
-            app_cfg=jnp.concatenate([self.hp.app_cfg,
-                                     jnp.zeros((pad, 8), jnp.int64)]),
+            app_kind=jnp.concatenate([
+                self.hp.app_kind,
+                jnp.zeros((pad,) + self.hp.app_kind.shape[1:],
+                          jnp.int32)]),
+            app_cfg=jnp.concatenate([
+                self.hp.app_cfg,
+                jnp.zeros((pad,) + self.hp.app_cfg.shape[1:],
+                          jnp.int64)]),
             nic_buf=jnp.concatenate([self.hp.nic_buf,
                                      jnp.ones(pad, jnp.int64)]),
             cpu_cost=jnp.concatenate([self.hp.cpu_cost,
@@ -536,7 +562,10 @@ class Simulation:
                         "overflow; raise EngineConfig.hostedcap")
                 # ops may have queued events earlier than the next
                 # window the engine computed — re-derive the window
-                nt = jnp.min(hosts.eq_time)
+                # (carried outbox arrivals count, engine.window.
+                # next_wakeup)
+                nt = jnp.minimum(jnp.min(hosts.eq_time),
+                                 jnp.min(hosts.ob_next))
                 wstart = nt
                 wend = jnp.where(nt == SIMTIME_MAX, nt, nt + sh.min_jump)
                 ws = int(wstart)

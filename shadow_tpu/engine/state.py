@@ -67,14 +67,33 @@ class EngineConfig:
     #   0 disables tracing entirely — the exchange compiles no trace code)
     synccap: int = 1        # tgen synchronize-barrier counters per host
     #   (sized by the Simulation to the compiled graphs' sync-node count)
+    procs_per_host: int = 1  # process slots per host (the reference
+    #   runs a process LIST per host, shd-configuration.h:36-95,
+    #   slave_addNewVirtualProcess shd-slave.c:293 — e.g. tor + tgen
+    #   on one machine). Each process has its own app kind/cfg/
+    #   registers ([H, P] rows); sockets remember their owning process
+    #   (sk_proc) so wakes route back to it. Sized by the Simulation
+    #   to the scenario's max process count.
     exchange_a2a: bool = True  # sharded exchange protocol: bucketed
     #   ragged all-to-all (v2, per-shard wire bytes ~flat in shard
     #   count) vs the v1 all_gather (O(shards x outbox); set False to
     #   fall back). Single-chip runs ignore this.
     a2acap: int = 0         # all-to-all bucket slots per (src shard ->
     #   dst shard) pair; 0 = auto (4x the uniform-traffic share,
-    #   clamped to the shard outbox). Bucket overflow is counted in
-    #   ST_PKTS_DROP_Q (see parallel.shard.exchange_sharded).
+    #   clamped to the shard outbox). Bucket overflow DEFERS the tail
+    #   at the source (counted in ST_DEFER_A2A; see
+    #   parallel.shard.exchange_sharded).
+    active_block: int = 0   # active-set compaction: when > 0, a
+    #   lockstep pass with <= this many ready hosts gathers just those
+    #   rows, steps them, and scatters back instead of paying a full
+    #   all-hosts pass — the TPU-native analogue of the reference's
+    #   host-steal load balancing (shd-scheduler-policy-host-steal.c:
+    #   163-191): a single busy relay no longer charges every idle
+    #   host one pass per event. Passes with more ready hosts than
+    #   this use the dense all-hosts step (engine.window.
+    #   step_window_pass). 0 disables (always dense). Bit-identical
+    #   either way: hosts only interact at window boundaries, so
+    #   per-host (time, seq) execution order is unchanged.
 
 
 @chex.dataclass
@@ -147,6 +166,9 @@ class Hosts:
     sk_hs_time: jnp.ndarray  # i64 handshake start (connect timeout/rtt)
     sk_last_tx: jnp.ndarray  # i64 last NIC service time (fifo qdisc key)
     sk_syn_tag: jnp.ndarray  # i32 connection-metadata tag carried on SYN
+    sk_proc: jnp.ndarray     # i32 owning process slot (socket wakes
+    #   route to this process's app — the analogue of the reference's
+    #   descriptor-to-process ownership)
     sk_app_ref: jnp.ndarray  # i32 app-owner reference for client sockets
     #   (tgen: the behavior node whose transfer rides this socket; -1
     #   for server children and non-app sockets)
@@ -154,14 +176,26 @@ class Hosts:
     sk_cc_wmax: jnp.ndarray   # f32 window before last loss
     sk_cc_epoch: jnp.ndarray  # i64 start of current cubic epoch (-1)
     sk_cc_k: jnp.ndarray      # f32 cubic K (seconds to plateau)
-    # --- app layer (vectorized behavior machines) ---
-    app_node: jnp.ndarray  # [H] i32 current behavior-graph node / phase
-    app_r: jnp.ndarray     # [H, 8] i64 app registers
+    # --- app layer (vectorized behavior machines; one row per
+    # process slot) ---
+    app_node: jnp.ndarray  # [H, PP] i32 behavior-graph node / phase
+    app_r: jnp.ndarray     # [H, PP, 8] i64 app registers
+    app_proc: jnp.ndarray  # [H] i32 process context during an EV_APP
+    #   dispatch: pushes made by the running app (timers, socket
+    #   allocations) are stamped with it so their wakes return to the
+    #   same process; 0 between dispatches
     tgen_sync: jnp.ndarray  # [H, SY] i32 synchronize-barrier arrival counts
-    # --- outbox: packets emitted this window awaiting exchange ---
+    # --- outbox: packets emitted this window awaiting exchange.
+    # Packets the destination could not take this window (per-window
+    # intake or queue headroom spent) STAY here and re-exchange next
+    # window with unchanged send times — exact deferral, see
+    # window.exchange ---
     ob_pkt: jnp.ndarray    # [H, O, PKT_WORDS] i32
     ob_time: jnp.ndarray   # [H, O] i64 send (wire-entry) time
     ob_cnt: jnp.ndarray    # [H] i32
+    ob_next: jnp.ndarray   # [H] i64 earliest ARRIVAL time among carried
+    #   packets (SIMTIME_MAX when none) — folded into the window-advance
+    #   minimum so a deferred delivery reopens the window
     # --- hosted-app wake ring (hosting.bridge; drained per window) ---
     hw_time: jnp.ndarray   # [H, HW] i64 wake event times
     hw_pkt: jnp.ndarray    # [H, HW, PKT_WORDS] i32 wake payloads
@@ -190,8 +224,9 @@ class HostParams:
     vertex: jnp.ndarray     # [H] i32 topology attachment
     bw_up: jnp.ndarray      # [H] i64 bytes/sec uplink
     bw_down: jnp.ndarray    # [H] i64 bytes/sec downlink
-    app_kind: jnp.ndarray   # [H] i32 which app runs here (apps registry)
-    app_cfg: jnp.ndarray    # [H, 8] i64 app static params
+    app_kind: jnp.ndarray   # [H, PP] i32 app per process slot (apps
+    #   registry; APP_NULL = empty slot)
+    app_cfg: jnp.ndarray    # [H, PP, 8] i64 app static params
     nic_buf: jnp.ndarray    # [H] i64 NIC input buffer bytes
     cpu_cost: jnp.ndarray   # [H] i64 modeled CPU ns per executed event
     #   (= base event cost x frequencyRatio, precision-rounded at
@@ -299,16 +334,19 @@ def alloc_hosts(cfg: EngineConfig) -> Hosts:
         sk_hs_time=full((H, S), 0, jnp.int64),
         sk_last_tx=full((H, S), 0, jnp.int64),
         sk_syn_tag=full((H, S), 0, jnp.int32),
+        sk_proc=full((H, S), 0, jnp.int32),
         sk_app_ref=full((H, S), -1, jnp.int32),
         sk_cc_wmax=full((H, S), 0.0, jnp.float32),
         sk_cc_epoch=full((H, S), -1, jnp.int64),
         sk_cc_k=full((H, S), 0.0, jnp.float32),
-        app_node=full((H,), 0, jnp.int32),
-        app_r=full((H, 8), 0, jnp.int64),
+        app_node=full((H, max(cfg.procs_per_host, 1)), 0, jnp.int32),
+        app_r=full((H, max(cfg.procs_per_host, 1), 8), 0, jnp.int64),
+        app_proc=full((H,), 0, jnp.int32),
         tgen_sync=full((H, max(cfg.synccap, 1)), 0, jnp.int32),
         ob_pkt=full((H, O, PKT_WORDS), 0, jnp.int32),
         ob_time=full((H, O), 0, jnp.int64),
         ob_cnt=full((H,), 0, jnp.int32),
+        ob_next=full((H,), SIMTIME_MAX, jnp.int64),
         hw_time=full((H, max(cfg.hostedcap, 1)), 0, jnp.int64),
         hw_pkt=full((H, max(cfg.hostedcap, 1), PKT_WORDS), 0, jnp.int32),
         hw_cnt=full((H,), 0, jnp.int32),

@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import rng as R
-from ..core.rowops import radd, rget
+from ..core.rowops import radd, rget, rset
 from ..core.simtime import SIMTIME_MAX
 from ..net import nic
 from ..net import packet as P
@@ -44,7 +44,7 @@ from ..apps.base import dispatch as app_dispatch
 from . import equeue
 from .defs import (EV_NULL, EV_APP, EV_PKT, EV_NIC_TX, EV_TCP_TIMER,
                    EV_TCP_CLOSE, ST_EVENTS, ST_PKTS_RECV, ST_PKTS_DROP_NET,
-                   ST_PKTS_DROP_Q)
+                   ST_PKTS_DROP_Q, ST_DEFER_FANIN)
 from .state import EngineConfig
 
 
@@ -63,8 +63,37 @@ def _make_handlers(cfg: EngineConfig):
     machine compile to nothing."""
 
     def _on_app(row, hp, sh, now, wend, pkt):
-        return app_dispatch(row, hp, sh, now, pkt,
+        # Multi-process routing (reference: process list per host,
+        # shd-configuration.h:36-95): a wake belongs to the process
+        # that owns its socket (sk_proc), or to the process stamped in
+        # the SRC word for slotless timer/start wakes. The app then
+        # runs against a single-process VIEW of the [P]-shaped app
+        # state, so app code is process-count agnostic.
+        PP = row.app_node.shape[0]
+        if PP == 1:
+            vrow = row.replace(app_node=row.app_node[0],
+                               app_r=row.app_r[0])
+            vhp = hp.replace(app_kind=hp.app_kind[0],
+                             app_cfg=hp.app_cfg[0])
+            vrow = app_dispatch(vrow, vhp, sh, now, pkt,
+                                app_kinds=cfg.app_kinds)
+            return vrow.replace(app_node=row.app_node.at[0].set(
+                                    vrow.app_node),
+                                app_r=row.app_r.at[0].set(vrow.app_r))
+        slot = pkt[P.SEQ]
+        proc = jnp.clip(jnp.where(slot >= 0, rget(row.sk_proc, slot),
+                                  pkt[P.SRC]), 0, PP - 1)
+        vrow = row.replace(app_node=rget(row.app_node, proc),
+                           app_r=rget(row.app_r, proc),
+                           app_proc=proc.astype(jnp.int32))
+        vhp = hp.replace(app_kind=rget(hp.app_kind, proc),
+                         app_cfg=rget(hp.app_cfg, proc))
+        vrow = app_dispatch(vrow, vhp, sh, now, pkt,
                             app_kinds=cfg.app_kinds)
+        return vrow.replace(
+            app_node=rset(row.app_node, proc, vrow.app_node),
+            app_r=rset(row.app_r, proc, vrow.app_r),
+            app_proc=jnp.int32(0))
 
     def _on_pkt(row, hp, sh, now, wend, pkt):
         """Packet arrival at the NIC: admission, demux, protocol
@@ -175,6 +204,54 @@ def step_all_hosts(hosts, hp, sh, wend, cfg: EngineConfig):
     return jax.vmap(f)(hosts, hp)
 
 
+def step_window_pass(hosts, hp, sh, wend, cfg: EngineConfig):
+    """One lockstep pass with active-set compaction (cfg.active_block).
+
+    The dense pass pays O(H x row-state) per iteration even when one
+    busy host is the only one with events left in the window — the
+    lockstep-skew cost that made at-scale TCP runs follow the busiest
+    relay (the round-2 diagnosis; the reference solves the same skew by
+    migrating hosts between threads, shd-scheduler-policy-host-steal.c:
+    163-191,266-299). Here: count the ready hosts; if at most K =
+    active_block are ready, gather exactly those rows, step only them,
+    scatter back — O(K x row-state) — else fall back to the dense
+    all-hosts step (which executes one event on EVERY ready host, so
+    it is strictly better when most hosts are busy).
+
+    Exactness: hosts interact only at window boundaries (loopback
+    delivery is host-local), so any per-pass subset schedule that
+    steps each host's own events in (time, seq) order produces
+    bit-identical state — and a not-ready row's step is the identity,
+    which makes dummy gather slots (duplicates of one not-ready host)
+    harmless: every duplicate scatter-back writes identical bytes.
+    """
+    H = hosts.eq_ctr.shape[0]
+    K = min(cfg.active_block, H)
+    ready = jnp.min(hosts.eq_time, axis=1) < wend     # [H]
+    nready = jnp.sum(ready, dtype=jnp.int32)
+
+    def dense(h):
+        return step_all_hosts(h, hp, sh, wend, cfg)
+
+    def sparse(h):
+        rank = jnp.cumsum(ready) - 1
+        take = ready & (rank < K)
+        tgt = jnp.where(take, rank, K).astype(jnp.int32)
+        hid = jnp.arange(H, dtype=jnp.int32)
+        # dummy slots point at the first NOT-ready host: whenever a
+        # dummy is needed (nready < K), one exists (nready < H), and
+        # its step is the identity (see docstring)
+        dummy = jnp.argmin(ready).astype(jnp.int32)
+        idx = jnp.full((K,), dummy, jnp.int32).at[tgt].set(
+            hid, mode="drop")
+        sub = jax.tree.map(lambda a: a[idx], h)
+        shp = jax.tree.map(lambda a: a[idx], hp)
+        stepped = step_all_hosts(sub, shp, sh, wend, cfg)
+        return jax.tree.map(lambda a, s: a.at[idx].set(s), h, stepped)
+
+    return jax.lax.cond(nready > K, dense, sparse, hosts)
+
+
 # --- Window-boundary packet exchange --------------------------------------
 
 def _trace_append(row, pkts, times, valid, dirv, on):
@@ -198,7 +275,16 @@ def _trace_append(row, pkts, times, valid, dirv, on):
 
 def exchange(hosts, hp, sh, cfg: EngineConfig):
     """Route, loss-roll and deliver all outbox packets into destination
-    event queues. Pure array program; runs once per window."""
+    event queues. Pure array program; runs once per window.
+
+    Round-3 deferral semantics: a packet whose destination cannot take
+    it this window (per-window intake budget or queue headroom spent)
+    STAYS in the source outbox and re-exchanges next window with its
+    send time — and therefore its arrival time — unchanged. Exact
+    carry, never a drop: the only modeled drop points are the topology
+    reliability roll here and the NIC input buffer
+    (shd-network-interface.c:288-311). Engine-capacity pressure shows
+    up as ST_DEFER_FANIN, not as lost packets."""
     H, O, IN = cfg.num_hosts, cfg.obcap, cfg.incap
     N = H * O
 
@@ -224,6 +310,8 @@ def exchange(hosts, hp, sh, cfg: EngineConfig):
     # Deterministic per-packet drop roll keyed by the globally unique
     # (src, uid) stamped at NIC emit — the counter-based analogue of
     # worker_sendPacket's reliability test (shd-worker.c:238-244).
+    # A carried packet re-rolls with the SAME (src, uid) key, so the
+    # roll is stable across deferrals.
     u = R.cheap_uniform(R.stream_of(sh.seed32, R.DOMAIN_DROP, src),
                         pkts[:, P.UID])
 
@@ -240,70 +328,125 @@ def exchange(hosts, hp, sh, cfg: EngineConfig):
     sortkey = jnp.where(deliver, dst, H)
     order = jnp.argsort(sortkey, stable=True)
     sdst = sortkey[order]
-    hosts, in_pkt, in_time = _deliver_dense(
-        hosts, order, sdst, pkts, arrival, net_dropped, O, IN)
+    hosts, in_pkt, in_time, kept_sorted = _deliver_dense(
+        hosts, order, sdst, pkts, arrival, net_dropped, O, IN, cfg)
 
-    hosts = trace_and_merge(hosts, hp, cfg, in_pkt, in_time)
-    return hosts.replace(ob_cnt=jnp.zeros_like(hosts.ob_cnt))
+    # tx trace records cover only packets that actually depart this
+    # window (a carried packet is traced in the window it ships)
+    kept = jnp.zeros((N,), jnp.bool_).at[order].set(kept_sorted)
+    hosts = _trace_tx(hosts, hp, cfg, pkts, stimes,
+                      (kept | net_dropped).reshape(H, O))
+    stay = deliver & ~kept
+    hosts = hosts.replace(stats=hosts.stats.at[:, ST_DEFER_FANIN].add(
+        jnp.sum(stay.reshape(H, O), axis=1, dtype=jnp.int64)))
+    hosts = _carry_outbox(hosts, pkts, stimes, arrival, stay, O)
+    hosts = merge_arrivals(hosts, hp, cfg, in_pkt, in_time)
+    return hosts
 
 
 def _deliver_dense(hosts, order, sdst, pkts, arrival, net_dropped,
-                   O, IN, lo=0):
+                   O, IN, cfg: EngineConfig, lo=0):
     """Shared gather-based delivery construction for both exchanges.
     `order`/`sdst` sort the (possibly gathered) global packet list by
     destination; builds this block's [Hl, IN] inbound buffers for hosts
-    [lo, lo+Hl) plus the drop statistics (reshape-sums, no scatters).
-    `net_dropped` is this block's local outbox drop mask ([Hl*O])."""
+    [lo, lo+Hl) (reshape-sums, no scatters). `net_dropped` is this
+    block's local outbox drop mask ([Hl*O]).
+
+    Per-destination intake = min(IN, queue headroom): the IN window
+    budget, bounded by the free event-queue slots less the reserve for
+    protocol-internal pushes — but never less than one arrival when
+    any slot is free, so a jammed destination still makes progress
+    (no livelock). Returns kept_sorted, the accepted mask over the
+    sorted list (False for entries destined outside this block), which
+    the caller turns into source-side carries."""
     N = sdst.shape[0]
     Hl = hosts.stats.shape[0]
     dsts = lo + jnp.arange(Hl, dtype=sdst.dtype)
     first_of = jnp.searchsorted(sdst, dsts, side="left")
     count_of = jnp.searchsorted(sdst, dsts, side="right") - first_of
 
+    reserve = min(8, cfg.qcap // 4)
+    nfree = jnp.sum(hosts.eq_time == SIMTIME_MAX, axis=1,
+                    dtype=jnp.int32)
+    allow = jnp.minimum(IN, jnp.maximum(nfree - reserve,
+                                        jnp.minimum(nfree, 1)))
+    take_of = jnp.minimum(count_of, allow)
+
     r = jnp.arange(IN)
     j = jnp.clip(first_of[:, None] + r[None, :], 0, N - 1)  # [Hl, IN]
     oj = order[j]
-    cell_ok = r[None, :] < jnp.minimum(count_of, IN)[:, None]
+    cell_ok = r[None, :] < take_of[:, None]
     in_time = jnp.where(cell_ok, arrival[oj], SIMTIME_MAX)
     in_pkt = jnp.where(cell_ok[:, :, None], pkts[oj], jnp.int32(0))
+
+    # accepted flags in the sorted domain: rank within my dest block
+    # < that dest's intake
+    db = sdst - lo
+    inblock = (db >= 0) & (db < Hl)
+    dbc = jnp.clip(db, 0, Hl - 1)
+    rank = jnp.arange(N) - first_of[dbc]
+    kept_sorted = inblock & (rank < take_of[dbc])
 
     stats = hosts.stats
     net_per_src = jnp.sum(net_dropped.reshape(Hl, O), axis=1,
                           dtype=jnp.int64)
-    q_per_dst = jnp.maximum(count_of - IN, 0).astype(jnp.int64)
     stats = stats.at[:, ST_PKTS_DROP_NET].add(net_per_src)
-    stats = stats.at[:, ST_PKTS_DROP_Q].add(q_per_dst)
-    return hosts.replace(stats=stats), in_pkt, in_time
+    return hosts.replace(stats=stats), in_pkt, in_time, kept_sorted
 
 
-def trace_and_merge(hosts, hp, cfg: EngineConfig, in_pkt, in_time):
+def _carry_outbox(hosts, pkts, stimes, arrival, stay, O):
+    """Compact the packets in `stay` (original-order mask [Hl*O]) to
+    the front of each source outbox; everything else departed. Records
+    the earliest carried arrival in ob_next (window-advance bound).
+    Callers count the carries into the appropriate defer stat."""
+    Hl = hosts.stats.shape[0]
+    stay2 = stay.reshape(Hl, O)
+    ordr = jnp.argsort(~stay2, axis=1, stable=True)  # stayers first,
+    #   original order preserved (stable sort of booleans)
+    ob_pkt = jnp.take_along_axis(pkts.reshape(Hl, O, -1),
+                                 ordr[:, :, None], axis=1)
+    ob_time = jnp.take_along_axis(stimes.reshape(Hl, O), ordr, axis=1)
+    cnt = jnp.sum(stay2, axis=1, dtype=jnp.int32)
+    ob_next = jnp.min(jnp.where(stay2, arrival.reshape(Hl, O),
+                                SIMTIME_MAX), axis=1)
+    return hosts.replace(ob_pkt=ob_pkt, ob_time=ob_time, ob_cnt=cnt,
+                         ob_next=ob_next)
+
+
+def _trace_tx(hosts, hp, cfg: EngineConfig, pkts, stimes, departed):
+    """Optional tx pcap records for the packets leaving the outbox
+    this window (`departed` [Hl, O] mask; carried packets are traced
+    in the window they finally ship). Loopback delivery bypasses the
+    exchange and is not traced."""
+    if not cfg.tracecap:
+        return hosts
+    Hl = hosts.stats.shape[0]
+    O = departed.shape[1]
+    return jax.vmap(_trace_append, in_axes=(0, 0, 0, 0, None, 0))(
+        hosts, pkts.reshape(Hl, O, -1), stimes.reshape(Hl, O),
+        departed, 1, hp.pcap_on)
+
+
+def merge_arrivals(hosts, hp, cfg: EngineConfig, in_pkt, in_time):
     """Shared tail of both exchanges (single-chip and sharded — ONE
     implementation so the bit-equality contract between them cannot
-    drift): optional pcap trace records, then the inbound merge into
-    per-host queue free slots. A headroom reserve keeps
-    protocol-internal pushes (NIC events, timers, app wakes) from being
-    starved by an arrival burst — a full queue would silently drop
-    those and freeze the host's NIC."""
+    drift): optional rx trace records, then the inbound merge into
+    per-host queue free slots. The delivery construction already
+    bounded each destination's intake by its queue headroom
+    (_deliver_dense), so every arrival fits; the clamp here is a
+    belt-and-braces guard — a nonzero ST_PKTS_DROP_Q is an engine
+    bug, not a modeled drop."""
     IN = in_time.shape[1]
-    O = cfg.obcap
 
     if cfg.tracecap:
-        # tx records: each source's outbox rows (cross-host traffic;
-        # loopback delivery bypasses the exchange and is not traced);
-        # rx records: what lands on this host this window
-        ob_valid = jnp.arange(O)[None, :] < hosts.ob_cnt[:, None]
-        hosts = jax.vmap(_trace_append, in_axes=(0, 0, 0, 0, None, 0))(
-            hosts, hosts.ob_pkt, hosts.ob_time, ob_valid, 1, hp.pcap_on)
         hosts = jax.vmap(_trace_append, in_axes=(0, 0, 0, 0, None, 0))(
             hosts, in_pkt, in_time, in_time != SIMTIME_MAX, 0, hp.pcap_on)
-
-    reserve = min(8, cfg.qcap // 4)
 
     def merge(row, ipkt, itime):
         k = jnp.sum(itime != SIMTIME_MAX).astype(jnp.int32)
         free = row.eq_time == SIMTIME_MAX
         nfree = jnp.sum(free).astype(jnp.int32)
-        k2 = jnp.minimum(k, jnp.maximum(nfree - reserve, 0))
+        k2 = jnp.minimum(k, nfree)
         frank = jnp.cumsum(free) - 1
         take = free & (frank < k2)
         j = jnp.clip(frank, 0, IN - 1)
@@ -344,8 +487,17 @@ def update_cap_peaks(hosts):
 # --- Multi-window driver ---------------------------------------------------
 
 def next_event_time(hosts):
-    """Global minimum pending event time (the pmin reduction)."""
+    """Global minimum pending EXECUTABLE event time (the pmin
+    reduction). Drives the intra-window pass loop."""
     return jnp.min(hosts.eq_time)
+
+
+def next_wakeup(hosts):
+    """Window-advance bound: the earliest pending event OR the earliest
+    arrival among source-carried packets (ob_next) — a deferred
+    delivery must reopen the window even when no queue holds an event
+    yet."""
+    return jnp.minimum(jnp.min(hosts.eq_time), jnp.min(hosts.ob_next))
 
 
 # One AOT-compiled instance per (cfg, max_windows): this build's jit
@@ -389,6 +541,7 @@ def _run_windows_impl(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
         # never execute past the simulation end (the reference clamps the
         # execution window to endTime, shd-master.c:410-440)
         we_eff = jnp.minimum(we, sh.stop_time)
+        ran = next_event_time(hosts) < we_eff  # >=1 event will execute
 
         def ev_cond(h):
             go = next_event_time(h) < we_eff
@@ -405,10 +558,13 @@ def _run_windows_impl(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
             return go
 
         def ev_body(h):
+            if cfg.active_block:
+                return step_window_pass(h, hp, sh, we_eff, cfg)
             return step_all_hosts(h, hp, sh, we_eff, cfg)
 
         hosts = jax.lax.while_loop(ev_cond, ev_body, hosts)
         hosts = update_cap_peaks(hosts)
+        ob0 = jnp.sum(hosts.ob_cnt)
         # an empty exchange is the identity: skip its sort/gather work
         # for windows that emitted nothing (common in sparse phases).
         # Single-chip only — the sharded body's collectives must run
@@ -419,7 +575,14 @@ def _run_windows_impl(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
             lambda h: h, hosts)
         # second sample catches the queue right after arrivals merged
         hosts = update_cap_peaks(hosts)
-        nt = next_event_time(hosts)
+        # Anti-livelock: a window that executed nothing AND shipped
+        # nothing (every carried packet's destination still jammed)
+        # must not re-open at the same carried arrival forever —
+        # advance to the earliest queue event instead so the jammed
+        # destination drains (its events execute, freeing intake).
+        progressed = ran | (jnp.sum(hosts.ob_cnt) < ob0)
+        nt = jnp.where(progressed, next_wakeup(hosts),
+                       next_event_time(hosts))
         we2 = jnp.where(nt == SIMTIME_MAX, SIMTIME_MAX, nt + sh.min_jump)
         return hosts, nt, we2, i + 1
 

@@ -75,18 +75,29 @@ SHIM_C = _os.path.join(_SRC, "shim_preload.c")
 def build_shim(out_dir: str = None) -> str:
     """Compile the preload library (cached). -> .so path
 
-    Builds into SHADOW_SHIM_BUILD or the system temp dir — never next
-    to the target binary, which may live somewhere read-only."""
+    Builds into SHADOW_SHIM_BUILD or a per-user 0700 cache directory —
+    never next to the target binary (may be read-only) and never at a
+    predictable path in the world-writable system temp dir (another
+    local user could pre-plant a .so there that would then be
+    LD_PRELOADed into our child processes). A cached .so is reused
+    only if we own it and it is not group/other-writable."""
     if out_dir is None:
-        import tempfile
-        out_dir = _os.environ.get("SHADOW_SHIM_BUILD",
-                                  tempfile.gettempdir())
+        out_dir = _os.environ.get("SHADOW_SHIM_BUILD")
+    if out_dir is None:
+        base = _os.environ.get("XDG_CACHE_HOME",
+                               _os.path.join(_os.path.expanduser("~"),
+                                             ".cache"))
+        out_dir = _os.path.join(base, "shadow_tpu")
+    _os.makedirs(out_dir, mode=0o700, exist_ok=True)
     so = _os.path.join(out_dir, "libshadow_shim.so")
     if (_os.path.exists(so) and
             _os.path.getmtime(so) >= _os.path.getmtime(SHIM_C)):
-        return so
+        st = _os.stat(so)
+        if st.st_uid == _os.getuid() and not (st.st_mode & 0o022):
+            return so
     subprocess.run(["cc", "-shared", "-fPIC", "-O2", "-o", so, SHIM_C,
                     "-ldl"], check=True)
+    _os.chmod(so, 0o755)
     return so
 
 

@@ -99,6 +99,10 @@ def sock_alloc(row, proto):
         sk_hs_time=setf(row.sk_hs_time, 0, jnp.int64),
         sk_last_tx=setf(row.sk_last_tx, 0, jnp.int64),
         sk_syn_tag=setf(row.sk_syn_tag, 0, jnp.int32),
+        # the allocating process owns the socket: its wakes route back
+        # to that process's app (engine.window._on_app). app_proc is
+        # the live dispatch context (0 outside multi-process configs).
+        sk_proc=setf(row.sk_proc, row.app_proc, jnp.int32),
         sk_app_ref=setf(row.sk_app_ref, -1, jnp.int32),
         sk_cc_wmax=setf(row.sk_cc_wmax, 0.0, jnp.float32),
         sk_cc_epoch=setf(row.sk_cc_epoch, -1, jnp.int64),

@@ -442,6 +442,10 @@ def _accept_syn(row, hp, sh, now, lslot, pkt):
                  sk_rport=pkt[P.SPORT],
                  sk_rhost=pkt[P.SRC],
                  sk_parent=_I32(lslot),
+                 # children inherit the LISTENER's owning process:
+                 # allocation happens during packet handling, outside
+                 # any app dispatch context (app_proc would read 0)
+                 sk_proc=rget(r.sk_proc, lslot),
                  sk_ctl=_I32(CTL_SYNACK),
                  sk_cwnd=sh.tcp_init_wnd,
                  sk_ssthresh=sh.tcp_ssthresh0,
@@ -468,7 +472,11 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
     seq = pkt[P.SEQ].astype(_I64)
     ackno = pkt[P.ACK].astype(_I64)
     ln = pkt[P.LEN].astype(_I64)
-    finack = (pkt[P.AUX] & AUX_FINACK) != 0
+    # AUX carries the peer's bandwidth stamps on handshake segments
+    # (see _autotune), so the FINACK bit is only meaningful on ~syn
+    # segments — without the guard, any peer whose bw_down>>10 is odd
+    # would spuriously set fin-acked on the SYN|ACK.
+    finack = ~syn & ((pkt[P.AUX] & AUX_FINACK) != 0)
 
     state0 = rget(row.sk_state, slot)
 
@@ -556,7 +564,7 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
     wm0, ep0, k0 = (rget(row.sk_cc_wmax, slot), rget(row.sk_cc_epoch, slot),
                     rget(row.sk_cc_k, slot))
     cw_a, ep_a, k_a = CC.on_ack(sh.cc_kind, cw0, ss0, wm0, ep0, k0,
-                                npkts, now)
+                                npkts, now, rget(row.sk_srtt, slot))
     cw_l, ss_l, wm_l, ep_l = CC.on_loss(sh.cc_kind, cw0, ss0, wm0)
 
     row = _set(

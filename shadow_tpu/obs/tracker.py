@@ -41,8 +41,8 @@ import numpy as np
 from ..engine import defs
 
 
-HEADER = ("time,host,events,pkts-sent,pkts-recv,bytes-sent,bytes-recv,"
-          "retransmits,drop-net,drop-buf,transfers-done")
+HEADER = ("time,host,interval,events,pkts-sent,pkts-recv,bytes-sent,"
+          "bytes-recv,retransmits,drop-net,drop-buf,transfers-done")
 
 
 class Tracker:
@@ -99,8 +99,11 @@ class Tracker:
             for i, name in enumerate(self.names):
                 if d[i, defs.ST_EVENTS] == 0:
                     continue
+                # the covered-span column keeps per-host rates
+                # computable when several intervals collapse into one
+                # chunk-boundary emission (rate = delta / interval)
                 self._emit(
-                    f"[shadow-heartbeat] [node] {t},{name},"
+                    f"[shadow-heartbeat] [node] {t},{name},{span_s},"
                     f"{d[i, defs.ST_EVENTS]},"
                     f"{d[i, defs.ST_PKTS_SENT]},"
                     f"{d[i, defs.ST_PKTS_RECV]},"

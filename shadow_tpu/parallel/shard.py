@@ -36,12 +36,11 @@ Two wire protocols (EngineConfig.exchange_a2a selects; both live in
   post-exchange merge are stable sorts keyed exactly like v1, so the
   delivered order (and therefore every downstream bit) matches v1 and
   the single-chip engine. A bucket overflow (one shard bursting more
-  than B packets at one other shard in a single window) drops the
-  burst tail and counts it in ST_PKTS_DROP_Q against the sending
-  host — beyond that bound the single-chip engine is also dropping
-  (a destination shard can absorb at most Hl x incap per window), but
-  may pick different victims, so bit-equality holds only under the
-  bucket bound; size a2acap for the workload's burst, or set
+  than B packets at one other shard in a single window) DEFERS the
+  burst tail at the source — exact arrival times, counted in
+  ST_DEFER_A2A — where v1/single-chip would have delivered it this
+  window, so bit-equality with them holds only under the bucket
+  bound; size a2acap for the workload's burst, or set
   exchange_a2a=False for the exact-at-any-burst v1.
 """
 
@@ -57,9 +56,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 from ..core import rng as R
 from ..core.simtime import SIMTIME_MAX
 from ..engine import equeue
-from ..engine.defs import (EV_PKT, ST_PKTS_DROP_NET, ST_PKTS_DROP_Q)
+from ..engine.defs import (EV_PKT, ST_PKTS_DROP_NET,
+                           ST_DEFER_FANIN, ST_DEFER_A2A)
 from ..engine.state import EngineConfig
-from ..engine.window import step_all_hosts, update_cap_peaks
+from ..engine.window import (step_all_hosts, step_window_pass,
+                             update_cap_peaks)
 from ..net import packet as P
 
 AXIS = "hosts"
@@ -77,14 +78,23 @@ def exchange_sharded(hosts, hp, sh, cfg: EngineConfig,
     """Window-boundary packet exchange, one shard's view.
 
     Same program as engine.window.exchange with the routing/loss math
-    done source-side (all inputs local) and delivery done after an
-    all-gather (the cross-shard hop). `cfg` is global sizes, `lcfg`
-    local (per-shard) sizes.
+    done source-side (all inputs local) and delivery done after the
+    cross-shard hop (v2 bucketed all-to-all or v1 all-gather). `cfg`
+    is global sizes, `lcfg` local (per-shard) sizes.
+
+    Deferral (round 3): the destination shard decides which received
+    packets fit its hosts' intake this window (engine.window.
+    _deliver_dense) and the accept flags travel BACK to the source
+    shard — one small reverse collective — so unaccepted packets stay
+    in the source outbox and re-exchange next window, exactly like the
+    single-chip engine. The v2 bucket-overflow tail (never shipped)
+    defers the same way, counted in ST_DEFER_A2A instead of dropped.
     """
     H, Hl, O, IN = cfg.num_hosts, lcfg.num_hosts, cfg.obcap, cfg.incap
     Nl = Hl * O
     n_shards = H // Hl
-    lo = jax.lax.axis_index(AXIS).astype(jnp.int32) * Hl
+    my = jax.lax.axis_index(AXIS).astype(jnp.int32)
+    lo = my * Hl
 
     pkts = hosts.ob_pkt.reshape(Nl, P.PKT_WORDS)
     stimes = hosts.ob_time.reshape(Nl)
@@ -115,27 +125,66 @@ def exchange_sharded(hosts, hp, sh, cfg: EngineConfig,
 
     sortkey_l = jnp.where(deliver, dst, H)
 
+    from ..engine.window import (_deliver_dense, _carry_outbox,
+                                 _trace_tx, merge_arrivals)
+
     if cfg.exchange_a2a and n_shards > 1:
-        hosts, g_key, g_arr, g_pkt = _a2a_hop(
-            hosts, cfg, lcfg, sortkey_l, arrival, pkts, n_shards)
+        g_key, g_arr, g_pkt, oj, cell_ok = _a2a_hop(
+            cfg, lcfg, sortkey_l, arrival, pkts, n_shards)
+        # which outbox positions actually shipped in a bucket (the
+        # overflow tail did not — it defers via ST_DEFER_A2A)
+        tgt = jnp.where(cell_ok, oj, Nl)
+        shipped = jnp.zeros((Nl,), jnp.bool_).at[tgt.reshape(-1)].set(
+            True, mode="drop")
     else:
         # --- v1: gather all shards' surviving traffic ---
         g_key = jax.lax.all_gather(sortkey_l, AXIS).reshape(n_shards * Nl)
         g_arr = jax.lax.all_gather(arrival, AXIS).reshape(n_shards * Nl)
         g_pkt = jax.lax.all_gather(pkts, AXIS).reshape(n_shards * Nl,
                                                        P.PKT_WORDS)
+        shipped = deliver
 
     # identical group-by-destination + gather-based delivery as the
     # single-chip exchange (engine.window._deliver_dense — ONE
     # implementation keeps the bit-equality contract)
-    from ..engine.window import _deliver_dense, trace_and_merge
     order = jnp.argsort(g_key, stable=True)
     sdst = g_key[order]
-    hosts, in_pkt, in_time = _deliver_dense(
-        hosts, order, sdst, g_pkt, g_arr, net_dropped, O, IN, lo=lo)
+    hosts, in_pkt, in_time, kept_sorted = _deliver_dense(
+        hosts, order, sdst, g_pkt, g_arr, net_dropped, O, IN, cfg, lo=lo)
 
-    hosts = trace_and_merge(hosts, hp, cfg, in_pkt, in_time)
-    return hosts.replace(ob_cnt=jnp.zeros_like(hosts.ob_cnt))
+    # accept flags back into the received-list original order, then
+    # back to the SOURCE shards
+    kept_recv = jnp.zeros(g_key.shape, jnp.bool_).at[order].set(
+        kept_sorted)
+    if cfg.exchange_a2a and n_shards > 1:
+        # reverse hop: [S, B] accept flags per bucket slot I received
+        # -> per bucket slot I sent
+        acc_bkt = jax.lax.all_to_all(
+            kept_recv.reshape(n_shards, -1).astype(jnp.int32),
+            AXIS, split_axis=0, concat_axis=0, tiled=False)
+        acc_local = jnp.zeros((Nl,), jnp.bool_).at[tgt.reshape(-1)].set(
+            acc_bkt.reshape(-1) > 0, mode="drop")
+    else:
+        # each shard accepted only its own dests; OR across shards,
+        # then take my segment of the gathered (source-major) order
+        acc_all = jax.lax.psum(kept_recv.astype(jnp.int32), AXIS) > 0
+        acc_local = jax.lax.dynamic_slice(
+            acc_all, (my.astype(jnp.int32) * Nl,), (Nl,))
+
+    stay = deliver & ~acc_local
+    fanin_stay = stay & shipped
+    a2a_stay = stay & ~shipped
+    hosts = hosts.replace(stats=hosts.stats
+                          .at[:, ST_DEFER_FANIN].add(jnp.sum(
+                              fanin_stay.reshape(Hl, O), axis=1,
+                              dtype=jnp.int64))
+                          .at[:, ST_DEFER_A2A].add(jnp.sum(
+                              a2a_stay.reshape(Hl, O), axis=1,
+                              dtype=jnp.int64)))
+    hosts = _trace_tx(hosts, hp, cfg, pkts, stimes,
+                      (acc_local | net_dropped).reshape(Hl, O))
+    hosts = _carry_outbox(hosts, pkts, stimes, arrival, stay, O)
+    return merge_arrivals(hosts, hp, cfg, in_pkt, in_time)
 
 
 def a2a_bucket_cap(cfg: EngineConfig, lcfg: EngineConfig) -> int:
@@ -149,10 +198,14 @@ def a2a_bucket_cap(cfg: EngineConfig, lcfg: EngineConfig) -> int:
     return min(max(64, (4 * Nl) // n_shards), Nl)
 
 
-def _a2a_hop(hosts, cfg, lcfg, sortkey_l, arrival, pkts, n_shards):
+def _a2a_hop(cfg, lcfg, sortkey_l, arrival, pkts, n_shards):
     """v2 cross-shard hop (module docstring): bucket by destination
     shard, exchange buckets, return the received (key, arrival, pkt)
-    triple in the same global source order v1's gather produces.
+    triple in the same global source order v1's gather produces, plus
+    the (oj, cell_ok) bucket->outbox-position mapping the caller uses
+    to route accept flags back and to identify the overflow tail
+    (which now DEFERS at the source — ST_DEFER_A2A — instead of
+    dropping).
 
     Order argument: the local stable sort is keyed by destination
     SHARD only, so packets for one shard stay in local outbox order;
@@ -182,16 +235,6 @@ def _a2a_hop(hosts, cfg, lcfg, sortkey_l, arrival, pkts, n_shards):
     bkt_arr = jnp.where(cell_ok, arrival[oj], 0)
     bkt_pkt = jnp.where(cell_ok[:, :, None], pkts[oj], jnp.int32(0))
 
-    # bucket overflow: the burst tail past B never ships — count it
-    # against the sending host (rank within bucket >= B)
-    rank = jnp.arange(Nl) - first_of[jnp.clip(sds, 0, n_shards - 1)]
-    lost = (sds < n_shards) & (rank >= B)
-    src_host = order_l // O  # local host id of each sorted entry
-    per_host = jnp.zeros((Hl,), jnp.int64).at[src_host].add(
-        lost.astype(jnp.int64))
-    hosts = hosts.replace(
-        stats=hosts.stats.at[:, ST_PKTS_DROP_Q].add(per_host))
-
     g_key = jax.lax.all_to_all(bkt_key, AXIS, split_axis=0,
                                concat_axis=0, tiled=False)
     g_arr = jax.lax.all_to_all(bkt_arr, AXIS, split_axis=0,
@@ -199,8 +242,8 @@ def _a2a_hop(hosts, cfg, lcfg, sortkey_l, arrival, pkts, n_shards):
     g_pkt = jax.lax.all_to_all(bkt_pkt, AXIS, split_axis=0,
                                concat_axis=0, tiled=False)
     N2 = n_shards * B
-    return (hosts, g_key.reshape(N2), g_arr.reshape(N2),
-            g_pkt.reshape(N2, P.PKT_WORDS))
+    return (g_key.reshape(N2), g_arr.reshape(N2),
+            g_pkt.reshape(N2, P.PKT_WORDS), oj, cell_ok)
 
 
 def _windows_body(hosts, hp, sh, wstart, wend, cfg, lcfg, max_windows):
@@ -209,6 +252,12 @@ def _windows_body(hosts, hp, sh, wstart, wend, cfg, lcfg, max_windows):
     def next_time_global(h):
         return jax.lax.pmin(jnp.min(h.eq_time), AXIS)
 
+    def next_wakeup_global(h):
+        # window-advance bound includes source-carried arrivals
+        # (engine.window.next_wakeup)
+        return jax.lax.pmin(jnp.minimum(jnp.min(h.eq_time),
+                                        jnp.min(h.ob_next)), AXIS)
+
     def win_cond(carry):
         _, ws, _, i = carry
         return (i < max_windows) & (ws < sh.stop_time) & (ws < SIMTIME_MAX)
@@ -216,18 +265,30 @@ def _windows_body(hosts, hp, sh, wstart, wend, cfg, lcfg, max_windows):
     def win_body(carry):
         hosts, ws, we, i = carry
         we_eff = jnp.minimum(we, sh.stop_time)
+        ran = next_time_global(hosts) < we_eff
 
         def ev_cond(h):
             return next_time_global(h) < we_eff
 
         def ev_body(h):
+            # active-set compaction applies per shard (local rows);
+            # the while cond stays the global pmin so every shard runs
+            # the same number of (possibly no-op) passes — collectives
+            # remain uniform
+            if cfg.active_block:
+                return step_window_pass(h, hp, sh, we_eff, cfg)
             return step_all_hosts(h, hp, sh, we_eff, cfg)
 
         hosts = jax.lax.while_loop(ev_cond, ev_body, hosts)
         hosts = update_cap_peaks(hosts)
+        ob0 = jax.lax.psum(jnp.sum(hosts.ob_cnt), AXIS)
         hosts = exchange_sharded(hosts, hp, sh, cfg, lcfg)
         hosts = update_cap_peaks(hosts)
-        nt = next_time_global(hosts)
+        # anti-livelock, global decision (engine.window.win_body)
+        ob1 = jax.lax.psum(jnp.sum(hosts.ob_cnt), AXIS)
+        progressed = ran | (ob1 < ob0)
+        nt = jnp.where(progressed, next_wakeup_global(hosts),
+                       next_time_global(hosts))
         we2 = jnp.where(nt == SIMTIME_MAX, SIMTIME_MAX, nt + sh.min_jump)
         return hosts, nt, we2, i + 1
 

@@ -57,10 +57,11 @@ def test_rng_determinism_and_independence():
     assert 0.0 <= u1 < 1.0
 
 
-def test_multi_process_host_rejected(simple_topology_xml):
-    """Multiple processes on one host are refused loudly (one behavior
-    machine per host; combined roles go in one tgen graph)."""
-    import pytest
+def test_multi_process_host_sizes_slots(simple_topology_xml):
+    """Multiple processes per host are supported (round 3): the engine
+    sizes its process slots from the scenario's max process count.
+    tests/test_multiproc.py covers the behavior; this guards the
+    config plumbing."""
     from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario
     from shadow_tpu.engine.sim import Simulation
 
@@ -74,8 +75,9 @@ def test_multi_process_host_rejected(simple_topology_xml):
                         arguments="port=2"),
         ])],
     )
-    with pytest.raises(NotImplementedError, match="2 processes"):
-        Simulation(scen)
+    sim = Simulation(scen)
+    assert sim.cfg.procs_per_host == 2
+    assert sim.hp.app_kind.shape == (1, 2)
 
 
 def test_engine_caps_cli_parsing(simple_topology_xml, tmp_path):

@@ -174,3 +174,72 @@ def test_differential_tgen_web(simple_topology_xml):
                                         incap=16, txqcap=8,
                                         chunk_windows=8))
     assert stats[2:, defs.ST_XFER_DONE].sum() > 0
+
+
+# --- SOCKS proxy chains (the at-scale flagship app, BASELINE #3/#4
+# shape at toy size: clients fetch through 1- and 2-hop relay circuits;
+# CONNECT tags, relay pairing, streamed relay writes and pair teardown
+# must agree bit for bit) ---------------------------------------------------
+
+def _socks_scen(loss=0.0, hops=1, clients=3, stop=45):
+    from test_tcp import poi_topology
+
+    def scen():
+        return Scenario(
+            stop_time=stop * 10**9,
+            topology_graphml=poi_topology(loss=loss),
+            hosts=[
+                # ids 0-1: target servers; 2-4: relays; 5+: clients
+                HostSpec(id="server", quantity=2, processes=[
+                    ProcessSpec(plugin="bulkserver", start_time=10**9,
+                                arguments="port=80")]),
+                HostSpec(id="relay", quantity=3, processes=[
+                    ProcessSpec(plugin="socksproxy", start_time=10**9,
+                                arguments="port=9050 server-port=80 "
+                                          "relay-lo=2 relay-hi=5")]),
+                HostSpec(id="client", quantity=clients, processes=[
+                    ProcessSpec(plugin="socksclient", start_time=2 * 10**9,
+                                arguments=f"proxy-lo=2 proxy-hi=5 "
+                                          f"proxy-port=9050 server-lo=0 "
+                                          f"server-hi=2 size=30000 "
+                                          f"count=2 pause=1s "
+                                          f"hops={hops}")]),
+            ],
+        )
+
+    return scen
+
+
+SOCKS_CFG = dict(qcap=32, scap=12, obcap=16, incap=24, txqcap=12,
+                 chunk_windows=8)
+SOCKS_COMPARE = TCP_COMPARE + [defs.ST_CHAIN_SHORT]
+
+
+def _diff_socks(scenario_fn, n_hosts):
+    jax_stats = Simulation(scenario_fn(),
+                           engine_cfg=EngineConfig(num_hosts=n_hosts,
+                                                   **SOCKS_CFG)).run().stats
+    py_stats = PyEngine(Simulation(scenario_fn(),
+                                   engine_cfg=EngineConfig(
+                                       num_hosts=n_hosts,
+                                       **SOCKS_CFG))).run()
+    for st in SOCKS_COMPARE:
+        assert np.array_equal(jax_stats[:, st], py_stats[:, st]), (
+            f"stat {st} diverges:\n jax={jax_stats[:, st]}\n "
+            f"py={py_stats[:, st]}")
+    return jax_stats
+
+
+def test_differential_socks():
+    """Single-hop circuits: client -> relay -> server."""
+    stats = _diff_socks(_socks_scen(hops=1), 8)
+    # every client finished its 2 fetches
+    assert (stats[5:, defs.ST_APP_DONE] == 1).all()
+
+
+def test_differential_socks_multihop_lossy():
+    """2-hop circuits over a 2%-loss link: chain extension plus loss
+    recovery on every leg."""
+    stats = _diff_socks(_socks_scen(loss=0.02, hops=2, stop=90), 8)
+    assert stats[:, defs.ST_RETRANSMIT].sum() > 0
+    assert stats[5:, defs.ST_XFER_DONE].sum() > 0

@@ -1,0 +1,515 @@
+"""Golden-vector tests for the TCP protocol kernels.
+
+The differential harness (tests/test_differential.py) runs the same
+kernels in both engines, so it cannot catch a SPEC bug in
+net/congestion.py or net/sack.py — both engines would faithfully
+reproduce it. These tests check the kernels against expectations
+derived INDEPENDENTLY from the reference C:
+
+- the loss-response formulas of cubic/reno/aimd
+  (/root/reference/src/main/host/descriptor/shd-tcp-cubic.c:224-236,
+  shd-tcp-aimd.c:38-60, shd-tcp-reno.c:42-66), hand-transcribed here
+  as plain Python arithmetic;
+- the cubic growth curve: a full pure-Python reimplementation of the
+  reference's integer _cubic_update mechanics (shd-tcp-cubic.c:
+  112-220) drives the same ACK schedule as our float kernel, and the
+  trajectories must stay within a tight envelope;
+- the SACK scoreboard range algebra: an independent set-of-integers
+  model checks insert/consume/skip/drop_below exactly, and
+  hand-computed retransmit-selection scenarios check the recovery
+  rules against shd-tcp-scoreboard.c:187-281 (with the one designed
+  divergence — the FACK-style "everything below the highest sacked
+  run is lost" rule vs the reference's fack-4 holdoff — asserted
+  explicitly so it cannot drift silently).
+
+Nothing in this file calls into net/ to COMPUTE an expectation; net/
+functions are only ever the system under test.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from shadow_tpu.net import congestion as CC
+from shadow_tpu.net import sack
+from shadow_tpu.core.constants import TCP_MSS
+
+
+# ---------------------------------------------------------------------------
+# Reference constants, transcribed from shd-tcp-cubic.c cubic_new
+# (beta=819, scalingFactor=41, BETA_SCALE=1024, BICTCP_HZ=10, time in
+# milliseconds) — NOT taken from net.congestion.
+REF_BETA = 819
+REF_BETA_SCALE = 1024
+REF_RTT_SCALE = 41 * 10
+REF_CUBE_FACTOR = (1 << (10 + 3 * 10)) // REF_RTT_SCALE
+
+
+def test_cubic_loss_golden():
+    """cubic_packetLoss: new window = max(W*819/1024, 2)."""
+    for w in [2.0, 5.0, 10.0, 37.0, 100.0, 1000.0, 10000.0]:
+        expected = max(w * REF_BETA / REF_BETA_SCALE, 2.0)
+        got, thresh, _, epoch = CC.on_loss(
+            jnp.int32(CC.CC_CUBIC), jnp.float32(w), jnp.float32(0.0),
+            jnp.float32(0.0))
+        assert got == pytest.approx(expected, rel=1e-5), w
+        assert thresh == pytest.approx(expected, rel=1e-5)
+        assert int(epoch) == -1          # epochStart reset on loss
+
+
+def test_cubic_fast_convergence_golden():
+    """cubic_packetLoss wmax update: W < lastMax -> lastMax' =
+    W*(1024+819)/(2*1024), else lastMax' = W (shd-tcp-cubic.c:228-233)."""
+    for w, wmax in [(50.0, 100.0), (10.0, 12.0), (99.0, 100.0)]:
+        expected = w * (REF_BETA_SCALE + REF_BETA) / (2 * REF_BETA_SCALE)
+        _, _, wmax2, _ = CC.on_loss(jnp.int32(CC.CC_CUBIC),
+                                    jnp.float32(w), jnp.float32(0.0),
+                                    jnp.float32(wmax))
+        assert wmax2 == pytest.approx(expected, rel=1e-5), (w, wmax)
+    for w, wmax in [(100.0, 50.0), (100.0, 100.0), (5.0, 0.0)]:
+        _, _, wmax2, _ = CC.on_loss(jnp.int32(CC.CC_CUBIC),
+                                    jnp.float32(w), jnp.float32(0.0),
+                                    jnp.float32(wmax))
+        assert wmax2 == pytest.approx(w, rel=1e-5), (w, wmax)
+
+
+def test_aimd_reno_loss_golden():
+    """aimd/reno packetLoss: ceil(W/2), floor 1 (RFC5681 note in
+    shd-tcp-aimd.c:50-60)."""
+    for kind in (CC.CC_AIMD, CC.CC_RENO):
+        for w in [1.0, 2.0, 3.0, 7.0, 100.0, 12345.0]:
+            expected = max(math.ceil(w / 2.0), 1.0)
+            got, thresh, _, _ = CC.on_loss(jnp.int32(kind),
+                                           jnp.float32(w),
+                                           jnp.float32(0.0),
+                                           jnp.float32(0.0))
+            assert got == pytest.approx(expected, rel=1e-6), (kind, w)
+
+
+def test_slow_start_and_additive_increase_golden():
+    """Slow start adds packetsAcked; avoidance adds n^2/W per ack
+    (aimd/reno shared shape, shd-tcp-aimd.c:16-36)."""
+    # slow start: threshold unset (0)
+    w2, _, _ = CC.on_ack(jnp.int32(CC.CC_RENO), jnp.float32(10.0),
+                         jnp.float32(0.0), jnp.float32(0.0),
+                         jnp.int64(-1), jnp.float32(0.0),
+                         jnp.int32(3), jnp.int64(10**9),
+                         jnp.int64(100 * 10**6))
+    assert w2 == pytest.approx(13.0)
+    # avoidance: W=20 above threshold 10, 1 pkt acked -> +1/20
+    w2, _, _ = CC.on_ack(jnp.int32(CC.CC_RENO), jnp.float32(20.0),
+                         jnp.float32(10.0), jnp.float32(0.0),
+                         jnp.int64(-1), jnp.float32(0.0),
+                         jnp.int32(1), jnp.int64(10**9),
+                         jnp.int64(100 * 10**6))
+    assert w2 == pytest.approx(20.0 + 1.0 / 20.0, rel=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Reference cubic mechanics, reimplemented in full from
+# shd-tcp-cubic.c:112-220 (integer count/windowCount pacing, ms time
+# base, >>40 scaling). Hystart is inert under a constant-RTT ACK clock
+# (its "found" conditions need sub-2ms ack spacing or RTT inflation),
+# so it is omitted; the slow-start branch is included.
+
+class RefCubic:
+    def __init__(self, window, threshold):
+        self.window = window
+        self.threshold = threshold if threshold else 0x7FFFFFFF
+        self.lastMaxWindow = 0
+        self.lossWindow = 0
+        self.epochStart = 0
+        self.lastTime = 0
+        self.originPoint = 0
+        self.delayMin = 0
+        self.tcpWindowEst = 0
+        self.k = 0
+        self.ackCount = 0
+        self.count = 0
+        self.windowCount = 0
+        self.betaScale = 8 * (REF_BETA_SCALE + REF_BETA) // 3 \
+            // (REF_BETA_SCALE - REF_BETA)
+
+    def _update(self, now_ms, rtt_ms):
+        if self.delayMin:
+            self.delayMin = min(self.delayMin, rtt_ms)
+        else:
+            self.delayMin = rtt_ms
+        self.ackCount += 1
+        if now_ms - self.lastTime <= 1024 // 32:
+            return
+        self.lastTime = now_ms
+        if not self.epochStart:
+            self.epochStart = now_ms
+            if self.window < self.lastMaxWindow:
+                self.k = int((REF_CUBE_FACTOR *
+                              (self.lastMaxWindow - self.window))
+                             ** (1.0 / 3.0))
+                self.originPoint = self.lastMaxWindow
+            else:
+                self.k = 0
+                self.originPoint = self.window
+            self.ackCount = 1
+            self.tcpWindowEst = self.window
+        timeOffset = now_ms + self.delayMin - self.epochStart
+        offset = abs(timeOffset - self.k)
+        originDelta = (REF_RTT_SCALE * offset * offset * offset) >> 40
+        if timeOffset < self.k:
+            target = self.originPoint - originDelta
+        else:
+            target = self.originPoint + originDelta
+        if target > self.window:
+            self.count = self.window // (target - self.window)
+        else:
+            self.count = self.window * 100
+        if self.delayMin > 0:
+            minCount = (self.window * 1000 * 8) // (10 * 16 * self.delayMin)
+            if self.count < minCount and timeOffset >= self.k:
+                self.count = minCount
+        delta = (self.window * self.betaScale) >> 3
+        while self.ackCount > delta:
+            self.ackCount -= delta
+            self.tcpWindowEst += 1
+        self.ackCount = 0
+        if self.tcpWindowEst > self.window:
+            maxCount = self.window // (self.tcpWindowEst - self.window)
+            if self.count > maxCount:
+                self.count = maxCount
+        self.count //= 2
+        if self.count == 0:
+            self.count = 1
+
+    def avoidance(self, now_ms, rtt_ms):
+        if self.window <= self.threshold:
+            self.window += 1
+        else:
+            self._update(now_ms, rtt_ms)
+            if self.windowCount > self.count:
+                self.window += 1
+                self.windowCount = 0
+            else:
+                self.windowCount += 1
+
+    def packet_loss(self):
+        self.epochStart = 0
+        if self.window < self.lastMaxWindow:
+            self.lastMaxWindow = (self.window *
+                                  (REF_BETA_SCALE + REF_BETA)) \
+                // (2 * REF_BETA_SCALE)
+        else:
+            self.lastMaxWindow = self.window
+        self.lossWindow = self.window
+        new = max((self.window * REF_BETA) // REF_BETA_SCALE, 2)
+        # caller contract (shd-tcp.c:1063-1064): threshold = loss
+        # return; window = threshold
+        self.threshold = new
+        self.window = new
+
+
+import jax as _jax
+
+
+@_jax.jit
+def _round_of_acks(cwnd, ssthresh, wmax, epoch, k, t0, spacing, acks,
+                   srtt_ns):
+    """One RTT worth of per-packet on_ack calls as a scanned kernel
+    (the eager per-ack loop took minutes on a 1-core box)."""
+    def body(carry, i):
+        cwnd, epoch, k = carry
+        now = t0 + (i + 1) * spacing
+        cwnd, epoch, k = CC.on_ack(jnp.int32(CC.CC_CUBIC), cwnd,
+                                   ssthresh, wmax, epoch, k,
+                                   jnp.int32(1), now, srtt_ns)
+        return (cwnd, epoch, k), 0
+
+    idx = jnp.arange(4096, dtype=jnp.int64)
+    def step(carry, i):
+        do = i < acks
+        new, _ = body(carry, i)
+        out = _jax.tree.map(lambda a, b: jnp.where(do, a, b), new, carry)
+        return out, 0
+
+    (cwnd, epoch, k), _ = _jax.lax.scan(step, (cwnd, epoch, k), idx)
+    return cwnd, epoch, k
+
+
+def _run_ours(w0, thresh0, wmax0, rtt_ms, seconds, loss_times_s):
+    """Drive net.congestion's cubic with one on_ack per packet, window
+    acks per RTT (the same ACK clock RefCubic gets)."""
+    cwnd = jnp.float32(w0)
+    ssthresh = jnp.float32(thresh0)
+    wmax = jnp.float32(wmax0)
+    epoch = jnp.int64(-1)
+    k = jnp.float32(0.0)
+    now_ns = 0
+    losses = sorted(loss_times_s)
+    samples = []
+    while now_ns < seconds * 10**9:
+        acks = max(int(cwnd), 1)
+        spacing = int(rtt_ms * 10**6) // acks
+        cwnd, epoch, k = _round_of_acks(
+            cwnd, ssthresh, wmax, epoch, k, jnp.int64(now_ns),
+            jnp.int64(spacing), jnp.int64(acks),
+            jnp.int64(rtt_ms * 10**6))
+        now_ns += spacing * acks
+        while losses and now_ns >= losses[0] * 10**9:
+            losses.pop(0)
+            cwnd, ssthresh, wmax, epoch = CC.on_loss(
+                jnp.int32(CC.CC_CUBIC), cwnd, ssthresh, wmax)
+        samples.append((now_ns / 1e9, float(cwnd)))
+    return samples
+
+
+def _run_ref(w0, thresh0, rtt_ms, seconds, loss_times_s):
+    ref = RefCubic(w0, thresh0)
+    now_ms = 0
+    losses = sorted(loss_times_s)
+    samples = []
+    while now_ms < seconds * 1000:
+        acks = max(ref.window, 1)
+        spacing = rtt_ms / acks
+        t = now_ms
+        for i in range(acks):
+            t = now_ms + (i + 1) * spacing
+            ref.avoidance(int(t), rtt_ms)
+        now_ms = int(now_ms + rtt_ms)
+        while losses and now_ms >= losses[0] * 1000:
+            losses.pop(0)
+            ref.packet_loss()
+        samples.append((now_ms / 1000.0, float(ref.window)))
+    return samples
+
+
+def test_cubic_trajectory_vs_reference_mechanics():
+    """After a loss from W=120, both implementations must (a) drop to
+    ~0.8W, (b) grow back toward wmax ~ the pre-loss window along the
+    cubic, (c) plateau near wmax around t=K, with the windows staying
+    within a modest envelope of each other throughout."""
+    rtt_ms = 100
+    seconds = 40
+    # start both at W=120 in avoidance and take a loss at t=2s
+    ours = _run_ours(120.0, 60.0, 0.0, rtt_ms, seconds, [2.0])
+    ref = _run_ref(120, 60, rtt_ms, seconds, [2.0])
+
+    def at(samples, t):
+        return min(samples, key=lambda p: abs(p[0] - t))[1]
+
+    # (a) the multiplicative decrease: the first post-loss sample is
+    # ~0.8x the pre-loss window in both (119/1024 slack for the growth
+    # between sample points)
+    pre_o, pre_r = at(ours, 1.9), at(ref, 1.9)
+    assert at(ours, 2.2) <= pre_o * 0.9
+    assert at(ref, 2.2) <= pre_r * 0.9
+    assert at(ours, 2.2) >= pre_o * (819 / 1024) * 0.95
+    assert at(ref, 2.2) >= pre_r * (819 / 1024) * 0.95
+    # (b)+(c): windows track within a 30% envelope at every sampled
+    # second after recovery starts (mechanics differ — float target
+    # chase with the minCount rate cap vs integer count pacing — but
+    # the curve and the post-plateau linear rate are the same)
+    for t in range(4, seconds, 2):
+        o, r = at(ours, t), at(ref, t)
+        assert 0.70 <= o / r <= 1.30, (t, o, r)
+    # post-plateau probing is RATE-BOUNDED: the reference's minCount
+    # floor caps growth at 0.04*delayMin packets per RTT = ~40/s here;
+    # the runaway-chase bug (window doubling per RTT) blows far past
+    # this within a few seconds
+    for t in (20, 30, 38):
+        dt_rate_o = (at(ours, t) - at(ours, t - 4)) / 4.0
+        dt_rate_r = (at(ref, t) - at(ref, t - 4)) / 4.0
+        assert dt_rate_o <= 60.0, (t, dt_rate_o)
+        assert dt_rate_r <= 60.0, (t, dt_rate_r)
+
+
+def test_cubic_k_formula_golden():
+    """Our K (seconds to plateau) must equal the reference's
+    k = cbrt(cubeFactor * (lastMax - W)) milliseconds
+    (shd-tcp-cubic.c:137-139) for the same deficit."""
+    for w, wmax in [(50.0, 100.0), (80.0, 100.0), (10.0, 400.0)]:
+        ref_k_ms = (REF_CUBE_FACTOR * (wmax - w)) ** (1.0 / 3.0)
+        # probe our kernel: first avoidance ack sets k (epoch < 0)
+        _, _, k = CC.on_ack(jnp.int32(CC.CC_CUBIC), jnp.float32(w),
+                            jnp.float32(w / 2), jnp.float32(wmax),
+                            jnp.int64(-1), jnp.float32(0.0),
+                            jnp.int32(1), jnp.int64(10**9),
+                            jnp.int64(100 * 10**6))
+        assert float(k) == pytest.approx(ref_k_ms / 1000.0, rel=0.01), \
+            (w, wmax)
+
+
+# ---------------------------------------------------------------------------
+# SACK scoreboard: independent set-of-integers model.
+
+class SetModel:
+    """Byte ranges as a plain Python set of byte offsets."""
+
+    def __init__(self):
+        self.bytes = set()
+
+    def insert(self, s, e):
+        self.bytes |= set(range(s, e))
+
+    def drop_below(self, lo):
+        self.bytes = {b for b in self.bytes if b >= lo}
+
+    def consume(self, rcv):
+        """TCP semantics (and the kernel's): any stored range whose
+        START the cursor has reached is absorbed WHOLE — in real use
+        rcv_nxt never sits inside a stored out-of-order run, and a run
+        starting at/below the cursor is by construction fully
+        receivable."""
+        changed = True
+        while changed:
+            changed = False
+            for (s, e) in self.ranges():
+                if s <= rcv:
+                    self.bytes -= set(range(s, e))
+                    rcv = max(rcv, e)
+                    changed = True
+                    break
+        return rcv
+
+    def skip(self, x):
+        while x in self.bytes:
+            x += 1
+        return x
+
+    def ranges(self):
+        out = []
+        for b in sorted(self.bytes):
+            if out and out[-1][1] == b:
+                out[-1][1] = b + 1
+            else:
+                out.append([b, b + 1])
+        return [(s, e) for s, e in out]
+
+
+def _ranges_of(s, e):
+    s = np.asarray(s)
+    e = np.asarray(e)
+    return sorted((int(a), int(b)) for a, b in zip(s, e) if a >= 0)
+
+
+def test_sack_ops_match_set_model():
+    """Randomized op sequences: as long as the model never exceeds K
+    disjoint ranges, the kernel must agree exactly."""
+    rng = np.random.default_rng(7)
+    for trial in range(50):
+        s, e = sack.empty()
+        model = SetModel()
+        for _ in range(30):
+            op = rng.integers(0, 4)
+            if op == 0:
+                a = int(rng.integers(0, 400))
+                ln = int(rng.integers(1, 60))
+                model.insert(a, a + ln)
+                if len(model.ranges()) > sack.K:
+                    # out of model scope (kernel K-truncates); restart
+                    break
+                s, e = sack.insert(s, e, jnp.int64(a), jnp.int64(a + ln))
+            elif op == 1:
+                lo = int(rng.integers(0, 400))
+                model.drop_below(lo)
+                s, e = sack.drop_below(s, e, jnp.int64(lo))
+            elif op == 2:
+                x = int(rng.integers(0, 400))
+                assert int(sack.skip(jnp.int64(x), s, e)) == model.skip(x)
+                continue
+            else:
+                rcv = int(rng.integers(0, 400))
+                want = model.consume(rcv)
+                s, e, got = sack.consume(s, e, jnp.int64(rcv))
+                assert int(got) == want
+            # ranges agree after each mutating op. The kernel merges
+            # ADJACENT ranges (non-adjacency invariant) which the set
+            # model reproduces by construction of ranges().
+            assert _ranges_of(s, e) == model.ranges(), trial
+
+
+def test_sack_insert_merges_touching():
+    """[0,10) + [10,20) must merge into one range — non-adjacency is
+    an invariant the wire encoder relies on."""
+    s, e = sack.empty()
+    s, e = sack.insert(s, e, jnp.int64(0), jnp.int64(10))
+    s, e = sack.insert(s, e, jnp.int64(10), jnp.int64(20))
+    assert _ranges_of(s, e) == [(0, 20)]
+
+
+def test_sack_overflow_drops_highest():
+    s, e = sack.empty()
+    for a in (0, 100, 200, 300):
+        s, e = sack.insert(s, e, jnp.int64(a), jnp.int64(a + 10))
+    s2, e2, dropped = sack.insert_counted(s, e, jnp.int64(400),
+                                          jnp.int64(410))
+    assert int(dropped) == 1
+    # the highest range (the new [400,410)) was the one discarded
+    assert _ranges_of(s2, e2) == [(0, 10), (100, 110), (200, 210),
+                                  (300, 310)]
+
+
+def test_encode_decode_subset_invariant():
+    """Wire rounding must advertise a SUBSET of the true range
+    (over-claim would stall recovery until RTO — module docstring)."""
+    ack = 1000
+    cases = [(ack + 3, ack + 3 * TCP_MSS + 7),
+             (ack + TCP_MSS, ack + 2 * TCP_MSS),
+             (ack + 1, ack + TCP_MSS)]       # sub-MSS: nothing to say
+    for (ts, te) in cases:
+        s, e = sack.empty()
+        s, e = sack.insert(s, e, jnp.int64(ts), jnp.int64(te))
+        w1, _ = sack.encode2(s, e, jnp.int64(ack))
+        ds, de = sack.decode(jnp.int32(w1), jnp.int64(ack),
+                             jnp.int64(te))
+        if int(ds) >= 0:
+            assert ts <= int(ds) <= int(de) <= te
+        # FINACK bit (bit 0 of the AUX word) must stay clear
+        assert (int(w1) & 1) == 0
+
+
+def test_retransmit_selection_hand_vectors():
+    """Hand-computed recovery scenario against the reference
+    scoreboard's selection (shd-tcp-scoreboard.c:187-281), packets
+    mapped to MSS-sized byte ranges.
+
+    Sent packets 0..9, una=0; peer SACKed {3,4,5} and {7,8}:
+    - reference: fack=8; INFLIGHT 0,1,2 are <= fack-4 -> LOST;
+      getNextRetransmit = 0 (= una). Packets 6 and 9 stay INFLIGHT
+      (within 3 of fack / above fack).
+    - ours (FACK-style, documented divergence): every un-sacked byte
+      below the highest sacked run (9*MSS) is inferably lost, so the
+      recovery bound is lost_bound = min(hole_end, max_end) and the
+      cursor visits 0,1,2 AND 6; bytes >= 9*MSS are never touched.
+    Both agree on the first retransmission (una) and on never
+    resending sacked bytes — the invariants that matter for
+    correctness; the fack-4 holdoff only affects aggressiveness.
+    """
+    M = TCP_MSS
+    s, e = sack.empty()
+    s, e = sack.insert(s, e, jnp.int64(3 * M), jnp.int64(6 * M))
+    s, e = sack.insert(s, e, jnp.int64(7 * M), jnp.int64(9 * M))
+    una, hole_end = 0, 10 * M
+
+    # first retransmit = una (reference: block 0 is LOST, lowest)
+    first = int(sack.skip(jnp.int64(una), s, e))
+    assert first == 0
+    # the cursor never lands inside a sacked run
+    assert int(sack.skip(jnp.int64(3 * M), s, e)) == 6 * M
+    assert int(sack.skip(jnp.int64(7 * M + 1), s, e)) == 9 * M
+    # recovery bound: the highest sacked end, clipped to the recovery
+    # point — bytes at/above 9*MSS are in flight, NOT retransmittable
+    bound = int(sack.lost_bound(s, e, jnp.int64(una),
+                                jnp.int64(hole_end)))
+    assert bound == 9 * M
+    # and a retransmission starting below a sacked run must stop at it
+    assert int(sack.next_start_after(jnp.int64(0), s, e)) == 3 * M
+
+
+def test_lost_bound_no_sack_is_classic_fast_retransmit():
+    """With no SACK info, 3 dupacks retransmit exactly one segment
+    past una (classic fast retransmit)."""
+    s, e = sack.empty()
+    bound = int(sack.lost_bound(s, e, jnp.int64(5000),
+                                jnp.int64(10**9)))
+    assert bound == 5000 + TCP_MSS

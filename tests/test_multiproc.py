@@ -1,0 +1,163 @@
+"""Multiple processes per host (reference: the per-host process LIST,
+shd-configuration.h:36-95; slave_addNewVirtualProcess shd-slave.c:293 —
+the canonical tor+tgen host shape).
+
+Each process slot has its own app kind/config/registers; sockets
+remember their owning process and wakes route back to it. The
+differential harness must hold: both engines run the same per-process
+apps bit-identically.
+"""
+
+import numpy as np
+
+from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario
+from shadow_tpu.engine import defs
+from shadow_tpu.engine.pyengine import PyEngine
+from shadow_tpu.engine.sim import Simulation
+from shadow_tpu.engine.state import EngineConfig
+
+from test_tcp import poi_topology
+
+CFG = dict(qcap=32, scap=12, obcap=16, incap=24, txqcap=12,
+           chunk_windows=8)
+
+
+def _mutual_scen(loss=0.0, stop=40):
+    """Two hosts, each BOTH a server and a client of the other — the
+    minimal process-list shape."""
+    return Scenario(
+        stop_time=stop * 10**9,
+        topology_graphml=poi_topology(loss=loss),
+        hosts=[
+            HostSpec(id="alpha", processes=[
+                ProcessSpec(plugin="bulkserver", start_time=10**9,
+                            arguments="port=80"),
+                ProcessSpec(plugin="bulk", start_time=2 * 10**9,
+                            arguments="peer=beta port=80 size=80000 "
+                                      "count=2 pause=1s")]),
+            HostSpec(id="beta", processes=[
+                ProcessSpec(plugin="bulkserver", start_time=10**9,
+                            arguments="port=80"),
+                ProcessSpec(plugin="bulk", start_time=3 * 10**9,
+                            arguments="peer=alpha port=80 size=50000 "
+                                      "count=1 pause=1s")]),
+        ],
+    )
+
+
+def _diff(scen_fn, n_hosts):
+    from test_differential import TCP_COMPARE
+
+    cfg = EngineConfig(num_hosts=n_hosts, **CFG)
+    jax_stats = Simulation(scen_fn(), engine_cfg=cfg).run().stats
+    py_stats = PyEngine(Simulation(scen_fn(), engine_cfg=cfg)).run()
+    for st in TCP_COMPARE:
+        assert np.array_equal(jax_stats[:, st], py_stats[:, st]), (
+            f"stat {st} diverges:\n jax={jax_stats[:, st]}\n "
+            f"py={py_stats[:, st]}")
+    return jax_stats
+
+
+def test_two_processes_mutual_transfer():
+    stats = _diff(_mutual_scen, 2)
+    # alpha's client pushed 2x80000 to beta's server; beta's client
+    # pushed 1x50000 to alpha's server — both directions complete
+    assert stats[0, defs.ST_BYTES_RECV] == 50000
+    assert stats[1, defs.ST_BYTES_RECV] == 160000
+    # client-side completion counted per host (client is proc 1)
+    assert stats[0, defs.ST_APP_DONE] == 1
+    assert stats[1, defs.ST_APP_DONE] == 1
+
+
+def test_two_processes_lossy():
+    stats = _diff(lambda: _mutual_scen(loss=0.03, stop=80), 2)
+    assert stats[:, defs.ST_RETRANSMIT].sum() > 0
+    assert stats[0, defs.ST_BYTES_RECV] == 50000
+    assert stats[1, defs.ST_BYTES_RECV] == 160000
+
+
+def test_mixed_kinds_per_host():
+    """Different app FAMILIES in one host's process list: a UDP ping
+    server next to a TCP bulk client (and the mirror on the peer)."""
+    def scen():
+        return Scenario(
+            stop_time=30 * 10**9,
+            topology_graphml=poi_topology(),
+            hosts=[
+                HostSpec(id="alpha", processes=[
+                    ProcessSpec(plugin="pingserver", start_time=10**9,
+                                arguments="port=8000"),
+                    ProcessSpec(plugin="bulk", start_time=2 * 10**9,
+                                arguments="peer=beta port=80 "
+                                          "size=60000 count=1 "
+                                          "pause=1s")]),
+                HostSpec(id="beta", processes=[
+                    ProcessSpec(plugin="bulkserver", start_time=10**9,
+                                arguments="port=80"),
+                    ProcessSpec(plugin="ping", start_time=2 * 10**9,
+                                arguments="peer=alpha port=8000 "
+                                          "interval=500ms size=96 "
+                                          "count=8")]),
+            ],
+        )
+
+    stats = _diff(scen, 2)
+    # beta received the 60000-byte bulk stream AND 8 x 96-byte ping
+    # echoes; alpha received the 8 ping requests
+    assert stats[1, defs.ST_BYTES_RECV] == 60000 + 8 * 96
+    assert stats[0, defs.ST_BYTES_RECV] == 8 * 96
+    assert stats[1, defs.ST_RTT_COUNT] == 8           # all pings echoed
+    assert stats[1, defs.ST_APP_DONE] == 1            # ping finished
+
+
+def test_tgen_server_plus_bulk_client():
+    """The verdict's reference shape: a tgen server graph and a bulk
+    client in ONE host's process list (shd-slave.c:293 semantics)."""
+    from test_tgen import SERVER_GRAPH
+
+    def scen():
+        return Scenario(
+            stop_time=40 * 10**9,
+            topology_graphml=poi_topology(),
+            hosts=[
+                HostSpec(id="combo", processes=[
+                    ProcessSpec(plugin="tgen", start_time=10**9,
+                                arguments=SERVER_GRAPH),
+                    ProcessSpec(plugin="bulk", start_time=2 * 10**9,
+                                arguments="peer=peer port=80 "
+                                          "size=40000 count=1 "
+                                          "pause=1s")]),
+                HostSpec(id="peer", processes=[
+                    ProcessSpec(plugin="bulkserver", start_time=10**9,
+                                arguments="port=80")]),
+            ],
+        )
+
+    stats = _diff(scen, 2)
+    assert stats[1, defs.ST_BYTES_RECV] == 40000
+    assert stats[0, defs.ST_APP_DONE] == 1            # bulk client done
+
+
+def test_single_process_shapes_unchanged():
+    """procs_per_host defaults to 1 and single-process scenarios keep
+    the old behavior (regression guard for the [H, P] reshape)."""
+    def scen():
+        return Scenario(
+            stop_time=20 * 10**9,
+            topology_graphml=poi_topology(),
+            hosts=[
+                HostSpec(id="server", processes=[
+                    ProcessSpec(plugin="bulkserver", start_time=10**9,
+                                arguments="port=80")]),
+                HostSpec(id="client", processes=[
+                    ProcessSpec(plugin="bulk", start_time=2 * 10**9,
+                                arguments="peer=server port=80 "
+                                          "size=30000 count=1 "
+                                          "pause=1s")]),
+            ],
+        )
+
+    sim = Simulation(scen(), engine_cfg=EngineConfig(num_hosts=2, **CFG))
+    assert sim.cfg.procs_per_host == 1
+    rep = sim.run()
+    assert rep.summary()["bytes_recv"] == 30000

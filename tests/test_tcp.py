@@ -122,3 +122,27 @@ def test_bulk_throughput_tracks_bandwidth():
     # client uplink 10240*1024 B/s? bandwidths in the graphml are KiB/s
     # (reference semantics); transfer must complete within the sim.
     assert rep.summary()["transfers_done"] == 2
+
+
+def test_odd_bw_stamp_does_not_fake_finack():
+    """Regression: handshake segments carry the peer's bandwidths in
+    AUX, so a peer whose bw_down>>10 is odd (e.g. 12207 KiB/s ~ 100
+    Mbit/s) used to flip AUX bit 0 = AUX_FINACK on its SYN|ACK, and the
+    active opener spuriously marked its (never-sent) FIN as acked.
+    With the ~syn guard, no established-but-open socket may have
+    fin_acked set."""
+    topo = poi_topology(bw_down=977, bw_up=977, latency_ms=20.0)
+    # stop mid-transfer so connections are still open at snapshot time
+    # (977 KiB/s ~ 1 MB/s moves ~3 MB of the 5 MB by the 5 s stop)
+    scen = bulk_scenario(topo, size=5_000_000, count=1, stop=5)
+    sim = Simulation(scen, engine_cfg=EngineConfig(num_hosts=2, qcap=64,
+                                                   scap=4, obcap=32,
+                                                   incap=64,
+                                                   chunk_windows=8))
+    sim.run()
+    import numpy as np
+    from shadow_tpu.net.tcp import TCPS_ESTABLISHED
+    states = np.asarray(sim.final_hosts.sk_state)
+    fin_acked = np.asarray(sim.final_hosts.sk_fin_acked)
+    assert (states == TCPS_ESTABLISHED).sum() >= 2   # both ends open
+    assert not fin_acked.any(), "FINACK leaked from a handshake bw stamp"

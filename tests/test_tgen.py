@@ -362,6 +362,10 @@ def test_tgen_stallout_unit(simple_topology_xml):
     cli = 2
     row = jax.tree.map(lambda x: x[cli], sim.hosts)
     hpr = jax.tree.map(lambda x: x[cli], sim.hp)
+    # apps receive the single-PROCESS view of the [P]-shaped app state
+    # (engine.window._on_app builds it; unit calls build it here)
+    row = row.replace(app_node=row.app_node[0], app_r=row.app_r[0])
+    hpr = hpr.replace(app_kind=hpr.app_kind[0], app_cfg=hpr.app_cfg[0])
     slot = 0
     row = row.replace(
         sk_used=row.sk_used.at[slot].set(True),

@@ -89,7 +89,7 @@ def build_socks(n_hosts, hops=1, stop=60, size=49152, count=0, pause="5s",
     )
 
 
-def socks_caps(n_hosts, scap=96):
+def socks_caps(n_hosts, scap=96, active_block=0):
     """Lean engine caps for the SOCKS/Tor configs (see module doc).
 
     scap: each live circuit holds 2 sockets per relay it crosses plus
@@ -99,11 +99,19 @@ def socks_caps(n_hosts, scap=96):
 
     qcap/incap 96: servers fan in ~8 client streams; a 48-slot queue
     measured 9k arrival drops (and a 20x retransmit amplification) on
-    the 400-host smoke — arrival headroom is the binding constraint.
+    the 400-host smoke — arrival headroom is the binding constraint
+    (round 3: arrivals past the headroom now defer at the source
+    instead of dropping, so undersizing costs windows, not packets).
+
+    active_block: active-set compaction block (engine.window.
+    step_window_pass) — the at-scale SOCKS/Tor shape is exactly the
+    lockstep-skew workload it exists for (a few busy relays, a sea of
+    idle clients).
     """
     from shadow_tpu.engine.state import EngineConfig
     return EngineConfig(num_hosts=n_hosts, qcap=96, scap=scap, obcap=24,
-                        incap=96, txqcap=16, chunk_windows=64)
+                        incap=96, txqcap=16, chunk_windows=64,
+                        active_block=active_block)
 
 
 _TGEN_KEYS = (
@@ -175,29 +183,37 @@ CONFIGS = {
     # name: (builder, caps, default n)
     "socks10k": (lambda n, stop: build_socks(n, hops=1, stop=stop,
                                              count=0, pause="5s"),
-                 lambda n: socks_caps(n, scap=96), 10_000),
+                 lambda n: socks_caps(n, scap=96, active_block=256),
+                 10_000),
     "tor50k": (lambda n, stop: build_socks(n, hops=3, stop=stop,
                                            count=0, pause="10s"),
-               lambda n: socks_caps(n, scap=160), 50_000),
+               lambda n: socks_caps(n, scap=160, active_block=512),
+               50_000),
     "bulk1k": (lambda n, stop: build_bulk_1k(n, stop=stop),
-               lambda n: socks_caps(n, scap=32), 1_000),
+               lambda n: socks_caps(n, scap=32, active_block=128),
+               1_000),
 }
 
 
 def run_config(name, n=None, stop=60, heartbeat=0.0, verbose=False,
-               runahead_ms=0, chunk=0):
+               runahead_ms=0, chunk=0, active_block=None):
     from shadow_tpu.engine.sim import Simulation
 
     builder, capf, n_default = CONFIGS[name]
     n = n or n_default
     scen = builder(n, stop)
     cfg = capf(n)
-    if chunk:
+    if chunk or active_block is not None:
         # a wider runahead packs ~runahead/min-latency more event
         # passes into each window — keep one device dispatch (a chunk)
         # short or the axon worker aborts long-running calls
         import dataclasses
-        cfg = dataclasses.replace(cfg, chunk_windows=chunk)
+        kw = {}
+        if chunk:
+            kw["chunk_windows"] = chunk
+        if active_block is not None:
+            kw["active_block"] = active_block
+        cfg = dataclasses.replace(cfg, **kw)
     sim = Simulation(scen, engine_cfg=cfg)
     if runahead_ms:
         # lookahead override, exactly the reference's --runahead knob
@@ -222,6 +238,9 @@ def run_config(name, n=None, stop=60, heartbeat=0.0, verbose=False,
         "transfers_done": s["transfers_done"],
         "retransmits": s["retransmits"],
         "drop_q": s["drop_q"],
+        "defer_fanin": s["defer_fanin"],
+        "defer_a2a": s["defer_a2a"],
+        "active_block": cfg.active_block,
         "sock_fail": int(report.stats[:, defs.ST_SOCK_FAIL].sum()),
         "capacity": report.capacity_report(),
     }
@@ -243,6 +262,9 @@ def main(argv):
                          "topology's true minimum latency)")
     ap.add_argument("--chunk", type=int, default=0,
                     help="windows per device dispatch override")
+    ap.add_argument("--active-block", type=int, default=None,
+                    help="active-set compaction block override "
+                         "(0 = dense)")
     args = ap.parse_args(argv)
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -250,7 +272,7 @@ def main(argv):
         jax.config.update("jax_platforms", "cpu")
     out = run_config(args.config, n=args.n, stop=args.stop,
                      verbose=args.verbose, runahead_ms=args.runahead_ms,
-                     chunk=args.chunk)
+                     chunk=args.chunk, active_block=args.active_block)
     if args.runahead_ms:
         out["runahead_ms"] = args.runahead_ms
     print(json.dumps(out))

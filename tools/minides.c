@@ -1,0 +1,136 @@
+/* minides: dependency-free compiled-C discrete-event baseline.
+ *
+ * The reference C engine cannot be built in this image (GLib/igraph
+ * absent — see BASELINE.md), so the bench's primary denominator is the
+ * pure-Python reference engine, which understates compiled-code speed.
+ * This program is the honesty check: a minimal binary-heap DES running
+ * the PHOLD shape (the classic DES benchmark the reference ships as a
+ * plugin, /root/reference/src/test/phold/shd-test-phold.c) with the
+ * same workload parameters bench.py uses — N hosts, one initial timer
+ * each, exponential(mean) re-arm, fixed-latency message to a uniform
+ * random peer. It does LESS per-event work than either real engine
+ * (no NIC model, no sockets, no per-packet state), so its events/sec
+ * is an UPPER bound on any full engine's compiled-C throughput —
+ * making the bench's vs-compiled-C ratio conservative.
+ *
+ * Usage: minides <num_hosts> <stop_seconds> [mean_ms] [latency_ms]
+ * Prints one line: events=<N> wall_s=<S> events_per_sec=<R>
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <stdint.h>
+#include <math.h>
+#include <time.h>
+
+typedef struct {
+    int64_t t;      /* ns */
+    int32_t seq;    /* (time, seq) total order, matching event_compare */
+    int32_t host;
+    int32_t kind;   /* 0 = timer fire, 1 = message arrival */
+} Ev;
+
+static Ev *heap;
+static size_t heap_n, heap_cap;
+
+static int ev_lt(const Ev *a, const Ev *b) {
+    if (a->t != b->t) return a->t < b->t;
+    return a->seq < b->seq;
+}
+
+static void heap_push(Ev e) {
+    if (heap_n == heap_cap) {
+        heap_cap *= 2;
+        heap = realloc(heap, heap_cap * sizeof(Ev));
+        if (!heap) { perror("realloc"); exit(1); }
+    }
+    size_t i = heap_n++;
+    heap[i] = e;
+    while (i > 0) {
+        size_t p = (i - 1) / 2;
+        if (!ev_lt(&heap[i], &heap[p])) break;
+        Ev tmp = heap[p]; heap[p] = heap[i]; heap[i] = tmp;
+        i = p;
+    }
+}
+
+static Ev heap_pop(void) {
+    Ev top = heap[0];
+    heap[0] = heap[--heap_n];
+    size_t i = 0;
+    for (;;) {
+        size_t l = 2 * i + 1, r = l + 1, m = i;
+        if (l < heap_n && ev_lt(&heap[l], &heap[m])) m = l;
+        if (r < heap_n && ev_lt(&heap[r], &heap[m])) m = r;
+        if (m == i) break;
+        Ev tmp = heap[m]; heap[m] = heap[i]; heap[i] = tmp;
+        i = m;
+    }
+    return top;
+}
+
+/* xorshift128+ — fast deterministic PRNG (public-domain algorithm) */
+static uint64_t rs[2] = {0x9E3779B97F4A7C15ull, 0xBF58476D1CE4E5B9ull};
+static uint64_t rnext(void) {
+    uint64_t x = rs[0], y = rs[1];
+    rs[0] = y;
+    x ^= x << 23;
+    rs[1] = x ^ y ^ (x >> 17) ^ (y >> 26);
+    return rs[1] + y;
+}
+static double runif(void) { return (rnext() >> 11) * (1.0 / 9007199254740992.0); }
+
+int main(int argc, char **argv) {
+    if (argc < 3) {
+        fprintf(stderr, "usage: %s <hosts> <stop_s> [mean_ms] [lat_ms]\n",
+                argv[0]);
+        return 2;
+    }
+    int n = atoi(argv[1]);
+    double stop_s = atof(argv[2]);
+    double mean_ms = argc > 3 ? atof(argv[3]) : 500.0;
+    double lat_ms = argc > 4 ? atof(argv[4]) : 25.0;
+    int64_t stop = (int64_t)(stop_s * 1e9);
+    int64_t lat = (int64_t)(lat_ms * 1e6);
+    int32_t seq = 0;
+
+    heap_cap = (size_t)n * 4 + 64;
+    heap_n = 0;
+    heap = malloc(heap_cap * sizeof(Ev));
+    if (!heap) { perror("malloc"); return 1; }
+
+    /* init=1: one initial timer per host at start + exp(mean) */
+    for (int h = 0; h < n; h++) {
+        int64_t d = (int64_t)(-mean_ms * 1e6 * log(1.0 - runif()));
+        Ev e = {1000000000LL + (d > 0 ? d : 1), seq++, h, 0};
+        heap_push(e);
+    }
+
+    struct timespec t0, t1;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+    long long events = 0;
+    while (heap_n > 0) {
+        Ev e = heap_pop();
+        if (e.t >= stop) break;
+        events++;
+        if (e.kind == 0) {
+            /* timer fire: send a message to a uniform random peer */
+            int peer = (int)(runif() * n);
+            if (peer >= n) peer = n - 1;
+            if (peer == e.host) peer = (peer + 1) % n;
+            Ev m = {e.t + lat, seq++, peer, 1};
+            heap_push(m);
+        } else {
+            /* arrival: re-arm the exponential timer */
+            int64_t d = (int64_t)(-mean_ms * 1e6 * log(1.0 - runif()));
+            Ev m = {e.t + (d > 0 ? d : 1), seq++, e.host, 0};
+            heap_push(m);
+        }
+    }
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    double wall = (t1.tv_sec - t0.tv_sec) + (t1.tv_nsec - t0.tv_nsec) * 1e-9;
+    printf("events=%lld wall_s=%.6f events_per_sec=%.1f\n",
+           events, wall, wall > 0 ? events / wall : 0.0);
+    free(heap);
+    return 0;
+}
