@@ -1,0 +1,103 @@
+/* epserver: a plain, UNMODIFIED epoll-based TCP sink server.
+ *
+ * Uses only ordinary libc networking (socket, bind, listen, accept4,
+ * epoll, recv-until-EOF) — no simulator headers. The same binary runs:
+ *   natively:   ./epserver <port> <count>
+ *               serving any TCP uploader (e.g. epclient) on localhost;
+ *   simulated:  plugin="hosted:shim" cmd=.../epserver <port> <count>
+ *               via the LD_PRELOAD shim (shadow_tpu/hosting/shim*),
+ *               serving SIMULATED clients.
+ *
+ * Serves exactly <count> connections: accept, read until EOF, close.
+ * Prints one summary line:
+ *   epserver done transfers=N bytes=B
+ * which must match between native and simulated runs — the server half
+ * of the reference's dual-build test pattern (SURVEY §4; the reference
+ * builds every test as a native binary AND a shadow plugin).
+ */
+#define _GNU_SOURCE      /* accept4 */
+#include <errno.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+#include <fcntl.h>
+
+static int fatal(const char *msg) { perror(msg); exit(1); }
+
+int main(int argc, char **argv) {
+    if (argc < 3) {
+        fprintf(stderr, "usage: %s <port> <count>\n", argv[0]);
+        return 2;
+    }
+    int port = atoi(argv[1]);
+    int count = atoi(argv[2]);
+
+    int ls = socket(AF_INET, SOCK_STREAM, 0);
+    if (ls < 0) fatal("socket");
+    int one = 1;
+    setsockopt(ls, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    struct sockaddr_in addr;
+    memset(&addr, 0, sizeof addr);
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons((uint16_t)port);
+    if (bind(ls, (struct sockaddr *)&addr, sizeof addr) < 0) fatal("bind");
+    if (listen(ls, 64) < 0) fatal("listen");
+    fcntl(ls, F_SETFL, O_NONBLOCK);
+
+    int ep = epoll_create1(0);
+    if (ep < 0) fatal("epoll_create1");
+    struct epoll_event ev;
+    ev.events = EPOLLIN;
+    ev.data.fd = ls;
+    if (epoll_ctl(ep, EPOLL_CTL_ADD, ls, &ev) < 0) fatal("epoll_ctl");
+
+    char *buf = malloc(65536);
+    long total = 0;
+    int served = 0;
+
+    struct epoll_event evs[8];
+    while (served < count) {
+        int n = epoll_wait(ep, evs, 8, -1);
+        if (n < 0) fatal("epoll_wait");
+        for (int i = 0; i < n; i++) {
+            int fd = evs[i].data.fd;
+            if (fd == ls) {
+                for (;;) {
+                    int c = accept4(ls, NULL, NULL, SOCK_NONBLOCK);
+                    if (c < 0) {
+                        if (errno == EAGAIN || errno == EWOULDBLOCK)
+                            break;
+                        fatal("accept4");
+                    }
+                    ev.events = EPOLLIN | EPOLLRDHUP;
+                    ev.data.fd = c;
+                    if (epoll_ctl(ep, EPOLL_CTL_ADD, c, &ev) < 0)
+                        fatal("epoll_ctl(child)");
+                }
+                continue;
+            }
+            for (;;) {
+                ssize_t m = recv(fd, buf, 65536, 0);
+                if (m > 0) { total += m; continue; }
+                if (m == 0) {                     /* clean EOF */
+                    epoll_ctl(ep, EPOLL_CTL_DEL, fd, NULL);
+                    close(fd);
+                    served++;
+                    break;
+                }
+                if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+                fatal("recv");
+            }
+        }
+    }
+    printf("epserver done transfers=%d bytes=%ld\n", served, total);
+    free(buf);
+    close(ls);
+    close(ep);
+    return served == count ? 0 : 1;
+}

@@ -1,0 +1,72 @@
+/* uping: a plain, UNMODIFIED UDP ping client (sendto/recvfrom).
+ *
+ * Sends <count> datagrams of <bytes> to <host>:<port> and waits for
+ * each echo — ordinary libc only (getaddrinfo, sendto, recvfrom,
+ * epoll). The same binary runs:
+ *   natively:   ./uping <host> <port> <bytes> <count>
+ *               against any UDP echo server;
+ *   simulated:  plugin="hosted:shim" cmd=.../uping ... against the
+ *               simulator's modeled pingserver app.
+ * Prints: uping done echoes=N bytes=B
+ */
+#include <errno.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+#include <fcntl.h>
+
+static int fatal(const char *msg) { perror(msg); exit(1); }
+
+int main(int argc, char **argv) {
+    if (argc < 5) {
+        fprintf(stderr, "usage: %s <host> <port> <bytes> <count>\n",
+                argv[0]);
+        return 2;
+    }
+    const char *host = argv[1], *port = argv[2];
+    long nbytes = atol(argv[3]);
+    int count = atoi(argv[4]);
+
+    struct addrinfo hints, *ai;
+    memset(&hints, 0, sizeof hints);
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_DGRAM;
+    if (getaddrinfo(host, port, &hints, &ai) != 0) fatal("getaddrinfo");
+
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    if (fd < 0) fatal("socket");
+    fcntl(fd, F_SETFL, O_NONBLOCK);
+
+    int ep = epoll_create1(0);
+    if (ep < 0) fatal("epoll_create1");
+    struct epoll_event ev, out;
+    ev.events = EPOLLIN;
+    ev.data.fd = fd;
+    if (epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ev) < 0) fatal("epoll_ctl");
+
+    char *buf = calloc(1, 65536);
+    long total = 0;
+    int echoes = 0;
+    for (int i = 0; i < count; i++) {
+        if (sendto(fd, buf, (size_t)nbytes, 0, ai->ai_addr,
+                   ai->ai_addrlen) < 0)
+            fatal("sendto");
+        for (;;) {
+            ssize_t n = recvfrom(fd, buf, 65536, 0, NULL, NULL);
+            if (n >= 0) { total += n; echoes++; break; }
+            if (errno != EAGAIN) fatal("recvfrom");
+            if (epoll_wait(ep, &out, 1, 30000) < 1)
+                fatal("epoll_wait(echo timeout)");
+        }
+    }
+    printf("uping done echoes=%d bytes=%ld\n", echoes, total);
+    freeaddrinfo(ai);
+    free(buf);
+    close(fd);
+    return echoes == count ? 0 : 1;
+}

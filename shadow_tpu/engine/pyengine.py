@@ -81,7 +81,8 @@ def _new_sock():
         "hole_end": 0, "rex_nxt": 0, "peer_fin": -1,
         "fin_acked": False, "close_after": False,
         "cwnd": np.float32(0.0), "ssthresh": np.float32(0.0),
-        "srtt": -1, "rttvar": 0, "rto": TCP_RTO_INIT, "rto_deadline": 0,
+        "srtt": -1, "rtt_min": -1, "rttvar": 0,
+        "rto": TCP_RTO_INIT, "rto_deadline": 0,
         "timer_on": False, "timer_gen": 0, "dupacks": 0,
         "rtt_seq": -1, "rtt_time": 0, "ctl": 0,
         "peer_rwnd": RECV_BUFFER_SIZE,
@@ -757,6 +758,8 @@ class PyEngine:
             hs_rtt = now - sk["hs_time"]
             sk["srtt"], sk["rttvar"], sk["rto"] = self._rfc6298(
                 sk["srtt"], sk["rttvar"], hs_rtt)
+            sk["rtt_min"] = (min(sk["rtt_min"], hs_rtt)
+                             if sk["rtt_min"] > 0 else hs_rtt)
             sk["rto_deadline"] = 0
             self._wake(host, now,
                        WAKE_CONNECTED if estA else WAKE_ACCEPT, slot,
@@ -805,10 +808,12 @@ class PyEngine:
         cw0, ss0 = sk["cwnd"], sk["ssthresh"]
         wm0, ep0, k0 = sk["cc_wmax"], sk["cc_epoch"], sk["cc_k"]
         if new_ack:
+            # delayMin for the rate cap (pre-this-sample, as on device)
+            delay_ns = sk["rtt_min"] if sk["rtt_min"] > 0 else sk["srtt"]
             cw_a, ep_a, k_a = CC.on_ack(
                 jnp.int32(self.cc_kind), jnp.float32(cw0), jnp.float32(ss0),
                 jnp.float32(wm0), jnp.int64(ep0), jnp.float32(k0),
-                jnp.int64(npkts), jnp.int64(now), jnp.int64(sk["srtt"]))
+                jnp.int64(npkts), jnp.int64(now), jnp.int64(delay_ns))
             cw_a, ep_a, k_a = (np.float32(cw_a), int(ep_a), np.float32(k_a))
         if fast_rx:
             cw_l, ss_l, wm_l, ep_l = CC.on_loss(
@@ -822,8 +827,11 @@ class PyEngine:
         if valid_ack:
             sk["peer_rwnd"] = max(int(pkt[P.WND]), 1)
         if sample_ok:
+            rtt_sample = max(now - sk["rtt_time"], 1)
             sk["srtt"], sk["rttvar"], sk["rto"] = self._rfc6298(
-                sk["srtt"], sk["rttvar"], max(now - sk["rtt_time"], 1))
+                sk["srtt"], sk["rttvar"], rtt_sample)
+            sk["rtt_min"] = (min(sk["rtt_min"], rtt_sample)
+                             if sk["rtt_min"] > 0 else rtt_sample)
             sk["rtt_seq"] = -1
         if fast_rx:
             sk["cwnd"], sk["ssthresh"] = cw_l, ss_l

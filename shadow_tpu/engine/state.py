@@ -151,6 +151,9 @@ class Hosts:
     sk_cwnd: jnp.ndarray     # f32 congestion window (bytes)
     sk_ssthresh: jnp.ndarray  # f32
     sk_srtt: jnp.ndarray     # i64 (-1 until first sample; RFC6298)
+    sk_rtt_min: jnp.ndarray  # i64 minimum RTT sample seen (-1 none) —
+    #   the reference cubic's delayMin (shd-tcp-cubic.c:121-126),
+    #   which bounds the growth-rate cap in net.congestion.on_ack
     sk_rttvar: jnp.ndarray   # i64
     sk_rto: jnp.ndarray      # i64 current retransmission timeout
     sk_rto_deadline: jnp.ndarray  # i64 desired timer expiration (0 = off)
@@ -319,6 +322,7 @@ def alloc_hosts(cfg: EngineConfig) -> Hosts:
         sk_cwnd=full((H, S), 0.0, jnp.float32),
         sk_ssthresh=full((H, S), 0.0, jnp.float32),
         sk_srtt=full((H, S), -1, jnp.int64),
+        sk_rtt_min=full((H, S), -1, jnp.int64),
         sk_rttvar=full((H, S), 0, jnp.int64),
         sk_rto=full((H, S), C.TCP_RTO_INIT, jnp.int64),
         sk_rto_deadline=full((H, S), 0, jnp.int64),

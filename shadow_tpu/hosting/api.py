@@ -173,7 +173,12 @@ class HostedApp:
     def on_connected(self, os: HostOS, sock: Sock):
         pass
 
-    def on_accept(self, os: HostOS, sock: Sock, tag: int):
+    def on_accept(self, os: HostOS, sock: Sock, tag: int, dport: int = 0,
+                  peer: tuple = (0, 0)):
+        """`sock` is the accepted CHILD connection; `dport` the local
+        port it arrived on (identifies the listener when the app has
+        several); `peer` = (virtual host id, port) of the connecting
+        client."""
         pass
 
     def on_eof(self, os: HostOS, sock: Sock):

@@ -221,7 +221,7 @@ class CPluginApp(HostedApp):
     def on_eof(self, os, sock):
         self._wake(os, 4, a=self._handle_of_slot(sock))
 
-    def on_accept(self, os, sock, tag):
+    def on_accept(self, os, sock, tag, dport=0, peer=(0, 0)):
         self._wake(os, 5, a=self._handle_of_slot(sock), b=tag)
 
     def on_sent(self, os, sock):

@@ -80,7 +80,12 @@ class HostingRuntime:
             elif reason == WAKE_CONNECTED:
                 app.on_connected(os, sock)
             elif reason == WAKE_ACCEPT:
-                app.on_accept(os, sock, int(wake[P.APP]))
+                # the accept wake rides the SYN packet: SRC/SPORT are
+                # the connecting client's identity, DPORT the listener
+                app.on_accept(os, sock, int(wake[P.APP]),
+                              dport=int(wake[P.DPORT]),
+                              peer=(int(wake[P.SRC]),
+                                    int(wake[P.SPORT])))
             elif reason == WAKE_EOF:
                 app.on_eof(os, sock)
             elif reason == WAKE_SENT:

@@ -32,6 +32,13 @@ Protocol (one request, one response, in lockstep — the child is
 single-threaded between epoll_waits):
   request  = <iiqq64s>  op, a, b, c, name  (88 bytes)
   response = <qqq>      r0, r1, r2         (24 bytes)
+  OP_EPOLL_WAIT responses with r0 = n > 0 carry n trailing <qq>
+  (fd, events) pairs — multi-event waits honoring maxevents.
+
+Round 3: the full SERVER path (bind/listen/accept) and UDP
+(sendto/recvfrom) — an unmodified epoll server binary accepts
+simulated clients, mirroring the reference's server-side process_emu
+surface (shd-process.c:1993-2605).
 """
 
 from __future__ import annotations
@@ -44,6 +51,7 @@ from .api import HostedApp, register
 
 REQ = struct.Struct("<iiqq64s")
 RSP = struct.Struct("<qqq")
+EVPAIR = struct.Struct("<qq")
 
 OP_SOCKET = 1
 OP_CONNECT = 2
@@ -56,12 +64,18 @@ OP_EPOLL_CTL = 8
 OP_EPOLL_WAIT = 9
 OP_CLOCK = 10
 OP_RESOLVE = 11
+OP_BIND = 12
+OP_LISTEN = 13
+OP_ACCEPT = 14
+OP_SENDTO = 15
+OP_RECVFROM = 16
 
 EPOLLIN = 0x001
 EPOLLOUT = 0x004
 EPOLLRDHUP = 0x2000
 EPOLLHUP = 0x010
 EINPROGRESS = 115
+ENOTCONN = 107
 EAGAIN = 11
 
 EPOLL_CTL_ADD = 1
@@ -104,15 +118,21 @@ def build_shim(out_dir: str = None) -> str:
 class _VSock:
     """Shim-side view of one virtual socket fd."""
 
-    __slots__ = ("sock", "avail", "eof", "connected", "closed", "key")
+    __slots__ = ("sock", "avail", "eof", "connected", "closed", "key",
+                 "kind", "bound_port", "accept_q", "dgrams", "dgram_dst")
 
-    def __init__(self):
+    def __init__(self, kind="tcp"):
         self.sock = None        # hosting.api.Sock once connect issued
         self.avail = 0          # delivered-but-unread byte count
         self.eof = False
         self.connected = False
         self.closed = False
         self.key = None         # (slot, gen) once resolved
+        self.kind = kind        # "tcp" | "udp" | "listen"
+        self.bound_port = 0
+        self.accept_q = []      # listener: (child Sock, src, sport)
+        self.dgrams = []        # udp: (src_host, sport, nbytes)
+        self.dgram_dst = None   # udp: connect()ed default destination
 
 
 class ShimApp(HostedApp):
@@ -177,27 +197,48 @@ class ShimApp(HostedApp):
         if vs is None:
             return 0
         ev = 0
+        if vs.kind == "listen":
+            if vs.accept_q:
+                ev |= EPOLLIN
+            return ev
+        if vs.kind == "udp":
+            if vs.dgrams:
+                ev |= EPOLLIN
+            ev |= EPOLLOUT          # modeled datagrams never block
+            return ev
         if vs.avail > 0 or vs.eof:
             ev |= EPOLLIN | (EPOLLRDHUP if vs.eof else 0)
         if vs.connected:
             ev |= EPOLLOUT
         return ev
 
-    def _ready(self, vepfd):
+    def _ready(self, vepfd, maxevents=1):
+        hits = []
         for vfd, interest in self.epolls.get(vepfd, {}).items():
             ev = self._events_of(vfd) & (interest | EPOLLRDHUP | EPOLLHUP)
             if ev:
-                return vfd, ev
-        return None
+                hits.append((vfd, ev))
+                if len(hits) >= maxevents:
+                    break
+        return hits
+
+    def _rsp_events(self, hits):
+        """Multi-event epoll_wait answer: header with the count, then
+        one (fd, events) pair per event (shim_preload.c evpair)."""
+        out = RSP.pack(len(hits), 0, 0)
+        for vfd, ev in hits:
+            out += EVPAIR.pack(vfd, ev)
+        self.chan.sendall(out)
 
     def _maybe_unpark(self):
         if self.parked is None:
             return False
-        hit = self._ready(self.parked)
-        if hit is None:
+        epfd, maxev = self.parked
+        hits = self._ready(epfd, maxev)
+        if not hits:
             return False
         self.parked = None
-        self._rsp(1, hit[0], hit[1])
+        self._rsp_events(hits)
         return True
 
     # --- the service loop: run the child until it blocks ---
@@ -218,25 +259,102 @@ class ShimApp(HostedApp):
         if op == OP_SOCKET:
             vfd = self.next_fd
             self.next_fd += 1
-            self.vfds[vfd] = _VSock()
+            self.vfds[vfd] = _VSock(kind="udp" if a else "tcp")
             self._rsp(vfd)
-        elif op == OP_CONNECT:
+        elif op == OP_BIND:
             vs = self.vfds[a]
-            vs.sock = os.tcp_connect(int(b), int(c))
+            vs.bound_port = int(b)
+            if vs.kind == "udp":
+                vs.sock = os.udp_open(port=int(b))
+                self.by_sock[id(vs.sock)] = a
+            self._rsp(0)
+        elif op == OP_LISTEN:
+            vs = self.vfds[a]
+            vs.kind = "listen"
+            vs.sock = os.tcp_listen(vs.bound_port)
             self.by_sock[id(vs.sock)] = a
-            self._rsp(-1, EINPROGRESS)   # completes via EPOLLOUT
-        elif op == OP_SEND:
+            self._rsp(0)
+        elif op == OP_ACCEPT:
             vs = self.vfds[a]
-            os.write(vs.sock, int(b))
-            self._rsp(b)
-        elif op == OP_RECV:
-            vs = self.vfds[a]
-            n = min(vs.avail, int(b))
-            vs.avail -= n
-            if n == 0 and not vs.eof:
+            if not vs.accept_q:
                 self._rsp(-1, EAGAIN)
             else:
-                self._rsp(n)             # 0 = EOF
+                child, src, sport = vs.accept_q.pop(0)
+                cfd = self.next_fd
+                self.next_fd += 1
+                cvs = _VSock(kind="tcp")
+                cvs.sock = child
+                cvs.connected = True
+                self.vfds[cfd] = cvs
+                self.by_sock[id(child)] = cfd
+                if child.slot is not None:
+                    self.by_key[(child.slot, child.gen)] = cfd
+                    cvs.key = (child.slot, child.gen)
+                # peer identity: (virtual host id, port) off the
+                # handshake — servers keying state by accept() address
+                # see distinct simulated clients
+                self._rsp(cfd, src, sport)
+        elif op == OP_SENDTO:
+            vs = self.vfds[a]
+            if vs.sock is None:        # unbound UDP: ephemeral port
+                vs.sock = os.udp_open(port=0)
+                self.by_sock[id(vs.sock)] = a
+            dst = int(c) >> 16
+            port = int(c) & 0xFFFF
+            os.sendto(vs.sock, dst, port, int(b))
+            self._rsp(b)
+        elif op == OP_RECVFROM:
+            vs = self.vfds[a]
+            if not vs.dgrams:
+                self._rsp(-1, EAGAIN)
+            else:
+                src, sport, nbytes = vs.dgrams.pop(0)
+                self._rsp(min(int(b), nbytes), src, sport)
+        elif op == OP_CONNECT:
+            vs = self.vfds[a]
+            if vs.kind == "udp":
+                # connected-UDP: record the default destination; no
+                # handshake, succeeds immediately
+                vs.bound_port = -1       # marker unused for udp
+                vs.dgram_dst = (int(b), int(c))
+                if vs.sock is None:
+                    vs.sock = os.udp_open(port=0)
+                    self.by_sock[id(vs.sock)] = a
+                self._rsp(0)
+            else:
+                vs.sock = os.tcp_connect(int(b), int(c))
+                self.by_sock[id(vs.sock)] = a
+                self._rsp(-1, EINPROGRESS)  # completes via EPOLLOUT
+        elif op == OP_SEND:
+            vs = self.vfds[a]
+            if vs.kind == "udp":
+                if vs.dgram_dst is None:
+                    self._rsp(-1, ENOTCONN)
+                else:
+                    dst, port = vs.dgram_dst
+                    if vs.sock is None:
+                        vs.sock = os.udp_open(port=0)
+                        self.by_sock[id(vs.sock)] = a
+                    os.sendto(vs.sock, dst, port, int(b))
+                    self._rsp(b)
+            else:
+                os.write(vs.sock, int(b))
+                self._rsp(b)
+        elif op == OP_RECV:
+            vs = self.vfds[a]
+            if vs.kind == "udp":         # recv() on a datagram socket
+                if not vs.dgrams:
+                    self._rsp(-1, EAGAIN)
+                else:
+                    _src, _sp, nbytes = vs.dgrams.pop(0)
+                    self._rsp(min(int(b), nbytes))
+            else:
+                n = min(vs.avail, int(b))
+                vs.avail -= n
+                if n == 0 and not vs.eof:
+                    self._rsp(-1, EAGAIN)
+                else:
+                    self._rsp(n)         # 0 = EOF
         elif op in (OP_CLOSE, OP_SHUTDOWN):
             vs = self.vfds.get(a)
             if vs is not None and vs.sock is not None and not vs.closed:
@@ -266,13 +384,14 @@ class ShimApp(HostedApp):
                 watch[int(c)] = events
             self._rsp(0)
         elif op == OP_EPOLL_WAIT:
-            hit = self._ready(a)
-            if hit is not None:
-                self._rsp(1, hit[0], hit[1])
+            maxev = max(int(c), 1)
+            hits = self._ready(a, maxev)
+            if hits:
+                self._rsp_events(hits)
             elif b == 0:
                 self._rsp(0)             # pure poll: never parks
             else:
-                self.parked = a          # block until a wake readies it
+                self.parked = (a, maxev)  # block until a wake readies it
                 self.park_seq += 1
                 if b > 0:                # bounded wait: sim-time timer,
                     # tagged with this park's sequence so a stale timer
@@ -314,11 +433,28 @@ class ShimApp(HostedApp):
             vs.connected = True
         self._service(os)
 
+    def on_accept(self, os, sock, tag, dport=0, peer=(0, 0)):
+        # queue the accepted child on its listener (matched by bound
+        # port; fall back to the only listener when ports are unset)
+        target = None
+        for vs in self.vfds.values():
+            if vs.kind == "listen":
+                if vs.bound_port == dport or target is None:
+                    target = vs
+                    if vs.bound_port == dport:
+                        break
+        if target is not None:
+            target.accept_q.append((sock, peer[0], peer[1]))
+        self._service(os)
+
     def on_dgram(self, os, sock, src, sport, nbytes, aux):
-        # TCP delivered-bytes wake (reason WAKE_SOCKET)
+        # WAKE_SOCKET: TCP delivered bytes, or a UDP datagram
         _, vs = self._vs_of(sock)
         if vs is not None:
-            vs.avail += int(nbytes)
+            if vs.kind == "udp":
+                vs.dgrams.append((int(src), int(sport), int(nbytes)))
+            else:
+                vs.avail += int(nbytes)
         self._service(os)
 
     def on_eof(self, os, sock):
@@ -336,7 +472,7 @@ class ShimApp(HostedApp):
         epfd = tag & 0xFFFFFF
         seq = tag >> 24
         if (self.parked is not None and
-                (self.parked & 0xFFFFFF) == epfd and
+                (self.parked[0] & 0xFFFFFF) == epfd and
                 seq == self.park_seq):
             self.parked = None
             self._rsp(0)

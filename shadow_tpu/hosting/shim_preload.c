@@ -35,10 +35,14 @@
 enum {
     OP_SOCKET = 1, OP_CONNECT, OP_SEND, OP_RECV, OP_CLOSE, OP_SHUTDOWN,
     OP_EPOLL_CREATE, OP_EPOLL_CTL, OP_EPOLL_WAIT, OP_CLOCK, OP_RESOLVE,
+    OP_BIND, OP_LISTEN, OP_ACCEPT, OP_SENDTO, OP_RECVFROM,
 };
 
 struct req { int32_t op; int32_t a; int64_t b; int64_t c; char name[64]; };
 struct rsp { int64_t r0; int64_t r1; int64_t r2; };
+/* OP_EPOLL_WAIT responses with r0 = n > 0 are followed by n of these
+ * (multi-event wait honoring maxevents; see shim.py _rsp_events) */
+struct evpair { int64_t fd; int64_t events; };
 
 static int chan_fd = -1;
 static ssize_t (*real_send)(int, const void *, size_t, int);
@@ -113,7 +117,101 @@ static int is_vfd(int fd) { return fd >= VFD_BASE; }
 int socket(int domain, int type, int protocol) {
     if (!active() || domain != AF_INET)
         return real_socket(domain, type, protocol);
-    return (int)call(OP_SOCKET, 0, 0, 0, NULL).r0;
+    int dgram = (type & 0xFF) == SOCK_DGRAM;
+    return (int)call(OP_SOCKET, dgram, 0, 0, NULL).r0;
+}
+
+int bind(int fd, const struct sockaddr *addr, socklen_t len) {
+    if (!active() || !is_vfd(fd)) {
+        static int (*real_bind)(int, const struct sockaddr *, socklen_t);
+        if (!real_bind) real_bind = dlsym(RTLD_NEXT, "bind");
+        return real_bind(fd, addr, len);
+    }
+    const struct sockaddr_in *a = (const struct sockaddr_in *)addr;
+    struct rsp r = call(OP_BIND, fd, ntohs(a->sin_port), 0, NULL);
+    if (r.r0 < 0) { errno = (int)r.r1; return -1; }
+    return 0;
+}
+
+int listen(int fd, int backlog) {
+    if (!active() || !is_vfd(fd)) {
+        static int (*real_listen)(int, int);
+        if (!real_listen) real_listen = dlsym(RTLD_NEXT, "listen");
+        return real_listen(fd, backlog);
+    }
+    struct rsp r = call(OP_LISTEN, fd, backlog, 0, NULL);
+    if (r.r0 < 0) { errno = (int)r.r1; return -1; }
+    return 0;
+}
+
+int accept4(int fd, struct sockaddr *addr, socklen_t *len, int flags) {
+    if (!active() || !is_vfd(fd)) {
+        static int (*real_accept4)(int, struct sockaddr *, socklen_t *,
+                                   int);
+        if (!real_accept4) real_accept4 = dlsym(RTLD_NEXT, "accept4");
+        return real_accept4(fd, addr, len, flags);
+    }
+    (void)flags;                       /* children are always virtual */
+    struct rsp r = call(OP_ACCEPT, fd, 0, 0, NULL);
+    if (r.r0 < 0) { errno = (int)r.r1; return -1; }
+    if (addr && len && *len >= sizeof(struct sockaddr_in)) {
+        struct sockaddr_in *a = (struct sockaddr_in *)addr;
+        memset(a, 0, sizeof *a);
+        a->sin_family = AF_INET;
+        a->sin_addr.s_addr = (uint32_t)r.r1;  /* virtual peer host id */
+        a->sin_port = htons((uint16_t)r.r2);
+        *len = sizeof *a;
+    }
+    return (int)r.r0;
+}
+
+int accept(int fd, struct sockaddr *addr, socklen_t *len) {
+    if (!active() || !is_vfd(fd)) {
+        static int (*real_accept)(int, struct sockaddr *, socklen_t *);
+        if (!real_accept) real_accept = dlsym(RTLD_NEXT, "accept");
+        return real_accept(fd, addr, len);
+    }
+    return accept4(fd, addr, len, 0);
+}
+
+ssize_t sendto(int fd, const void *buf, size_t n, int flags,
+               const struct sockaddr *addr, socklen_t alen) {
+    if (!active() || !is_vfd(fd)) {
+        static ssize_t (*real_sendto)(int, const void *, size_t, int,
+                                      const struct sockaddr *, socklen_t);
+        if (!real_sendto) real_sendto = dlsym(RTLD_NEXT, "sendto");
+        return real_sendto(fd, buf, n, flags, addr, alen);
+    }
+    (void)buf;
+    if (!addr) return send(fd, buf, n, flags);
+    const struct sockaddr_in *a = (const struct sockaddr_in *)addr;
+    int64_t packed = ((int64_t)a->sin_addr.s_addr << 16) |
+                     (int64_t)ntohs(a->sin_port);
+    struct rsp r = call(OP_SENDTO, fd, (int64_t)n, packed, NULL);
+    if (r.r0 < 0) { errno = (int)r.r1; return -1; }
+    return (ssize_t)r.r0;
+}
+
+ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
+                 struct sockaddr *addr, socklen_t *alen) {
+    if (!active() || !is_vfd(fd)) {
+        static ssize_t (*real_recvfrom)(int, void *, size_t, int,
+                                        struct sockaddr *, socklen_t *);
+        if (!real_recvfrom) real_recvfrom = dlsym(RTLD_NEXT, "recvfrom");
+        return real_recvfrom(fd, buf, n, flags, addr, alen);
+    }
+    struct rsp r = call(OP_RECVFROM, fd, (int64_t)n, 0, NULL);
+    if (r.r0 < 0) { errno = (int)r.r1; return -1; }
+    memset(buf, 0, (size_t)r.r0);      /* counts modeled, bytes not */
+    if (addr && alen && *alen >= sizeof(struct sockaddr_in)) {
+        struct sockaddr_in *a = (struct sockaddr_in *)addr;
+        memset(a, 0, sizeof *a);
+        a->sin_family = AF_INET;
+        a->sin_addr.s_addr = (uint32_t)r.r1;  /* virtual src host id */
+        a->sin_port = htons((uint16_t)r.r2);
+        *alen = sizeof *a;
+    }
+    return (ssize_t)r.r0;
 }
 
 int connect(int fd, const struct sockaddr *addr, socklen_t len) {
@@ -181,12 +279,24 @@ int epoll_wait(int epfd, struct epoll_event *evs, int maxevents,
                int timeout) {
     if (!active() || !is_vfd(epfd))
         return real_epoll_wait(epfd, evs, maxevents, timeout);
-    (void)maxevents;
-    struct rsp r = call(OP_EPOLL_WAIT, epfd, timeout, 0, NULL);
+    if (maxevents < 1) { errno = EINVAL; return -1; }
+    struct rsp r = call(OP_EPOLL_WAIT, epfd, timeout, maxevents, NULL);
     if (r.r0 <= 0) return (int)r.r0;
-    evs[0].events = (uint32_t)r.r2;
-    evs[0].data.fd = (int)r.r1;
-    return 1;
+    /* r0 = n ready events; read the n trailing (fd, events) pairs */
+    int n = (int)r.r0;
+    for (int i = 0; i < n; i++) {
+        struct evpair p;
+        size_t off = 0;
+        while (off < sizeof p) {
+            ssize_t m = real_read(chan_fd, (char *)&p + off,
+                                  sizeof p - off);
+            if (m <= 0) { errno = EPIPE; return i; }
+            off += (size_t)m;
+        }
+        evs[i].events = (uint32_t)p.events;
+        evs[i].data.fd = (int)p.fd;
+    }
+    return n;
 }
 
 int clock_gettime(clockid_t clk, struct timespec *ts) {

@@ -48,9 +48,10 @@ def on_ack(kind, cwnd, ssthresh, wmax, epoch, k, npkts, now, srtt_ns):
 
     Args are per-socket scalars (or broadcastable arrays); `kind` is the
     runtime cc selector, `npkts` the number of full segments this ACK
-    newly covered, `now` sim time ns, `srtt_ns` the socket's smoothed
-    RTT (<=0 before the first sample: falls back to the reference's
-    100ms default, shd-tcp-cubic.c:72-74).
+    newly covered, `now` sim time ns, `srtt_ns` the socket's delayMin
+    (minimum RTT sample; callers fall back to srtt before the first
+    min) — <=0 falls back to the reference's 100ms default
+    (shd-tcp-cubic.c:72-74).
     Returns (cwnd', epoch', k').
     """
     npkts_f = npkts.astype(jnp.float32)

@@ -84,6 +84,7 @@ def sock_alloc(row, proto):
         sk_cwnd=setf(row.sk_cwnd, 0.0, jnp.float32),
         sk_ssthresh=setf(row.sk_ssthresh, 0.0, jnp.float32),
         sk_srtt=setf(row.sk_srtt, -1, jnp.int64),
+        sk_rtt_min=setf(row.sk_rtt_min, -1, jnp.int64),
         sk_rttvar=setf(row.sk_rttvar, 0, jnp.int64),
         sk_rto=setf(row.sk_rto, TCP_RTO_INIT, jnp.int64),
         sk_rto_deadline=setf(row.sk_rto_deadline, 0, jnp.int64),

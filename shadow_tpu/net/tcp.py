@@ -503,6 +503,13 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
                | jnp.where(resyn, CTL_SYNACK, 0)
                | jnp.where(resynack, CTL_ACKNOW, 0),
                sk_srtt=jnp.where(est, hs_srtt, rget(row.sk_srtt, slot)),
+               # delayMin: min RTT sample (reference cubic's delayMin)
+               sk_rtt_min=jnp.where(
+                   est,
+                   jnp.where(rget(row.sk_rtt_min, slot) > 0,
+                             jnp.minimum(rget(row.sk_rtt_min, slot),
+                                         hs_rtt), hs_rtt),
+                   rget(row.sk_rtt_min, slot)),
                sk_rttvar=jnp.where(est, hs_rttvar, rget(row.sk_rttvar, slot)),
                sk_rto=jnp.where(est, hs_rto, rget(row.sk_rto, slot)),
                sk_rto_deadline=jnp.where(est, _I64(0),
@@ -550,9 +557,12 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
     # RTT sample (Karn: only the timed offset, cleared on retransmit)
     rtt_seq = rget(row.sk_rtt_seq, slot)
     sample_ok = new_ack & (rtt_seq >= 0) & (ackno >= rtt_seq)
-    srtt1, rttvar1, rto1 = _rfc6298(rget(row.sk_srtt, slot), rget(row.sk_rttvar, slot),
-                                    jnp.maximum(now - rget(row.sk_rtt_time, slot),
-                                                1))
+    rtt_sample = jnp.maximum(now - rget(row.sk_rtt_time, slot), 1)
+    srtt1, rttvar1, rto1 = _rfc6298(rget(row.sk_srtt, slot),
+                                    rget(row.sk_rttvar, slot), rtt_sample)
+    rtt_min0 = rget(row.sk_rtt_min, slot)
+    rtt_min1 = jnp.where(rtt_min0 > 0,
+                         jnp.minimum(rtt_min0, rtt_sample), rtt_sample)
     # congestion: avoidance on new acks, loss on the 3rd dupack
     dup = (valid_ack & (ackno == snd_una0) & (ln == 0) & ~syn & ~fin &
            (rget(row.sk_snd_nxt, slot) > snd_una0))
@@ -563,8 +573,14 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
     cw0, ss0 = rget(row.sk_cwnd, slot), rget(row.sk_ssthresh, slot)
     wm0, ep0, k0 = (rget(row.sk_cc_wmax, slot), rget(row.sk_cc_epoch, slot),
                     rget(row.sk_cc_k, slot))
+    # the cubic rate cap uses delayMin (min RTT), the reference's
+    # choice (shd-tcp-cubic.c:121-126) — srtt inflates under standing
+    # queues, which would loosen the cap exactly when congestion builds
+    delay_ns = jnp.where(rget(row.sk_rtt_min, slot) > 0,
+                         rget(row.sk_rtt_min, slot),
+                         rget(row.sk_srtt, slot))
     cw_a, ep_a, k_a = CC.on_ack(sh.cc_kind, cw0, ss0, wm0, ep0, k0,
-                                npkts, now, rget(row.sk_srtt, slot))
+                                npkts, now, delay_ns)
     cw_l, ss_l, wm_l, ep_l = CC.on_loss(sh.cc_kind, cw0, ss0, wm0)
 
     row = _set(
@@ -575,6 +591,7 @@ def _rx_conn(row, hp, sh, now, slot, pkt):
                                jnp.maximum(pkt[P.WND].astype(_I64), 1),
                                rget(row.sk_peer_rwnd, slot)),
         sk_srtt=jnp.where(sample_ok, srtt1, rget(row.sk_srtt, slot)),
+        sk_rtt_min=jnp.where(sample_ok, rtt_min1, rtt_min0),
         sk_rttvar=jnp.where(sample_ok, rttvar1, rget(row.sk_rttvar, slot)),
         sk_rto=jnp.where(sample_ok, rto1, rget(row.sk_rto, slot)),
         sk_rtt_seq=jnp.where(sample_ok, _I64(-1), rtt_seq),
