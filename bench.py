@@ -40,13 +40,17 @@ import time
 
 
 def _enable_compile_cache():
-    """Persistent XLA compile cache next to this file. Safe to call
-    before any jax import site: only sets config values."""
+    """Persistent XLA compile cache next to this file — accelerator
+    backends only: this build's XLA:CPU AOT loader mismatches its own
+    cache entries (see tests/conftest.py), so CPU runs stay
+    uncached."""
     import jax
 
-    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         ".jax_cache")
     try:
+        if jax.default_backend() == "cpu":
+            return
+        cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
         jax.config.update("jax_compilation_cache_dir", cache)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -116,6 +116,17 @@ class HostOS:
         self._push(_PendingOp(7, a=self.now() + int(delay_ns),
                               b=int(tag)))
 
+    def pipe(self):
+        """A linked pair of pipe halves (the reference's Channel,
+        shd-channel.c): write on one half wakes the other with the
+        byte count; close delivers EOF. Returns (Sock, Sock). The
+        handles resolve at the next wake — use them in a LATER
+        callback, not the one that created them (same-batch Sock
+        references cannot name one half of a pair)."""
+        sa, sb = Sock(), Sock()
+        self._push(_PendingOp(8, out=(sa, sb)))
+        return sa, sb
+
     # --- internals ---
     def _push(self, op: _PendingOp):
         op.t = self.now()
@@ -158,6 +169,21 @@ class HostOS:
         sock.slot = packed & 0xFFFF
         sock.gen = (packed >> 16) & 0x7FFF
         self._socks[(sock.slot, sock.gen)] = sock
+
+    def _bind_pipe(self, sa: Sock, sb: Sock, packed: int):
+        """Bind a pipe open's packed pair:
+        gen_a(7) | slot_a(8) | gen_b(7) | slot_b(8)."""
+        if packed < 0:
+            for s in (sa, sb):
+                s.slot = -1
+                s.gen = -1
+            return
+        sa.slot = (packed >> 15) & 0xFF
+        sa.gen = (packed >> 23) & 0x7F
+        sb.slot = packed & 0xFF
+        sb.gen = (packed >> 8) & 0x7F
+        self._socks[(sa.slot, sa.gen)] = sa
+        self._socks[(sb.slot, sb.gen)] = sb
 
 
 class HostedApp:

@@ -27,7 +27,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..core.rowops import radd, rset, rset_where
+from ..core.rowops import radd, rget, rset, rset_where
 from ..engine import equeue
 from ..engine.defs import EV_APP, WAKE_TIMER, ST_EQ_FULL_LOCAL
 from ..net import nic
@@ -48,8 +48,9 @@ OP_TCP_LISTEN = 2    # a=port                           -> slot
 OP_TCP_CONNECT = 3   # a=dst host, b=dst port, c=tag    -> slot
 OP_TCP_WRITE = 4     # a=slot, b=nbytes
 OP_UDP_SENDTO = 5    # a=slot, b=dst host, c=(port<<32)|aux, d=nbytes
-OP_CLOSE = 6         # a=slot
+OP_CLOSE = 6         # a=slot (tcp/udp or pipe half; proto-dispatched)
 OP_TIMER = 7         # a=deadline ns (absolute), b=tag
+OP_PIPE_OPEN = 8     # -> packed pair (see _pipe_result)
 
 
 def hosted_wake(row, hp, sh, now, pkt):
@@ -94,6 +95,9 @@ def _apply_one(hosts, hp, sh, op, results):
     # for WRITE/SENDTO/CLOSE — opens return slots, they never take them.
     slot_op = (code == OP_TCP_WRITE) | (code == OP_UDP_SENDTO) | \
               (code == OP_CLOSE)
+    # NOTE: pipe handles resolve host-side (pipe opens bind both
+    # halves from one packed result), so OP_PIPE_OPEN takes no slot
+    # operands and pipe writes/closes arrive as ordinary slot ints
     op = jnp.stack([op[0], op[1],
                     jnp.where(slot_op, deref(op[2]), op[2]),
                     op[3], op[4], op[5], op[6]])
@@ -124,7 +128,15 @@ def _apply_one(hosts, hp, sh, op, results):
         return r, _slot_result(r, slot, ok)
 
     def op_write(r):
-        r = tcp_write(r, now, op[2].astype(_I32), op[3])
+        # pipes share the write/close verbs (descriptor-uniform, like
+        # the reference's transport vtable); dispatch on the proto
+        from ..net.channel import PROTO_PIPE, pipe_write
+        slot = op[2].astype(_I32)
+        is_pipe = rget(r.sk_proto, slot) == PROTO_PIPE
+        r = jax.lax.cond(
+            is_pipe,
+            lambda r2: pipe_write(r2, now, slot, op[3]),
+            lambda r2: tcp_write(r2, now, slot, op[3]), r)
         return r, _I32(0)
 
     def op_sendto(r):
@@ -136,7 +148,13 @@ def _apply_one(hosts, hp, sh, op, results):
         return r, _I32(0)
 
     def op_close(r):
-        r = tcp_close_call(r, now, op[2].astype(_I32))
+        from ..net.channel import PROTO_PIPE, pipe_close
+        slot = op[2].astype(_I32)
+        is_pipe = rget(r.sk_proto, slot) == PROTO_PIPE
+        r = jax.lax.cond(
+            is_pipe,
+            lambda r2: pipe_close(r2, now, slot),
+            lambda r2: tcp_close_call(r2, now, slot), r)
         return r, _I32(0)
 
     def op_timer(r):
@@ -147,10 +165,22 @@ def _apply_one(hosts, hp, sh, op, results):
         r = equeue.q_push(r, op[2], EV_APP, wake)
         return r, _I32(0)
 
+    def op_pipe_open(r):
+        from ..core.rowops import rget as _rget
+        from ..net.channel import pipe_open
+        r, a, b, ok = pipe_open(r)
+        # pack BOTH halves with their generations:
+        # gen_a(7) | slot_a(8) | gen_b(7) | slot_b(8) — 30 bits
+        gen_a = _rget(r.sk_timer_gen, a) & 0x7F
+        gen_b = _rget(r.sk_timer_gen, b) & 0x7F
+        packed = ((gen_a << 23) | ((a & 0xFF) << 15) |
+                  (gen_b << 8) | (b & 0xFF))
+        return r, jnp.where(ok, packed, -1).astype(_I32)
+
     row, result = jax.lax.switch(
-        jnp.clip(code, 0, 7),
+        jnp.clip(code, 0, 8),
         [op_nop, op_udp_open, op_listen, op_connect, op_write, op_sendto,
-         op_close, op_timer], row)
+         op_close, op_timer, op_pipe_open], row)
     hosts = jax.tree.map(lambda a, v: a.at[h].set(v), hosts, row)
     return hosts, result
 

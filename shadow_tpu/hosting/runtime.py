@@ -123,8 +123,9 @@ class HostingRuntime:
         ops = np.zeros((K, OP_WORDS), dtype=np.int64)
         ref_of = {}  # Sock object -> creating op index
         for k, (hid, os, op) in enumerate(pending):
-            if op.out is not None:
-                ref_of[id(op.out)] = k
+            if op.out is not None and not isinstance(op.out, tuple):
+                ref_of[id(op.out)] = k   # pipe pairs (tuples) cannot
+                # be same-batch referenced: one result names two socks
 
             def enc(x):
                 if isinstance(x, Sock):
@@ -140,6 +141,8 @@ class HostingRuntime:
         hosts, results = apply_ops_jit(hosts, hp, sh, jnp.asarray(ops))
         res = np.asarray(results)
         for k, (hid, os, op) in enumerate(pending):
-            if op.out is not None:
+            if isinstance(op.out, tuple):
+                os._bind_pipe(op.out[0], op.out[1], int(res[k]))
+            elif op.out is not None:
                 os._bind(op.out, int(res[k]))
         return hosts

@@ -1,0 +1,126 @@
+"""Pipe/socketpair channels: linked byte-queue halves, no TCP.
+
+The reference backs pipe()/socketpair() with a lightweight Channel
+descriptor — two halves linked pairwise, each a byte queue with
+readable/writable status (/root/reference/src/main/host/descriptor/
+shd-channel.c:134-172) — NOT with loopback TCP self-connections. This
+module is that object for the TPU build: a pair of PROTO_PIPE socket
+rows on ONE host, partner-linked through sk_parent.
+
+Semantics (matching the cooperative modeled-app world):
+- a write moves up to the free capacity (PIPE_BUFFER_SIZE, the
+  reference's channel buffer) into the partner's readable stream and
+  wakes the partner one nanosecond later (the epoll-notify delay every
+  descriptor status change pays, shd-epoll.c:326-370);
+- byte counts flow, payloads are not materialized (as everywhere in
+  the engine);
+- close wakes the partner with EOF and frees the half; the partner
+  half stays usable for draining until it closes itself.
+
+No handshake, no ACK clocking, no congestion state, no retransmission
+— a pipe-heavy workload pays two events per transfer leg (the write
+wake and the EOF) instead of the TCP machine's dozens (see
+tests/test_loopback.py's event-count comparison).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.rowops import radd, rget, rset
+from ..engine import equeue
+from ..engine.defs import EV_APP, WAKE_SOCKET, WAKE_EOF, ST_BYTES_RECV
+from . import packet as P
+from .socket import sock_alloc, sock_free
+
+_I32 = jnp.int32
+_I64 = jnp.int64
+
+PROTO_PIPE = 1                 # sk_proto value (6 = tcp, 17 = udp)
+PIPE_BUFFER_SIZE = 65536       # reference CONFIG_PIPE_BUFFER_SIZE
+
+
+def _wake_partner(row, now, reason, partner, ln=0):
+    """+1ns wake of the partner half's owning process (the same
+    descriptor-status notify path net.tcp._wake models)."""
+    w = jnp.zeros((P.PKT_WORDS,), _I32)
+    w = rset(w, P.ACK, _I32(reason))
+    w = rset(w, P.SEQ, partner.astype(_I32))
+    w = rset(w, P.LEN, _I32(ln))
+    # 7-bit generation: must match the pipe open's packed-pair gens
+    # (hosting.api._bind_pipe), which only have 7 bits per half
+    w = rset(w, P.WND, rget(row.sk_timer_gen, partner) & 0x7F)
+    return equeue.q_push(row, now + 1, EV_APP, w)
+
+
+def pipe_open(row):
+    """Allocate a linked pair of pipe halves. Returns
+    (row, slot_a, slot_b, ok)."""
+    row, a, ok1 = sock_alloc(row, PROTO_PIPE)
+    row, b, ok2 = sock_alloc(row, PROTO_PIPE)
+    ok = ok1 & ok2
+
+    def link(r):
+        return r.replace(
+            sk_parent=rset(rset(r.sk_parent, a, b.astype(_I32)),
+                           b, a.astype(_I32)))
+
+    def undo(r):
+        # partial alloc (only a landed): release it
+        return jax.lax.cond(ok1 & ~ok2,
+                            lambda r2: sock_free(r2, a),
+                            lambda r2: r2, r)
+
+    row = jax.lax.cond(ok, link, undo, row)
+    return row, a, b, ok
+
+
+def pipe_write(row, now, slot, nbytes):
+    """Move the full byte count to the reader and wake it. Delivery is
+    immediate (cooperative apps consume on the wake), so a standing
+    buffer fill never exists and PIPE_BUFFER_SIZE backpressure is NOT
+    modeled — clamping each write to it would silently truncate large
+    writes with no short-write signal (modeled byte accounting would
+    corrupt); the capacity constant is kept only as documentation of
+    the reference's buffer size."""
+    partner = rget(row.sk_parent, slot)
+    usable = (rget(row.sk_used, slot) & (partner >= 0) &
+              (rget(row.sk_proto, slot) == PROTO_PIPE))
+    n_ok = jnp.where(usable,
+                     jnp.maximum(jnp.asarray(nbytes, _I64), 0), 0)
+
+    def do(r):
+        r = r.replace(
+            sk_snd_end=rset(r.sk_snd_end, slot,
+                            rget(r.sk_snd_end, slot) + n_ok),
+            # the reader's stream cursor advances at delivery
+            sk_rcv_nxt=rset(r.sk_rcv_nxt, partner,
+                            rget(r.sk_rcv_nxt, partner) + n_ok),
+            stats=radd(r.stats, ST_BYTES_RECV, n_ok))
+        return _wake_partner(r, now, WAKE_SOCKET, partner,
+                             ln=n_ok.astype(_I32))
+
+    return jax.lax.cond(n_ok > 0, do, lambda r: r, row)
+
+
+def pipe_close(row, now, slot):
+    """Close this half: EOF to the (still-open) partner, free the
+    slot."""
+    partner = rget(row.sk_parent, slot)
+    live = (rget(row.sk_used, slot) &
+            (rget(row.sk_proto, slot) == PROTO_PIPE))
+    peer_open = (partner >= 0) & rget(row.sk_used, partner)
+
+    def do(r):
+        r = jax.lax.cond(
+            peer_open,
+            lambda r2: _wake_partner(
+                # unlink the partner so a recycled slot cannot alias
+                r2.replace(sk_parent=rset(r2.sk_parent, partner,
+                                          _I32(-1))),
+                now, WAKE_EOF, partner),
+            lambda r2: r2, r)
+        return sock_free(r, slot)
+
+    return jax.lax.cond(live, do, lambda r: r, row)

@@ -97,11 +97,15 @@ def socks_caps(n_hosts, scap=96, active_block=0):
     ~50 live sockets per relay — 96 covers bursts, and sock_alloc's
     TIME_WAIT recycling absorbs churn.
 
-    qcap/incap 96: servers fan in ~8 client streams; a 48-slot queue
-    measured 9k arrival drops (and a 20x retransmit amplification) on
-    the 400-host smoke — arrival headroom is the binding constraint
-    (round 3: arrivals past the headroom now defer at the source
-    instead of dropping, so undersizing costs windows, not packets).
+    qcap must EXCEED scap by the arrival headroom: every live socket
+    keeps one standing RTO-timer event in the queue (net.tcp
+    _arm_timer), so a relay with ~scap live sockets and qcap == scap
+    has near-zero free slots — intake collapses to the one-packet
+    forward-progress floor and deferred arrivals thrash the window
+    loop (measured: the 10k run pinned at ~2.7 sim-s). incap 96:
+    arrival headroom per window (round 3: arrivals past it defer at
+    the source instead of dropping, so undersizing costs windows,
+    never packets).
 
     active_block: active-set compaction block (engine.window.
     step_window_pass) — the at-scale SOCKS/Tor shape is exactly the
@@ -109,8 +113,8 @@ def socks_caps(n_hosts, scap=96, active_block=0):
     idle clients).
     """
     from shadow_tpu.engine.state import EngineConfig
-    return EngineConfig(num_hosts=n_hosts, qcap=96, scap=scap, obcap=24,
-                        incap=96, txqcap=16, chunk_windows=64,
+    return EngineConfig(num_hosts=n_hosts, qcap=scap + 96, scap=scap,
+                        obcap=24, incap=96, txqcap=16, chunk_windows=64,
                         active_block=active_block)
 
 
@@ -270,6 +274,19 @@ def main(argv):
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # persistent compile cache for chip runs (repeat measurements
+        # skip the multi-minute cold compile; CPU runs skip it — this
+        # build's XLA:CPU AOT loader mismatches its own entries)
+        import jax
+        try:
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.path.join(REPO, ".jax_cache"))
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception:
+            pass
     out = run_config(args.config, n=args.n, stop=args.stop,
                      verbose=args.verbose, runahead_ms=args.runahead_ms,
                      chunk=args.chunk, active_block=args.active_block)
