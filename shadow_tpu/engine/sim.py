@@ -469,13 +469,13 @@ class Simulation:
             if self.hosting:
                 raise NotImplementedError(
                     "hosted apps + multi-process mesh not supported")
-            if checkpoint_path or resume_from:
-                raise NotImplementedError(
-                    "checkpoint/resume + multi-process mesh not "
-                    "supported yet (snapshots are per-process)")
             if pcap_dir is not None:
                 raise NotImplementedError(
                     "pcap capture + multi-process mesh not supported")
+            # checkpoint/resume IS supported on a multi-process mesh:
+            # saves allgather the sharded state and process 0 writes
+            # ONE global snapshot; every process must be able to read
+            # the snapshot path on resume (shared storage)
 
         tracker = None
         if heartbeat_s:
@@ -537,8 +537,10 @@ class Simulation:
             wstart = jnp.int64(ws0)
             wend = jnp.int64(we0)
             if mesh is not None:
-                from ..parallel.shard import device_put_sharded as _dps
-                hosts, _, _ = _dps(hosts, hp, sh, mesh)
+                # hp/sh are already placed; only the restored Hosts
+                # arrays need (re-)sharding
+                from ..parallel.shard import put_hosts
+                hosts = put_hosts(hosts, mesh)
 
         if checkpoint_path and not checkpoint_every_s:
             raise ValueError(
@@ -584,8 +586,17 @@ class Simulation:
                     dist.gather_stats(hosts.stats)[:H],
                     socks=None if multiproc else socket_columns(hosts))
             if checkpoint_path and ckpt_at is not None and ws >= ckpt_at:
-                ckpt.save(checkpoint_path, hosts, ws, int(wend),
-                          total_windows, fingerprint)
+                to_save = hosts
+                if multiproc:
+                    # materialize the GLOBAL state on every process
+                    # (the collective must run on all of them), then
+                    # only process 0 touches the filesystem
+                    from jax.experimental import multihost_utils
+                    to_save = multihost_utils.process_allgather(
+                        hosts, tiled=True)
+                if not multiproc or jax.process_index() == 0:
+                    ckpt.save(checkpoint_path, to_save, ws, int(wend),
+                              total_windows, fingerprint)
                 ckpt_at += next_ckpt
             if verbose:
                 print(f"  t={ws / SIMTIME_ONE_SECOND:.3f}s "

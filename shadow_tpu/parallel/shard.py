@@ -341,30 +341,33 @@ def run_windows_sharded(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
     return fn(hosts, hp, sh, wstart, wend)
 
 
-def device_put_sharded(hosts, hp, sh, mesh: Mesh):
-    """Place the simulation state for a sharded run: Hosts/HostParams
-    block-sharded over the hosts axis, Shared replicated.
-
-    On a multi-process mesh (the DCN tier, parallel.dist) every
-    process holds the same full host-side arrays — deterministic
-    scenario build — and contributes its addressable shards via
-    make_array_from_callback; single-process keeps the plain
-    device_put fast path."""
-    shard = NamedSharding(mesh, PS(AXIS))
-    repl = NamedSharding(mesh, PS())
+def _put_tree(tree, mesh: Mesh, spec):
+    """Place one pytree of HOST-LOCAL (numpy-convertible) arrays with
+    the given partition spec; multi-process uses
+    make_array_from_callback (every process holds the same full
+    arrays — deterministic build), single-process plain device_put."""
+    s = NamedSharding(mesh, spec)
     if jax.process_count() > 1:
         import numpy as _np
 
-        def put(x, s):
+        def put(x):
             arr = _np.asarray(x)
             return jax.make_array_from_callback(
                 arr.shape, s, lambda idx: arr[idx])
 
-        hosts = jax.tree.map(lambda x: put(x, shard), hosts)
-        hp = jax.tree.map(lambda x: put(x, shard), hp)
-        sh = jax.tree.map(lambda x: put(x, repl), sh)
-        return hosts, hp, sh
-    hosts = jax.tree.map(lambda x: jax.device_put(x, shard), hosts)
-    hp = jax.tree.map(lambda x: jax.device_put(x, shard), hp)
-    sh = jax.tree.map(lambda x: jax.device_put(x, repl), sh)
-    return hosts, hp, sh
+        return jax.tree.map(put, tree)
+    return jax.tree.map(lambda x: jax.device_put(x, s), tree)
+
+
+def put_hosts(hosts, mesh: Mesh):
+    """Block-shard just the Hosts pytree (e.g. checkpoint-restored
+    state; params/shared are already placed)."""
+    return _put_tree(hosts, mesh, PS(AXIS))
+
+
+def device_put_sharded(hosts, hp, sh, mesh: Mesh):
+    """Place the simulation state for a sharded run: Hosts/HostParams
+    block-sharded over the hosts axis, Shared replicated."""
+    return (_put_tree(hosts, mesh, PS(AXIS)),
+            _put_tree(hp, mesh, PS(AXIS)),
+            _put_tree(sh, mesh, PS()))

@@ -1,10 +1,15 @@
-"""Worker process for the multi-process (DCN-tier) test.
+"""Worker process for the multi-process (DCN-tier) tests.
 
 Launched N times by tests/test_distributed.py over loopback TCP:
     python dist_worker.py <coordinator> <num_procs> <proc_id> <out.npy>
+        [--ckpt <path>] [--resume]
 Each process contributes 2 virtual CPU devices; the global mesh spans
 all processes — the same shape a real multi-host TPU deployment has
 (ICI within a process's slice, DCN between processes).
+
+--ckpt: checkpoint every simulated second into <path> while running
+(process 0 writes the global snapshot). --resume: restore from <path>
+instead of starting fresh.
 """
 
 import os
@@ -13,6 +18,9 @@ import sys
 
 def main():
     coord, nproc, pid, out = sys.argv[1:5]
+    rest = sys.argv[5:]
+    ckpt = rest[rest.index("--ckpt") + 1] if "--ckpt" in rest else None
+    resume = "--resume" in rest
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -34,7 +42,12 @@ def main():
     cfg = make_cfg()
     mesh = dist.global_mesh()
     assert len(mesh.devices.flat) == 2 * int(nproc)
-    r = Simulation(scen, engine_cfg=cfg).run(mesh=mesh)
+    kw = {}
+    if ckpt and resume:
+        kw = dict(resume_from=ckpt)
+    elif ckpt:
+        kw = dict(checkpoint_path=ckpt, checkpoint_every_s=1.0)
+    r = Simulation(scen, engine_cfg=cfg).run(mesh=mesh, **kw)
     if int(pid) == 0:
         np.save(out, r.stats)
     print(f"proc {pid}: {r.events} events", flush=True)

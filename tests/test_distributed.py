@@ -46,8 +46,15 @@ def test_two_process_mesh_matches_single(tmp_path):
     truth = Simulation(make_scenario(), engine_cfg=make_cfg()).run()
     assert truth.events > 0
 
-    coord = f"127.0.0.1:{_free_port()}"
     out = tmp_path / "stats.npy"
+    _spawn_workers(out, [], "fresh")
+    stats = np.load(out)
+    assert np.array_equal(stats, truth.stats), (
+        "multi-process stats diverge from single-process run")
+
+
+def _spawn_workers(out, extra, tag):
+    coord = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
@@ -55,10 +62,12 @@ def test_two_process_mesh_matches_single(tmp_path):
     procs = [
         subprocess.Popen(
             [sys.executable, str(HELPERS / "dist_worker.py"),
-             coord, "2", str(pid), str(out)],
+             coord, "2", str(pid), str(out)] + extra,
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         for pid in range(2)
     ]
+    # reap ALL workers before asserting: an early assert would leak
+    # the peer (blocked on the distributed barrier) as an orphan
     outputs = []
     for p in procs:
         try:
@@ -69,8 +78,34 @@ def test_two_process_mesh_matches_single(tmp_path):
             raise
         outputs.append(stdout.decode(errors="replace"))
     for pid, (p, text) in enumerate(zip(procs, outputs)):
-        assert p.returncode == 0, f"proc {pid} failed:\n{text[-3000:]}"
+        assert p.returncode == 0, (
+            f"{tag} proc {pid} failed:\n{text[-3000:]}")
 
-    stats = np.load(out)
-    assert np.array_equal(stats, truth.stats), (
-        "multi-process stats diverge from single-process run")
+
+def test_multiprocess_checkpoint_resume(tmp_path):
+    """DCN-tier checkpoint/resume (round 3): a 2-process mesh
+    checkpoints mid-run (process 0 writes ONE global snapshot), a
+    fresh 2-process mesh resumes from it, and the resumed run's final
+    stats equal both the uninterrupted multi-process run's and the
+    single-process truth."""
+    sys.path.insert(0, str(HELPERS))
+    try:
+        from scenario_phold import make_scenario, make_cfg
+    finally:
+        sys.path.pop(0)
+    from shadow_tpu.engine.sim import Simulation
+
+    truth = Simulation(make_scenario(), engine_cfg=make_cfg()).run()
+
+    ckpt = str(tmp_path / "snap.npz")
+    out_a = tmp_path / "stats_a.npy"
+    _spawn_workers(out_a, ["--ckpt", ckpt], "checkpointing")
+    assert os.path.exists(ckpt), "process 0 never wrote the snapshot"
+    stats_a = np.load(out_a)
+    assert np.array_equal(stats_a, truth.stats)
+
+    out_b = tmp_path / "stats_b.npy"
+    _spawn_workers(out_b, ["--ckpt", ckpt, "--resume"], "resuming")
+    stats_b = np.load(out_b)
+    assert np.array_equal(stats_b, truth.stats), (
+        "resumed multi-process run diverges from the uninterrupted run")
