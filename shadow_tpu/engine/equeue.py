@@ -45,6 +45,9 @@ def q_push(row, t, kind, pkt):
         eq_kind=rset_where(row.eq_kind, slot, has_free, jnp.int32(kind)),
         eq_pkt=rset_where(row.eq_pkt, slot, has_free, pkt),
         eq_ctr=row.eq_ctr + 1,
+        eq_next=jnp.where(has_free,
+                          jnp.minimum(row.eq_next, jnp.int64(t)),
+                          row.eq_next),
         stats=radd(row.stats, ST_EQ_FULL_LOCAL,
                    jnp.where(has_free, 0, 1)),
     )
@@ -59,8 +62,12 @@ def q_has_free(row):
 
 
 def q_min(row):
-    """Lexicographic (time, seq) minimum. Returns (slot, time)."""
-    tmin = jnp.min(row.eq_time)
+    """Lexicographic (time, seq) minimum. Returns (slot, time).
+
+    Reads the cached row minimum (eq_next) instead of re-reducing
+    eq_time — the cache invariant (eq_next == min(eq_time)) is
+    maintained by q_push/q_clear_slot/window.merge_arrivals."""
+    tmin = row.eq_next
     cand = row.eq_time == tmin
     seq_key = jnp.where(cand, row.eq_seq, _I32_MAX)
     slot = jnp.argmin(seq_key)
@@ -69,12 +76,16 @@ def q_min(row):
 
 def q_next_time(row):
     """Earliest pending event time (SIMTIME_MAX if queue empty)."""
-    return jnp.min(row.eq_time)
+    return row.eq_next
 
 
 def q_clear_slot(row, slot):
-    """Free a slot after popping its event."""
+    """Free a slot after popping its event. Recomputes the cached row
+    minimum (the cleared slot usually WAS the minimum) — one [Q]
+    reduction per pop, paid only for rows actually stepped."""
+    eq_time = rset(row.eq_time, slot, SIMTIME_MAX)
     return row.replace(
-        eq_time=rset(row.eq_time, slot, SIMTIME_MAX),
+        eq_time=eq_time,
         eq_kind=rset(row.eq_kind, slot, EV_NULL),
+        eq_next=jnp.min(eq_time),
     )

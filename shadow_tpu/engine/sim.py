@@ -42,6 +42,8 @@ class SimReport:
     windows: int
     heartbeats: list = field(default_factory=list)
     capacity: dict = field(default_factory=dict)
+    cost: dict = field(default_factory=dict)  # cost_model() inputs:
+    #   pass mix per compaction rung, per-row state bytes, warm wall
 
     def total(self, stat: int) -> int:
         return int(self.stats[:, stat].sum())
@@ -86,6 +88,69 @@ class SimReport:
                         "overflow": drops.get(name, 0),
                         "deferred": defers.get(name, 0)})
         return out
+
+    def cost_model(self) -> dict:
+        """Where the wall time goes, in pass-mix and modeled HBM-
+        traffic terms — the per-pass cost model the round-3 verdict
+        asked for (the reference self-reports the analogous numbers:
+        scheduler idle/barrier-wait seconds shd-scheduler.c:250-252,
+        per-host exec seconds shd-host.c:201-208). On this hardware
+        the pass cost is row-state HBM traffic, so the model reports
+        bytes moved per pass per rung and the achieved bandwidth
+        against the chip's roofline.
+
+        All byte figures are MODELED from array shapes (gather/step/
+        scatter traffic assuming no fusion savings), not measured
+        counters — upper bounds that localize where the time goes;
+        `achieved_gbps_est` divides PER-CHIP modeled traffic by the
+        warm wall (excluding the first chunk's compile when the run
+        had more than one chunk — `warm` says which), so it reads as
+        "what fraction of the roofline would this run sustain if the
+        model were exact"."""
+        if not self.cost:
+            return {}
+        rb = self.cost["row_bytes"]
+        mix = self.cost["pass_mix"]       # {label: (K or H, passes)}
+        B = self.cost.get("batch", 1)
+        per_chip_h = self.cost["per_chip_hosts"]
+        shards = self.cost.get("shards", 1)
+        passes = {k: int(n) for k, (_, n) in mix.items()}
+        total_passes = sum(passes.values())
+        est_pass_bytes = {}
+        est_total = 0
+        for label, (k, n) in mix.items():
+            if label == "dense":
+                pb = 2 * per_chip_h * rb
+            else:
+                pb = (4 + 2 * B) * k * rb
+            est_pass_bytes[label] = pb
+            est_total += pb * int(n)
+        warm = self.cost.get("warm_wall")
+        wall = warm if warm else self.wall_seconds
+        peak = self.cost.get("hbm_peak_gbps", 819.0)
+        # sharded pass counters sum every chip's passes (shards move
+        # their pass bytes CONCURRENTLY), so the per-chip bandwidth —
+        # the number comparable to one chip's HBM peak — divides the
+        # aggregate by the shard count
+        gbps = est_total / shards / wall / 1e9 if wall else 0.0
+        return {
+            "row_bytes": rb,
+            "batch": B,
+            "shards": shards,
+            "passes": passes,
+            "passes_total": total_passes,
+            "passes_per_window": (total_passes / self.windows
+                                  if self.windows else 0.0),
+            "est_pass_bytes": est_pass_bytes,
+            "est_total_gb": est_total / 1e9,
+            "wall_seconds_used": wall,
+            # False = single-chunk run: the wall INCLUDES the cold
+            # compile and achieved_gbps_est understates accordingly
+            "warm": warm is not None,
+            "achieved_gbps_est": gbps,
+            "hbm_peak_gbps": peak,
+            "roofline_frac": gbps / peak if peak else 0.0,
+        }
 
     def summary(self) -> dict:
         mean_rtt_us = (self.total(defs.ST_RTT_SUM_US) /
@@ -386,7 +451,8 @@ class Simulation:
         self.hosts = hosts.replace(
             eq_time=jnp.asarray(eq_time), eq_kind=jnp.asarray(eq_kind),
             eq_seq=jnp.asarray(eq_seq), eq_pkt=jnp.asarray(eq_pkt),
-            eq_ctr=jnp.asarray(eq_ctr))
+            eq_ctr=jnp.asarray(eq_ctr),
+            eq_next=jnp.asarray(eq_time.min(axis=1)))
 
         self._ran = False
 
@@ -498,6 +564,7 @@ class Simulation:
             hosts, cfg, hp, sh = self.hosts, self.cfg, self.hp, self.sh
             # hosted apps need the CPU between every window
             chunk = 1 if self.hosting else cfg.chunk_windows
+            per_chip_h = cfg.num_hosts
 
             def step(hosts, ws, we):
                 return run_windows(hosts, hp, sh, ws, we, cfg, chunk)
@@ -510,18 +577,30 @@ class Simulation:
             n = mesh.shape[AXIS]
             hosts, hp, sh, cfg = self._pad_for_mesh(n)
             hosts, hp, sh = device_put_sharded(hosts, hp, sh, mesh)
+            per_chip_h = cfg.num_hosts // n
 
             def step(hosts, ws, we):
                 return run_windows_sharded(hosts, hp, sh, ws, we, cfg,
                                            cfg.chunk_windows, mesh)
 
+        # cost-model bookkeeping (SimReport.cost_model): pass mix per
+        # compaction rung + per-row state bytes
+        from .window import ladder_of, sparse_batch
+        _ks = ladder_of(cfg, per_chip_h)
+        _pass_labels = [f"k{k}" for k in _ks] + ["dense"]
+        _pass_sizes = _ks + [per_chip_h]
+        pass_acc = np.zeros(len(_pass_labels), np.int64)
+        row_bytes = sum(
+            int(np.prod(leaf.shape[1:])) * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(hosts))
+
         if multiproc:
             # eager reductions cannot run on non-addressable global
             # arrays; a jitted min yields a replicated (addressable)
             # scalar on every process
-            t0 = jax.jit(jnp.min)(hosts.eq_time)
+            t0 = jax.jit(jnp.min)(hosts.eq_next)
         else:
-            t0 = jnp.min(hosts.eq_time)
+            t0 = jnp.min(hosts.eq_next)
         wstart = t0
         wend = jnp.where(t0 == SIMTIME_MAX, t0, t0 + sh.min_jump)
 
@@ -550,9 +629,14 @@ class Simulation:
                      if checkpoint_every_s else 0)
         ckpt_at = int(wstart) + next_ckpt if next_ckpt else None
         wall0 = _time.perf_counter()
+        first_chunk_wall = None
         while True:
-            hosts, wstart, wend, n = step(hosts, wstart, wend)
+            hosts, wstart, wend, n, pc = step(hosts, wstart, wend)
             total_windows += int(n)
+            pass_acc += np.asarray(pc)
+            if first_chunk_wall is None:
+                # everything after this excludes the cold compile
+                first_chunk_wall = _time.perf_counter() - wall0
             ws = int(wstart)
             if self.hosting is not None:
                 now = min(ws, int(sh.stop_time))
@@ -566,7 +650,7 @@ class Simulation:
                 # window the engine computed — re-derive the window
                 # (carried outbox arrivals count, engine.window.
                 # next_wakeup)
-                nt = jnp.minimum(jnp.min(hosts.eq_time),
+                nt = jnp.minimum(jnp.min(hosts.eq_next),
                                  jnp.min(hosts.ob_next))
                 wstart = nt
                 wend = jnp.where(nt == SIMTIME_MAX, nt, nt + sh.min_jump)
@@ -618,11 +702,27 @@ class Simulation:
             ("nic_txq", cfg.txqcap, int(peaks[3])),
         ]}
         sim_ns = min(int(sh.stop_time), ws) if ws < SIMTIME_MAX else int(sh.stop_time)
+        import os as _os
+        warm = (wall - first_chunk_wall
+                if first_chunk_wall is not None and
+                wall > first_chunk_wall * 1.05 else None)
+        cost = {
+            "row_bytes": row_bytes,
+            "pass_mix": {lbl: (size, int(nn)) for lbl, size, nn in
+                         zip(_pass_labels, _pass_sizes, pass_acc)},
+            "batch": sparse_batch(cfg),
+            "per_chip_hosts": per_chip_h,
+            "shards": (1 if mesh is None else
+                       cfg.num_hosts // per_chip_h),
+            "warm_wall": warm,
+            "hbm_peak_gbps": float(_os.environ.get(
+                "SHADOW_TPU_HBM_GBPS", "819")),
+        }
         return SimReport(stats=stats, host_names=self.host_names,
                          sim_time_ns=sim_ns, wall_seconds=wall,
                          windows=total_windows,
                          heartbeats=(tracker.lines if tracker else []),
-                         capacity=capacity)
+                         capacity=capacity, cost=cost)
 
 
 def run_scenario(scenario: Scenario, **kw) -> SimReport:

@@ -83,17 +83,26 @@ class EngineConfig:
     #   clamped to the shard outbox). Bucket overflow DEFERS the tail
     #   at the source (counted in ST_DEFER_A2A; see
     #   parallel.shard.exchange_sharded).
-    active_block: int = 0   # active-set compaction: when > 0, a
-    #   lockstep pass with <= this many ready hosts gathers just those
-    #   rows, steps them, and scatters back instead of paying a full
-    #   all-hosts pass — the TPU-native analogue of the reference's
-    #   host-steal load balancing (shd-scheduler-policy-host-steal.c:
-    #   163-191): a single busy relay no longer charges every idle
-    #   host one pass per event. Passes with more ready hosts than
-    #   this use the dense all-hosts step (engine.window.
-    #   step_window_pass). 0 disables (always dense). Bit-identical
-    #   either way: hosts only interact at window boundaries, so
-    #   per-host (time, seq) execution order is unchanged.
+    active_block: int = -1  # active-set compaction: a lockstep pass
+    #   with few ready hosts gathers just those rows, steps them, and
+    #   scatters back instead of paying a full all-hosts pass — the
+    #   TPU-native analogue of the reference's host-steal load
+    #   balancing (shd-scheduler-policy-host-steal.c:163-191): a
+    #   single busy relay no longer charges every idle host one pass
+    #   per event. -1 (default) = AUTO: a small rung ladder sized from
+    #   num_hosts, each pass picking the smallest rung that fits its
+    #   ready count (engine.window.ladder_of — replaces the round-3
+    #   hand-tuned per-config constant). > 0 = one explicit rung of
+    #   that size. 0 = off (always dense). Bit-identical in every
+    #   mode: hosts only interact at window boundaries, so per-host
+    #   (time, seq) execution order is unchanged.
+    event_batch: int = 8    # max consecutive due events drained per
+    #   gathered host within ONE sparse compaction pass (engine.window.
+    #   sparse_batch; forced to 1 under the CPU model and with hosted
+    #   apps). Amortizes the rung gather/scatter over up to this many
+    #   events — pass COUNT, not just pass cost, is the other factor
+    #   of the lockstep-skew product (round-3 verdict item 2). Dense
+    #   passes always drain exactly one event per ready host.
 
 
 @chex.dataclass
@@ -105,6 +114,12 @@ class Hosts:
     eq_kind: jnp.ndarray   # [H, Q] i32
     eq_pkt: jnp.ndarray    # [H, Q, PKT_WORDS] i32 payload
     eq_ctr: jnp.ndarray    # [H] i32 next sequence number
+    eq_next: jnp.ndarray   # [H] i64 CACHED min(eq_time, axis=1) —
+    #   maintained by every queue mutation (equeue.q_push/q_clear_slot,
+    #   window.merge_arrivals) so the window loop's ready mask and
+    #   min-next-event reductions read [H] instead of scanning the full
+    #   [H, Q] table every lockstep pass (at 10k hosts x 192 slots that
+    #   scan alone was ~15 MB of HBM traffic per pass, twice per pass)
     # --- per-host RNG use counter (key = fold_in(host_key, rng_ctr)) ---
     rng_ctr: jnp.ndarray   # [H] i32
     # --- CPU model (reference shd-cpu.c): busy horizon per host ---
@@ -287,6 +302,7 @@ def alloc_hosts(cfg: EngineConfig) -> Hosts:
         eq_kind=full((H, Q), 0, jnp.int32),
         eq_pkt=full((H, Q, PKT_WORDS), 0, jnp.int32),
         eq_ctr=full((H,), 0, jnp.int32),
+        eq_next=full((H,), SIMTIME_MAX, jnp.int64),
         rng_ctr=full((H,), 0, jnp.int32),
         cpu_avail=full((H,), 0, jnp.int64),
         nic_busy=full((H,), 0, jnp.int64),

@@ -204,52 +204,116 @@ def step_all_hosts(hosts, hp, sh, wend, cfg: EngineConfig):
     return jax.vmap(f)(hosts, hp)
 
 
+def ladder_of(cfg: EngineConfig, H: int = None):
+    """Active-set compaction rung sizes for this config (ascending),
+    WITHOUT the implicit dense fallback rung.
+
+    - active_block > 0: one explicit rung (the round-3 hand-tuned
+      knob, kept for A/B tests and overrides).
+    - active_block == 0: compaction off — always dense (the round-3
+      default, kept so dense-vs-sparse equality tests stay meaningful).
+    - active_block == -1 (default): AUTO — a small ladder of rungs
+      sized to the host count; each pass picks the smallest rung that
+      fits its ready count, so the hand-tuned per-config constant the
+      round-3 verdict flagged is gone (the reference's host-steal load
+      balancing needed no tuning either,
+      shd-scheduler-policy-host-steal.c:266-299). Rungs must satisfy
+      4*K <= H: gathering more than a quarter of the rows costs close
+      to a dense pass (round-3 block-size sweep, git 9b878c3).
+    """
+    if H is None:
+        H = cfg.num_hosts
+    if cfg.active_block > 0:
+        return [min(cfg.active_block, H)]
+    if cfg.active_block == 0:
+        return []
+    return [k for k in (32, 512) if 4 * k <= H]
+
+
+def sparse_batch(cfg: EngineConfig) -> int:
+    """Events executed per gathered host per sparse pass (the inner
+    bounded drain). 1 under the CPU model (every pop must re-check the
+    blocked-CPU threshold against the busy horizon accumulated by the
+    PREVIOUS pop — batching would reorder those checks) and with
+    hosted apps (the wake-ring pause margin in run_windows assumes at
+    most ~1 wake per host per pass)."""
+    if cfg.cpu_model or cfg.hostedcap > 1:
+        return 1
+    return cfg.event_batch
+
+
 def step_window_pass(hosts, hp, sh, wend, cfg: EngineConfig):
-    """One lockstep pass with active-set compaction (cfg.active_block).
+    """One lockstep pass with active-set compaction.
 
     The dense pass pays O(H x row-state) per iteration even when one
     busy host is the only one with events left in the window — the
     lockstep-skew cost that made at-scale TCP runs follow the busiest
     relay (the round-2 diagnosis; the reference solves the same skew by
     migrating hosts between threads, shd-scheduler-policy-host-steal.c:
-    163-191,266-299). Here: count the ready hosts; if at most K =
-    active_block are ready, gather exactly those rows, step only them,
-    scatter back — O(K x row-state) — else fall back to the dense
-    all-hosts step (which executes one event on EVERY ready host, so
-    it is strictly better when most hosts are busy).
+    163-191,266-299). Here: count the ready hosts, pick the smallest
+    ladder rung K >= nready, gather exactly those rows, drain up to
+    sparse_batch(cfg) consecutive due events per gathered host, scatter
+    back — O(K x row-state) amortized over up to B events — else fall
+    back to the dense all-hosts step (which executes one event on EVERY
+    ready host, so it is strictly better when most hosts are busy).
 
     Exactness: hosts interact only at window boundaries (loopback
     delivery is host-local), so any per-pass subset schedule that
     steps each host's own events in (time, seq) order produces
-    bit-identical state — and a not-ready row's step is the identity,
-    which makes dummy gather slots (duplicates of one not-ready host)
-    harmless: every duplicate scatter-back writes identical bytes.
+    bit-identical state — including draining SEVERAL consecutive due
+    events for one host in a single pass (that is exactly the order
+    the per-host queue would pop them over consecutive passes, and the
+    order the pyengine oracle drains them in). A not-ready row's step
+    is the identity (every handler is gated on `ready`; pinned by
+    tests/test_compaction.py::test_idle_step_identity), which makes
+    dummy gather slots (duplicates of one not-ready host) harmless:
+    every duplicate scatter-back writes identical bytes.
+
+    Returns (hosts, rung) where rung indexes ladder_of(cfg) with
+    len(ladder) == the dense fallback (pass-mix accounting for the
+    SimReport cost model).
     """
     H = hosts.eq_ctr.shape[0]
-    K = min(cfg.active_block, H)
-    ready = jnp.min(hosts.eq_time, axis=1) < wend     # [H]
+    ks = ladder_of(cfg, H)
+    ready = hosts.eq_next < wend                      # [H]
     nready = jnp.sum(ready, dtype=jnp.int32)
+    B = sparse_batch(cfg)
 
     def dense(h):
         return step_all_hosts(h, hp, sh, wend, cfg)
 
-    def sparse(h):
-        rank = jnp.cumsum(ready) - 1
-        take = ready & (rank < K)
-        tgt = jnp.where(take, rank, K).astype(jnp.int32)
-        hid = jnp.arange(H, dtype=jnp.int32)
-        # dummy slots point at the first NOT-ready host: whenever a
-        # dummy is needed (nready < K), one exists (nready < H), and
-        # its step is the identity (see docstring)
-        dummy = jnp.argmin(ready).astype(jnp.int32)
-        idx = jnp.full((K,), dummy, jnp.int32).at[tgt].set(
-            hid, mode="drop")
-        sub = jax.tree.map(lambda a: a[idx], h)
-        shp = jax.tree.map(lambda a: a[idx], hp)
-        stepped = step_all_hosts(sub, shp, sh, wend, cfg)
-        return jax.tree.map(lambda a, s: a.at[idx].set(s), h, stepped)
+    def make_sparse(K):
+        def sparse(h):
+            rank = jnp.cumsum(ready) - 1
+            take = ready & (rank < K)
+            tgt = jnp.where(take, rank, K).astype(jnp.int32)
+            hid = jnp.arange(H, dtype=jnp.int32)
+            # dummy slots point at the first NOT-ready host: whenever a
+            # dummy is needed (nready < K), one exists (nready < H), and
+            # its step is the identity (see docstring)
+            dummy = jnp.argmin(ready).astype(jnp.int32)
+            idx = jnp.full((K,), dummy, jnp.int32).at[tgt].set(
+                hid, mode="drop")
+            sub = jax.tree.map(lambda a: a[idx], h)
+            shp = jax.tree.map(lambda a: a[idx], hp)
+            if B > 1:
+                sub = jax.lax.fori_loop(
+                    0, B,
+                    lambda _, s: step_all_hosts(s, shp, sh, wend, cfg),
+                    sub)
+            else:
+                sub = step_all_hosts(sub, shp, sh, wend, cfg)
+            return jax.tree.map(lambda a, s: a.at[idx].set(s), h, sub)
+        return sparse
 
-    return jax.lax.cond(nready > K, dense, sparse, hosts)
+    if not ks:
+        return dense(hosts), jnp.int32(0)
+
+    # smallest rung that fits the ready count; len(ks) = dense
+    rung = jnp.searchsorted(jnp.asarray(ks, jnp.int32), nready,
+                            side="left").astype(jnp.int32)
+    branches = [make_sparse(K) for K in ks] + [dense]
+    return jax.lax.switch(rung, branches, hosts), rung
 
 
 # --- Window-boundary packet exchange --------------------------------------
@@ -451,13 +515,15 @@ def merge_arrivals(hosts, hp, cfg: EngineConfig, in_pkt, in_time):
         take = free & (frank < k2)
         j = jnp.clip(frank, 0, IN - 1)
         overflow = k - k2
+        eq_time = jnp.where(take, itime[j], row.eq_time)
         return row.replace(
-            eq_time=jnp.where(take, itime[j], row.eq_time),
+            eq_time=eq_time,
             eq_kind=jnp.where(take, EV_PKT, row.eq_kind),
             eq_seq=jnp.where(take, row.eq_ctr + frank.astype(jnp.int32),
                              row.eq_seq),
             eq_pkt=jnp.where(take[:, None], ipkt[j], row.eq_pkt),
             eq_ctr=row.eq_ctr + k2,
+            eq_next=jnp.min(eq_time),  # cache invariant (state.eq_next)
             stats=radd(row.stats, ST_PKTS_DROP_Q, jnp.int64(overflow)),
         )
 
@@ -488,8 +554,10 @@ def update_cap_peaks(hosts):
 
 def next_event_time(hosts):
     """Global minimum pending EXECUTABLE event time (the pmin
-    reduction). Drives the intra-window pass loop."""
-    return jnp.min(hosts.eq_time)
+    reduction). Drives the intra-window pass loop. Reads the cached
+    per-host minima (state.eq_next), an [H] reduction, instead of
+    scanning the full [H, Q] queue table every pass."""
+    return jnp.min(hosts.eq_next)
 
 
 def next_wakeup(hosts):
@@ -497,7 +565,7 @@ def next_wakeup(hosts):
     arrival among source-carried packets (ob_next) — a deferred
     delivery must reopen the window even when no queue holds an event
     yet."""
-    return jnp.minimum(jnp.min(hosts.eq_time), jnp.min(hosts.ob_next))
+    return jnp.minimum(jnp.min(hosts.eq_next), jnp.min(hosts.ob_next))
 
 
 # One AOT-compiled instance per (cfg, max_windows): this build's jit
@@ -511,8 +579,13 @@ def run_windows(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
                 max_windows: int):
     """Execute up to `max_windows` lookahead windows on device.
 
-    Returns (hosts, wstart', wend', windows_run). The caller loops until
-    wstart' >= stop_time or wstart' == SIMTIME_MAX (no events left).
+    Returns (hosts, wstart', wend', windows_run, pass_counts). The
+    caller loops until wstart' >= stop_time or wstart' == SIMTIME_MAX
+    (no events left). pass_counts is an i64 vector of lockstep passes
+    executed per compaction rung — one entry per ladder_of(cfg) rung
+    plus the trailing dense fallback — feeding the SimReport cost
+    model (the TPU analogue of the reference's self-reported scheduler
+    idle/barrier seconds, shd-scheduler.c:250-252).
     """
     from ..core.jitcache import AotJit
 
@@ -532,18 +605,21 @@ def run_windows(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
 
 def _run_windows_impl(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
                       max_windows: int):
+    NR = len(ladder_of(cfg)) + 1  # rungs + dense (pass-mix counters)
+
     def win_cond(carry):
-        _, ws, _, i = carry
+        _, ws, _, i, _ = carry
         return (i < max_windows) & (ws < sh.stop_time) & (ws < SIMTIME_MAX)
 
     def win_body(carry):
-        hosts, ws, we, i = carry
+        hosts, ws, we, i, pc = carry
         # never execute past the simulation end (the reference clamps the
         # execution window to endTime, shd-master.c:410-440)
         we_eff = jnp.minimum(we, sh.stop_time)
         ran = next_event_time(hosts) < we_eff  # >=1 event will execute
 
-        def ev_cond(h):
+        def ev_cond(carry2):
+            h, _ = carry2
             go = next_event_time(h) < we_eff
             if cfg.hostedcap > 1:
                 # pause before a hosted wake ring can overflow so the
@@ -557,12 +633,12 @@ def _run_windows_impl(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
                 go = go & (jnp.max(h.hw_cnt) < max(cap - 4, 1))
             return go
 
-        def ev_body(h):
-            if cfg.active_block:
-                return step_window_pass(h, hp, sh, we_eff, cfg)
-            return step_all_hosts(h, hp, sh, we_eff, cfg)
+        def ev_body(carry2):
+            h, pc2 = carry2
+            h, rung = step_window_pass(h, hp, sh, we_eff, cfg)
+            return h, pc2.at[rung].add(1)
 
-        hosts = jax.lax.while_loop(ev_cond, ev_body, hosts)
+        hosts, pc = jax.lax.while_loop(ev_cond, ev_body, (hosts, pc))
         hosts = update_cap_peaks(hosts)
         ob0 = jnp.sum(hosts.ob_cnt)
         # an empty exchange is the identity: skip its sort/gather work
@@ -584,7 +660,8 @@ def _run_windows_impl(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
         nt = jnp.where(progressed, next_wakeup(hosts),
                        next_event_time(hosts))
         we2 = jnp.where(nt == SIMTIME_MAX, SIMTIME_MAX, nt + sh.min_jump)
-        return hosts, nt, we2, i + 1
+        return hosts, nt, we2, i + 1, pc
 
     return jax.lax.while_loop(
-        win_cond, win_body, (hosts, wstart, wend, jnp.int32(0)))
+        win_cond, win_body,
+        (hosts, wstart, wend, jnp.int32(0), jnp.zeros((NR,), jnp.int64)))

@@ -250,36 +250,43 @@ def _windows_body(hosts, hp, sh, wstart, wend, cfg, lcfg, max_windows):
     """Per-shard window loop (runs inside shard_map)."""
 
     def next_time_global(h):
-        return jax.lax.pmin(jnp.min(h.eq_time), AXIS)
+        return jax.lax.pmin(jnp.min(h.eq_next), AXIS)
 
     def next_wakeup_global(h):
         # window-advance bound includes source-carried arrivals
         # (engine.window.next_wakeup)
-        return jax.lax.pmin(jnp.minimum(jnp.min(h.eq_time),
+        return jax.lax.pmin(jnp.minimum(jnp.min(h.eq_next),
                                         jnp.min(h.ob_next)), AXIS)
 
+    from ..engine.window import ladder_of
+    NR = len(ladder_of(cfg, lcfg.num_hosts)) + 1
+
     def win_cond(carry):
-        _, ws, _, i = carry
+        _, ws, _, i, _ = carry
         return (i < max_windows) & (ws < sh.stop_time) & (ws < SIMTIME_MAX)
 
     def win_body(carry):
-        hosts, ws, we, i = carry
+        hosts, ws, we, i, pc = carry
         we_eff = jnp.minimum(we, sh.stop_time)
         ran = next_time_global(hosts) < we_eff
 
-        def ev_cond(h):
+        def ev_cond(carry2):
+            h, _ = carry2
             return next_time_global(h) < we_eff
 
-        def ev_body(h):
+        def ev_body(carry2):
             # active-set compaction applies per shard (local rows);
             # the while cond stays the global pmin so every shard runs
             # the same number of (possibly no-op) passes — collectives
-            # remain uniform
-            if cfg.active_block:
-                return step_window_pass(h, hp, sh, we_eff, cfg)
-            return step_all_hosts(h, hp, sh, we_eff, cfg)
+            # remain uniform. Rung choice is shard-local (no
+            # collectives inside step_window_pass), so shards may run
+            # different rungs in the same pass; pass counters are
+            # per-shard and psum-reduced by the caller.
+            h, pc2 = carry2
+            h, rung = step_window_pass(h, hp, sh, we_eff, cfg)
+            return h, pc2.at[rung].add(1)
 
-        hosts = jax.lax.while_loop(ev_cond, ev_body, hosts)
+        hosts, pc = jax.lax.while_loop(ev_cond, ev_body, (hosts, pc))
         hosts = update_cap_peaks(hosts)
         ob0 = jax.lax.psum(jnp.sum(hosts.ob_cnt), AXIS)
         hosts = exchange_sharded(hosts, hp, sh, cfg, lcfg)
@@ -290,10 +297,13 @@ def _windows_body(hosts, hp, sh, wstart, wend, cfg, lcfg, max_windows):
         nt = jnp.where(progressed, next_wakeup_global(hosts),
                        next_time_global(hosts))
         we2 = jnp.where(nt == SIMTIME_MAX, SIMTIME_MAX, nt + sh.min_jump)
-        return hosts, nt, we2, i + 1
+        return hosts, nt, we2, i + 1, pc
 
-    return jax.lax.while_loop(
-        win_cond, win_body, (hosts, wstart, wend, jnp.int32(0)))
+    hosts, ws, we, i, pc = jax.lax.while_loop(
+        win_cond, win_body,
+        (hosts, wstart, wend, jnp.int32(0), jnp.zeros((NR,), jnp.int64)))
+    # total passes across shards (each shard counts its own rung mix)
+    return hosts, ws, we, i, jax.lax.psum(pc, AXIS)
 
 
 _RWS_INSTANCES = {}
@@ -303,9 +313,12 @@ def run_windows_sharded(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
                         max_windows: int, mesh: Mesh):
     """Sharded equivalent of engine.window.run_windows.
 
-    Same contract: returns (hosts, wstart', wend', windows_run) with
-    hosts block-sharded over the mesh's "hosts" axis. AOT-compiled per
-    (cfg, max_windows, mesh) — see core.jitcache for why.
+    Same contract: returns (hosts, wstart', wend', windows_run,
+    pass_counts) with hosts block-sharded over the mesh's "hosts"
+    axis; pass_counts sums every shard's per-rung pass mix (shards run
+    the same pass COUNT in lockstep but may pick different rungs).
+    AOT-compiled per (cfg, max_windows, mesh) — see core.jitcache for
+    why.
     """
     from ..core.jitcache import AotJit
 
@@ -323,7 +336,7 @@ def run_windows_sharded(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
                     max_windows=max_windows),
             mesh=mesh,
             in_specs=(PS(AXIS), PS(AXIS), PS(), PS(), PS()),
-            out_specs=(PS(AXIS), PS(), PS(), PS()),
+            out_specs=(PS(AXIS), PS(), PS(), PS(), PS()),
             # the row-level engine mixes unvarying constants into
             # sharded state everywhere (e.g. `.at[slot].set(True)`),
             # which trips the strict varying-axes typecheck; the

@@ -82,6 +82,56 @@ def test_compaction_differential():
         assert np.array_equal(jax_stats[:, st], py_stats[:, st]), st
 
 
+def test_idle_step_identity():
+    """Pin the invariant compaction's exactness rests on: a not-ready
+    row's step is the IDENTITY (engine.window.step_window_pass
+    docstring). Dummy gather slots duplicate a not-ready host, so a
+    handler that mutated state before its ready gate (e.g. an
+    unconditional rng_ctr bump) would corrupt state at scale in ways
+    only end-to-end equality tests could catch — this pins it at the
+    unit level: stepping a host set whose every event lies past the
+    window bound must leave every array bit-identical, dense and
+    sparse alike."""
+    import jax.numpy as jnp
+    from shadow_tpu.engine.window import step_all_hosts, step_window_pass
+
+    sim = Simulation(_skewed_scen(), engine_cfg=EngineConfig(
+        num_hosts=8, active_block=3, **CFG))
+    hosts, hp, sh = sim.hosts, sim.hp, sim.sh
+    wend = jnp.int64(0)  # every pending start event is at >= 1s
+
+    def assert_identity(out, label):
+        for (path, a), (_, b) in zip(
+                jax.tree_util.tree_flatten_with_path(hosts)[0],
+                jax.tree_util.tree_flatten_with_path(out)[0]):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), (
+                f"{label}: leaf {jax.tree_util.keystr(path)} mutated "
+                "by an all-idle step")
+
+    assert_identity(step_all_hosts(hosts, hp, sh, wend, sim.cfg),
+                    "dense")
+    out, rung = step_window_pass(hosts, hp, sh, wend, sim.cfg)
+    assert int(rung) == 0  # 0 ready -> smallest rung
+    assert_identity(out, "sparse")
+
+
+def test_event_batch_bit_identical():
+    """Draining up to B consecutive due events per gathered host in one
+    sparse pass (EngineConfig.event_batch) is a pass-schedule change
+    only — per-host (time, seq) order is preserved — so stats must be
+    bit-identical to the one-event-per-pass engine."""
+    sim1 = Simulation(_skewed_scen(), engine_cfg=EngineConfig(
+        num_hosts=8, active_block=3, event_batch=1, **CFG))
+    simB = Simulation(_skewed_scen(), engine_cfg=EngineConfig(
+        num_hosts=8, active_block=3, event_batch=8, **CFG))
+    r1, rB = sim1.run(), simB.run()
+    assert np.array_equal(r1.stats, rB.stats)
+    assert r1.windows == rB.windows
+    # batching may only LOWER the pass count
+    assert (rB.cost_model()["passes_total"] <=
+            r1.cost_model()["passes_total"])
+
+
 def test_compaction_sharded_matches_dense_single():
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 (virtual) devices")
