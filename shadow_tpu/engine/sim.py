@@ -121,6 +121,10 @@ class SimReport:
         for label, (k, n) in mix.items():
             if label == "dense":
                 pb = 2 * per_chip_h * rb
+            elif label.startswith("w"):
+                # window-rung: gather/scatter amortized over the whole
+                # window; each counted pass drains B batched sub-steps
+                pb = 2 * B * k * rb
             else:
                 pb = (4 + 2 * B) * k * rb
             est_pass_bytes[label] = pb
@@ -585,10 +589,10 @@ class Simulation:
 
         # cost-model bookkeeping (SimReport.cost_model): pass mix per
         # compaction rung + per-row state bytes
-        from .window import ladder_of, sparse_batch
-        _ks = ladder_of(cfg, per_chip_h)
-        _pass_labels = [f"k{k}" for k in _ks] + ["dense"]
-        _pass_sizes = _ks + [per_chip_h]
+        from .window import pass_labels, sparse_batch
+        _pl = pass_labels(cfg, per_chip_h)
+        _pass_labels = [lbl for lbl, _ in _pl]
+        _pass_sizes = [size for _, size in _pl]
         pass_acc = np.zeros(len(_pass_labels), np.int64)
         row_bytes = sum(
             int(np.prod(leaf.shape[1:])) * leaf.dtype.itemsize

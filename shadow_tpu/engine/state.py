@@ -96,6 +96,24 @@ class EngineConfig:
     #   that size. 0 = off (always dense). Bit-identical in every
     #   mode: hosts only interact at window boundaries, so per-host
     #   (time, seq) execution order is unchanged.
+    exsortcap: int = 0      # exchange sort-compaction cap: the window
+    #   exchange's group-by-destination argsort ran over ALL H x obcap
+    #   outbox slots (240k at socks10k — measured ~110 ms/window on
+    #   chip, ~40% of the socks10k wall; TPU sorts are bitonic and
+    #   expensive). When the window's surviving packet count fits this
+    #   cap, the exchange first compacts the valid entries (stable,
+    #   original order) and sorts only the cap-sized list; larger
+    #   bursts fall back to the full sort. 0 = auto
+    #   (engine.window.exsort_cap); bit-identical either way (a stable
+    #   sort of the compacted subsequence equals the filtered stable
+    #   sort of the full list).
+    dstcap: int = 0         # destination-compaction cap for the
+    #   arrival merge (engine.window.dst_cap): windows whose receiving
+    #   host set fits the cap merge only those rows ([D] gathers
+    #   instead of [H]-wide queue rewrites — the xplane trace showed
+    #   the full-width merge's data-dependent gathers were ~45 ms of
+    #   every socks10k window). 0 = auto (min(H, 2048)); bit-identical
+    #   either way (a no-arrival row's merge is the identity).
     event_batch: int = 8    # max consecutive due events drained per
     #   gathered host within ONE sparse compaction pass (engine.window.
     #   sparse_batch; forced to 1 under the CPU model and with hosted

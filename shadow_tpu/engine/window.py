@@ -29,6 +29,7 @@ from __future__ import annotations
 
 from functools import partial
 
+import chex
 import jax
 import jax.numpy as jnp
 
@@ -242,6 +243,147 @@ def sparse_batch(cfg: EngineConfig) -> int:
     return cfg.event_batch
 
 
+def window_ladder(cfg: EngineConfig, H: int = None):
+    """Window-level active-set rung sizes (ascending), without the
+    dense fallback. The set of hosts that can execute ANY event inside
+    a window is fixed at window open: hosts interact only at window
+    boundaries, and a host's own handlers can only schedule events for
+    itself (loopback included), so a host whose earliest event lies at
+    or past wend stays idle for the WHOLE window. That makes a single
+    gather-at-window-open / scatter-at-window-close exact — the inner
+    drain loop then runs every pass on [K] rows with no per-pass
+    gather, scatter, or full-state switch carry (measured ~37 ms of
+    every socks10k window, tools/xplane_profile.py round 4).
+
+    Disabled (empty) with hosted apps: the mid-window wake-ring pause
+    check needs the full host set.
+    """
+    if H is None:
+        H = cfg.num_hosts
+    if cfg.hostedcap > 1 or cfg.active_block == 0:
+        return []
+    if cfg.active_block > 0:
+        return [min(cfg.active_block, H)]
+    # ONE auto rung: the largest candidate with 2K <= H. A window rung
+    # pays its gather once for the whole window and the inner drain
+    # re-compacts per pass (drain_window), so finer window rungs buy
+    # almost nothing — while every extra rung compiles another full
+    # copy of the event-handler machine (measured: the 3-rung nested
+    # build took ~29 min of XLA compile; program size, not run time,
+    # is the binding cost of extra rungs)
+    for k in (2048, 512):
+        if 2 * k <= H:
+            return [k]
+    return []
+
+
+def drain_window(hosts, hp, sh, wend, cfg: EngineConfig, pc):
+    """Execute every event below `wend` (one whole window's pass
+    loop), window-level active-set compaction applied when the active
+    count fits a rung. Returns (hosts, pc) with pass counters
+    accumulated per rung (window rungs first, then the per-pass rungs
+    of the dense fallback, then dense — see pass_labels)."""
+    H = hosts.eq_ctr.shape[0]
+    wks = window_ladder(cfg, H)
+    B = sparse_batch(cfg)
+    nw = len(wks)
+
+    def fallback(h, pc2):
+        # full-set drain. With a window rung present this branch only
+        # runs population-wave windows (most hosts active), where the
+        # dense step is the right tool anyway — so it compiles the
+        # plain dense loop, not another rung-ladder copy of the
+        # handler machine. Without window rungs (small/mid H, hosted
+        # apps, explicit active_block) it IS the engine, and the
+        # per-pass ladder applies as before (step_window_pass handles
+        # the ladderless active_block=0 case as plain dense).
+        use_ladder = not wks
+
+        def ev_cond(carry2):
+            h2, _ = carry2
+            go = next_event_time(h2) < wend
+            if cfg.hostedcap > 1:
+                # pause before a hosted wake ring can overflow so the
+                # CPU tier drains mid-window (the window re-opens on
+                # the next call). The threshold floor keeps tiny
+                # manual hostedcap values from wedging the loop.
+                cap = h2.hw_time.shape[1]
+                go = go & (jnp.max(h2.hw_cnt) < max(cap - 4, 1))
+            return go
+
+        def ev_body(carry2):
+            h2, pc3 = carry2
+            if use_ladder:
+                h2, rung = step_window_pass(h2, hp, sh, wend, cfg)
+            else:
+                h2 = step_all_hosts(h2, hp, sh, wend, cfg)
+                rung = len(ladder_of(cfg, H))  # the dense slot
+            return h2, pc3.at[nw + rung].add(1)
+
+        return jax.lax.while_loop(ev_cond, ev_body, (h, pc2))
+
+    if not wks:
+        return fallback(hosts, pc)
+
+    active = hosts.eq_next < wend                     # [H]
+    nact = jnp.sum(active, dtype=jnp.int32)
+
+    def make_win(K, slot):
+        def f(h, pc2):
+            rank = jnp.cumsum(active) - 1
+            take = active & (rank < K)
+            tgt = jnp.where(take, rank, K).astype(jnp.int32)
+            hid = jnp.arange(H, dtype=jnp.int32)
+            dummy = jnp.argmin(active).astype(jnp.int32)
+            idx = jnp.full((K,), dummy, jnp.int32).at[tgt].set(
+                hid, mode="drop")
+            sub = jax.tree.map(lambda a: a[idx], h)
+            shp = jax.tree.map(lambda a: a[idx], hp)
+
+            def c(carry2):
+                s, _ = carry2
+                return jnp.min(s.eq_next) < wend
+
+            def b(carry2):
+                # per-pass sub-compaction INSIDE the gathered set:
+                # early passes run dense over [K], but once the easy
+                # hosts drain, the remaining passes (the busiest
+                # host's long tail) gather [32]-row subsets of the
+                # sub — without this, every tail pass pays the full
+                # [K]-row switch (measured: a flat [2048]-wide drain
+                # was SLOWER than the per-pass ladder it replaced)
+                s, n = carry2
+                s, _rung = step_window_pass(s, shp, sh, wend, cfg)
+                return s, n + 1
+
+            sub, n = jax.lax.while_loop(c, b, (sub, jnp.int64(0)))
+            h = jax.tree.map(lambda a, s: a.at[idx].set(s), h, sub)
+            return h, pc2.at[slot].add(n)
+        return f
+
+    branches = [make_win(K, i) for i, K in enumerate(wks)] + [fallback]
+    rung = jnp.searchsorted(jnp.asarray(wks, jnp.int32), nact,
+                            side="left").astype(jnp.int32)
+    # arrival-only windows (every queue event at/past wend; the window
+    # opened on a carried ob_next arrival) execute nothing — route
+    # them to the fallback, whose loop exits without the K-row
+    # gather/scatter a window rung would pay for zero passes
+    rung = jnp.where(nact == 0, jnp.int32(len(wks)), rung)
+    return jax.lax.switch(rung, branches, hosts, pc)
+
+
+def pass_labels(cfg: EngineConfig, H: int = None):
+    """Cost-model labels/sizes for drain_window's pass counters:
+    window rungs, then the dense-fallback's per-pass rungs, then
+    dense."""
+    if H is None:
+        H = cfg.num_hosts
+    wks = window_ladder(cfg, H)
+    ks = ladder_of(cfg, H)
+    return ([(f"w{k}", k) for k in wks] +
+            [(f"k{k}", k) for k in ks] + [("dense", H)])
+
+
 def step_window_pass(hosts, hp, sh, wend, cfg: EngineConfig):
     """One lockstep pass with active-set compaction.
 
@@ -318,6 +460,34 @@ def step_window_pass(hosts, hp, sh, wend, cfg: EngineConfig):
 
 # --- Window-boundary packet exchange --------------------------------------
 
+def exsort_cap(cfg: EngineConfig) -> int:
+    """Exchange sort-compaction cap (state.EngineConfig.exsortcap).
+    Auto: the smallest power of two >= num_hosts (>= 2048) — big
+    enough that a whole-population wave of one packet per host (the
+    connect-wave worst case) still takes the compact path; multi-
+    packet-per-host bursts beyond it fall back to the full sort."""
+    N = cfg.num_hosts * cfg.obcap
+    if cfg.exsortcap:
+        return min(cfg.exsortcap, N)
+    c = 2048
+    while c < cfg.num_hosts and c < N:
+        c *= 2
+    return min(c, N)
+
+
+def dst_cap(cfg: EngineConfig) -> int:
+    """Destination-compaction cap for the arrival merge
+    (state.EngineConfig.dstcap): when at most this many hosts received
+    arrivals this window, only their rows are gathered/merged/
+    scattered (merge_arrivals_at); more receivers fall back to the
+    full-width merge. MUST be <= num_hosts: dummy slots duplicate a
+    no-arrival destination, which is guaranteed to exist only while
+    the receiving set is smaller than the host count."""
+    if cfg.dstcap:
+        return min(cfg.dstcap, cfg.num_hosts)
+    return min(cfg.num_hosts, 4096)
+
+
 def _trace_append(row, pkts, times, valid, dirv, on):
     """Append up to len(times) records to this host's trace ring
     (obs.pcap). Row-level under vmap; compiled only when tracing."""
@@ -389,32 +559,161 @@ def exchange(hosts, hp, sh, cfg: EngineConfig):
     # simply sorted position first_of[d] + r. (The previous
     # scatter-based construction dominated the whole window cost:
     # TPU scatters serialize.)
+    #
+    # Sort compaction (round 4): the argsort over all N = H x obcap
+    # slots was itself the dominant window cost at scale (measured
+    # ~110 ms/window at socks10k via tools/phase_profile.py — TPU
+    # sorts are bitonic). Most windows ship a tiny fraction of N, so
+    # when the survivor count fits cfg.exsortcap the valid entries are
+    # first compacted (stable: compact rank is monotone in the
+    # original index) and only the cap-sized list is sorted; a stable
+    # sort of that subsequence equals the full stable sort filtered to
+    # the survivors, so delivery order — and every downstream bit —
+    # is unchanged. Oversized bursts fall back to the full sort.
     sortkey = jnp.where(deliver, dst, H)
-    order = jnp.argsort(sortkey, stable=True)
-    sdst = sortkey[order]
-    hosts, in_pkt, in_time, kept_sorted = _deliver_dense(
-        hosts, order, sdst, pkts, arrival, net_dropped, O, IN, cfg)
+    C = exsort_cap(cfg)
+    if C < N and not cfg.tracecap:
+        # At-scale path: sort compaction + destination-compacted merge
+        # (both exact; see exsort_cap / merge_arrivals_at). The merge
+        # runs INSIDE the branches so the dest-compacted variant can
+        # touch [D] host rows instead of [H]. pcap tracing needs the
+        # full [H, IN] inbound buffers, so traced runs take the static
+        # path below instead.
+        D = dst_cap(cfg)
+        nval = jnp.sum(deliver, dtype=jnp.int32)
+        merge_late = False
+
+        def compact_tail(h):
+            rank = jnp.cumsum(deliver) - 1
+            tgt = jnp.where(deliver, rank, C).astype(jnp.int32)
+            idx = jnp.full((C,), N, jnp.int32).at[tgt].set(
+                jnp.arange(N, dtype=jnp.int32), mode="drop")
+            live = idx < N
+            idxc = jnp.minimum(idx, N - 1)
+            key_c = jnp.where(live, sortkey[idxc], H)
+            order_c = jnp.argsort(key_c, stable=True)
+            sdst_c = key_c[order_c]
+            # pre-gather ONCE into the sorted domain: the per-dest
+            # window reads below then index a [C]-array, not [N]
+            pkt_s = pkts[idxc][order_c]
+            arr_s = arrival[idxc][order_c]
+            dsts = jnp.arange(H, dtype=sdst_c.dtype)
+            first_of = jnp.searchsorted(sdst_c, dsts, side="left")
+            count_of = (jnp.searchsorted(sdst_c, dsts, side="right")
+                        - first_of)
+            has = count_of > 0
+            ndst = jnp.sum(has, dtype=jnp.int32)
+
+            def dst_compact(h):
+                rankD = jnp.cumsum(has) - 1
+                tgtD = jnp.where(has, rankD, D).astype(jnp.int32)
+                # dummy rows: a destination with NO arrivals — its
+                # merge is the identity (k = 0), so duplicates are
+                # harmless (merge_arrivals_at docstring)
+                dummy = jnp.argmin(has).astype(jnp.int32)
+                idxD = jnp.full((D,), dummy, jnp.int32).at[tgtD].set(
+                    jnp.arange(H, dtype=jnp.int32), mode="drop")
+                nfreeD = jnp.sum(h.eq_time[idxD] == SIMTIME_MAX,
+                                 axis=1, dtype=jnp.int32)
+                reserve = min(8, cfg.qcap // 4)
+                allowD = jnp.minimum(IN, jnp.maximum(
+                    nfreeD - reserve, jnp.minimum(nfreeD, 1)))
+                take_ofD = jnp.minimum(count_of[idxD], allowD)
+                r = jnp.arange(IN)
+                jD = jnp.clip(first_of[idxD][:, None] + r[None, :],
+                              0, C - 1)
+                cellD = r[None, :] < take_ofD[:, None]
+                in_timeD = jnp.where(cellD, arr_s[jD], SIMTIME_MAX)
+                in_pktD = jnp.where(cellD[:, :, None], pkt_s[jD],
+                                    jnp.int32(0))
+                take_full = jnp.zeros((H,), jnp.int32).at[idxD].set(
+                    take_ofD, mode="drop")
+                # accepted flags in the sorted domain
+                dbc = jnp.clip(sdst_c, 0, H - 1)
+                rank_s = jnp.arange(C) - first_of[dbc]
+                kept_sorted = ((sdst_c < H) &
+                               (rank_s < take_full[dbc]))
+                h = merge_arrivals_at(h, cfg, in_pktD, in_timeD, idxD)
+                return h, kept_sorted
+
+            def dst_full(h):
+                nfree = jnp.sum(h.eq_time == SIMTIME_MAX, axis=1,
+                                dtype=jnp.int32)
+                in_pkt, in_time, kept_sorted = _deliver_dense(
+                    nfree, order_c, sdst_c, pkts[idxc], arrival[idxc],
+                    IN, cfg)
+                h = merge_arrivals(h, hp, cfg, in_pkt, in_time)
+                return h, kept_sorted
+
+            h, kept_sorted = jax.lax.cond(ndst <= D, dst_compact,
+                                          dst_full, h)
+            kept_c = jnp.zeros((C,), jnp.bool_).at[order_c].set(
+                kept_sorted)
+            kept = jnp.zeros((N,), jnp.bool_).at[idx].set(
+                kept_c, mode="drop")
+            return h, kept
+
+        def full_tail(h):
+            order = jnp.argsort(sortkey, stable=True)
+            sdst = sortkey[order]
+            nfree = jnp.sum(h.eq_time == SIMTIME_MAX, axis=1,
+                            dtype=jnp.int32)
+            in_pkt, in_time, kept_sorted = _deliver_dense(
+                nfree, order, sdst, pkts, arrival, IN, cfg)
+            h = merge_arrivals(h, hp, cfg, in_pkt, in_time)
+            kept = jnp.zeros((N,), jnp.bool_).at[order].set(kept_sorted)
+            return h, kept
+
+        hosts, kept = jax.lax.cond(nval <= C, compact_tail, full_tail,
+                                   hosts)
+    else:
+        # static path (small scale, or pcap tracing): full-width sort
+        # and delivery; the merge runs LAST (below) so the trace ring
+        # keeps its historical tx-before-rx record order — the rx
+        # records are appended by merge_arrivals
+        order = jnp.argsort(sortkey, stable=True)
+        sdst = sortkey[order]
+        nfree = jnp.sum(hosts.eq_time == SIMTIME_MAX, axis=1,
+                        dtype=jnp.int32)
+        in_pkt, in_time, kept_sorted = _deliver_dense(
+            nfree, order, sdst, pkts, arrival, IN, cfg)
+        kept = jnp.zeros((N,), jnp.bool_).at[order].set(kept_sorted)
+        merge_late = True
 
     # tx trace records cover only packets that actually depart this
-    # window (a carried packet is traced in the window it ships)
-    kept = jnp.zeros((N,), jnp.bool_).at[order].set(kept_sorted)
+    # window (a carried packet is traced in the window it ships).
+    # In the at-scale branches above the arrival merge has already
+    # run; that is order-equivalent because tracing is off there
+    # (tracecap == 0) and everything below touches disjoint state or
+    # commuting stat columns.
     hosts = _trace_tx(hosts, hp, cfg, pkts, stimes,
                       (kept | net_dropped).reshape(H, O))
     stay = deliver & ~kept
-    hosts = hosts.replace(stats=hosts.stats.at[:, ST_DEFER_FANIN].add(
+    net_per_src = jnp.sum(net_dropped.reshape(H, O), axis=1,
+                          dtype=jnp.int64)
+    hosts = hosts.replace(stats=hosts.stats
+                          .at[:, ST_PKTS_DROP_NET].add(net_per_src)
+                          .at[:, ST_DEFER_FANIN].add(
         jnp.sum(stay.reshape(H, O), axis=1, dtype=jnp.int64)))
     hosts = _carry_outbox(hosts, pkts, stimes, arrival, stay, O)
-    hosts = merge_arrivals(hosts, hp, cfg, in_pkt, in_time)
+    if merge_late:
+        hosts = merge_arrivals(hosts, hp, cfg, in_pkt, in_time)
     return hosts
 
 
-def _deliver_dense(hosts, order, sdst, pkts, arrival, net_dropped,
-                   O, IN, cfg: EngineConfig, lo=0):
+def _deliver_dense(nfree, order, sdst, pkts, arrival,
+                   IN, cfg: EngineConfig, lo=0):
     """Shared gather-based delivery construction for both exchanges.
     `order`/`sdst` sort the (possibly gathered) global packet list by
     destination; builds this block's [Hl, IN] inbound buffers for hosts
-    [lo, lo+Hl) (reshape-sums, no scatters). `net_dropped` is this
-    block's local outbox drop mask ([Hl*O]).
+    [lo, lo+Hl) (reshape-sums, no scatters). `nfree` is the caller's
+    per-host free-queue-slot count [Hl].
+
+    Takes and returns ONLY the small delivery arrays — not the Hosts
+    pytree — so the compact-vs-full sort branches in the exchange
+    carry ~the inbound buffers through lax.cond instead of the whole
+    simulation state (conditional branch boundaries materialize their
+    operands; at 10k hosts the state is ~0.5 GB per copy).
 
     Per-destination intake = min(IN, queue headroom): the IN window
     budget, bounded by the free event-queue slots less the reserve for
@@ -424,14 +723,12 @@ def _deliver_dense(hosts, order, sdst, pkts, arrival, net_dropped,
     sorted list (False for entries destined outside this block), which
     the caller turns into source-side carries."""
     N = sdst.shape[0]
-    Hl = hosts.stats.shape[0]
+    Hl = nfree.shape[0]
     dsts = lo + jnp.arange(Hl, dtype=sdst.dtype)
     first_of = jnp.searchsorted(sdst, dsts, side="left")
     count_of = jnp.searchsorted(sdst, dsts, side="right") - first_of
 
     reserve = min(8, cfg.qcap // 4)
-    nfree = jnp.sum(hosts.eq_time == SIMTIME_MAX, axis=1,
-                    dtype=jnp.int32)
     allow = jnp.minimum(IN, jnp.maximum(nfree - reserve,
                                         jnp.minimum(nfree, 1)))
     take_of = jnp.minimum(count_of, allow)
@@ -450,12 +747,7 @@ def _deliver_dense(hosts, order, sdst, pkts, arrival, net_dropped,
     dbc = jnp.clip(db, 0, Hl - 1)
     rank = jnp.arange(N) - first_of[dbc]
     kept_sorted = inblock & (rank < take_of[dbc])
-
-    stats = hosts.stats
-    net_per_src = jnp.sum(net_dropped.reshape(Hl, O), axis=1,
-                          dtype=jnp.int64)
-    stats = stats.at[:, ST_PKTS_DROP_NET].add(net_per_src)
-    return hosts.replace(stats=stats), in_pkt, in_time, kept_sorted
+    return in_pkt, in_time, kept_sorted
 
 
 def _carry_outbox(hosts, pkts, stimes, arrival, stay, O):
@@ -491,6 +783,31 @@ def _trace_tx(hosts, hp, cfg: EngineConfig, pkts, stimes, departed):
         departed, 1, hp.pcap_on)
 
 
+def _merge_row(row, ipkt, itime, IN):
+    """Merge one host's inbound arrivals into its queue free slots.
+    Row-level under vmap; `row` may be a full Hosts row or the
+    _MergeView slice of one (destination-compacted path)."""
+    k = jnp.sum(itime != SIMTIME_MAX).astype(jnp.int32)
+    free = row.eq_time == SIMTIME_MAX
+    nfree = jnp.sum(free).astype(jnp.int32)
+    k2 = jnp.minimum(k, nfree)
+    frank = jnp.cumsum(free) - 1
+    take = free & (frank < k2)
+    j = jnp.clip(frank, 0, IN - 1)
+    overflow = k - k2
+    eq_time = jnp.where(take, itime[j], row.eq_time)
+    return row.replace(
+        eq_time=eq_time,
+        eq_kind=jnp.where(take, EV_PKT, row.eq_kind),
+        eq_seq=jnp.where(take, row.eq_ctr + frank.astype(jnp.int32),
+                         row.eq_seq),
+        eq_pkt=jnp.where(take[:, None], ipkt[j], row.eq_pkt),
+        eq_ctr=row.eq_ctr + k2,
+        eq_next=jnp.min(eq_time),  # cache invariant (state.eq_next)
+        stats=radd(row.stats, ST_PKTS_DROP_Q, jnp.int64(overflow)),
+    )
+
+
 def merge_arrivals(hosts, hp, cfg: EngineConfig, in_pkt, in_time):
     """Shared tail of both exchanges (single-chip and sharded — ONE
     implementation so the bit-equality contract between them cannot
@@ -506,28 +823,44 @@ def merge_arrivals(hosts, hp, cfg: EngineConfig, in_pkt, in_time):
         hosts = jax.vmap(_trace_append, in_axes=(0, 0, 0, 0, None, 0))(
             hosts, in_pkt, in_time, in_time != SIMTIME_MAX, 0, hp.pcap_on)
 
-    def merge(row, ipkt, itime):
-        k = jnp.sum(itime != SIMTIME_MAX).astype(jnp.int32)
-        free = row.eq_time == SIMTIME_MAX
-        nfree = jnp.sum(free).astype(jnp.int32)
-        k2 = jnp.minimum(k, nfree)
-        frank = jnp.cumsum(free) - 1
-        take = free & (frank < k2)
-        j = jnp.clip(frank, 0, IN - 1)
-        overflow = k - k2
-        eq_time = jnp.where(take, itime[j], row.eq_time)
-        return row.replace(
-            eq_time=eq_time,
-            eq_kind=jnp.where(take, EV_PKT, row.eq_kind),
-            eq_seq=jnp.where(take, row.eq_ctr + frank.astype(jnp.int32),
-                             row.eq_seq),
-            eq_pkt=jnp.where(take[:, None], ipkt[j], row.eq_pkt),
-            eq_ctr=row.eq_ctr + k2,
-            eq_next=jnp.min(eq_time),  # cache invariant (state.eq_next)
-            stats=radd(row.stats, ST_PKTS_DROP_Q, jnp.int64(overflow)),
-        )
+    return jax.vmap(partial(_merge_row, IN=IN))(hosts, in_pkt, in_time)
 
-    return jax.vmap(merge)(hosts, in_pkt, in_time)
+
+@chex.dataclass
+class _MergeView:
+    """The subset of Hosts the arrival merge touches — gathered for
+    just the destination rows in the compacted merge path, so the
+    merge's per-row queue rewrites and data-dependent gathers scale
+    with the number of RECEIVING hosts, not the host count (the
+    xplane trace showed those gathers were ~45 ms of every socks10k
+    window at [H, Q] width)."""
+    eq_time: jnp.ndarray
+    eq_kind: jnp.ndarray
+    eq_seq: jnp.ndarray
+    eq_pkt: jnp.ndarray
+    eq_ctr: jnp.ndarray
+    eq_next: jnp.ndarray
+    stats: jnp.ndarray
+
+
+_MERGE_FIELDS = ("eq_time", "eq_kind", "eq_seq", "eq_pkt", "eq_ctr",
+                 "eq_next", "stats")
+
+
+def merge_arrivals_at(hosts, cfg: EngineConfig, in_pkt, in_time, idxD):
+    """Destination-compacted arrival merge: `in_pkt`/`in_time` are
+    [D, IN] inbound buffers for the host rows named by idxD [D]
+    (duplicates allowed ONLY for rows with zero arrivals — their merge
+    is the identity, so duplicate scatters write identical bytes, the
+    same argument as step_window_pass's dummy slots). Gathers only the
+    merge-touched columns (_MergeView), merges, scatters back."""
+    IN = in_time.shape[1]
+    view = _MergeView(**{f: getattr(hosts, f)[idxD]
+                         for f in _MERGE_FIELDS})
+    merged = jax.vmap(partial(_merge_row, IN=IN))(view, in_pkt, in_time)
+    return hosts.replace(**{
+        f: getattr(hosts, f).at[idxD].set(getattr(merged, f))
+        for f in _MERGE_FIELDS})
 
 
 def update_cap_peaks(hosts):
@@ -605,7 +938,7 @@ def run_windows(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
 
 def _run_windows_impl(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
                       max_windows: int):
-    NR = len(ladder_of(cfg)) + 1  # rungs + dense (pass-mix counters)
+    NR = len(pass_labels(cfg))  # pass-mix counters (SimReport cost)
 
     def win_cond(carry):
         _, ws, _, i, _ = carry
@@ -618,27 +951,7 @@ def _run_windows_impl(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
         we_eff = jnp.minimum(we, sh.stop_time)
         ran = next_event_time(hosts) < we_eff  # >=1 event will execute
 
-        def ev_cond(carry2):
-            h, _ = carry2
-            go = next_event_time(h) < we_eff
-            if cfg.hostedcap > 1:
-                # pause before a hosted wake ring can overflow so the
-                # CPU tier drains mid-window (the window simply
-                # re-opens on the next call — long loopback event
-                # chains otherwise complete inside ONE window and
-                # blow past any fixed ring size). The threshold floor
-                # keeps tiny manual hostedcap values from wedging the
-                # loop (hw_cnt stays 0 without hosted apps).
-                cap = h.hw_time.shape[1]
-                go = go & (jnp.max(h.hw_cnt) < max(cap - 4, 1))
-            return go
-
-        def ev_body(carry2):
-            h, pc2 = carry2
-            h, rung = step_window_pass(h, hp, sh, we_eff, cfg)
-            return h, pc2.at[rung].add(1)
-
-        hosts, pc = jax.lax.while_loop(ev_cond, ev_body, (hosts, pc))
+        hosts, pc = drain_window(hosts, hp, sh, we_eff, cfg, pc)
         hosts = update_cap_peaks(hosts)
         ob0 = jnp.sum(hosts.ob_cnt)
         # an empty exchange is the identity: skip its sort/gather work

@@ -59,8 +59,7 @@ from ..engine import equeue
 from ..engine.defs import (EV_PKT, ST_PKTS_DROP_NET,
                            ST_DEFER_FANIN, ST_DEFER_A2A)
 from ..engine.state import EngineConfig
-from ..engine.window import (step_all_hosts, step_window_pass,
-                             update_cap_peaks)
+from ..engine.window import drain_window, update_cap_peaks
 from ..net import packet as P
 
 AXIS = "hosts"
@@ -149,8 +148,12 @@ def exchange_sharded(hosts, hp, sh, cfg: EngineConfig,
     # implementation keeps the bit-equality contract)
     order = jnp.argsort(g_key, stable=True)
     sdst = g_key[order]
-    hosts, in_pkt, in_time, kept_sorted = _deliver_dense(
-        hosts, order, sdst, g_pkt, g_arr, net_dropped, O, IN, cfg, lo=lo)
+    nfree = jnp.sum(hosts.eq_time == SIMTIME_MAX, axis=1,
+                    dtype=jnp.int32)
+    in_pkt, in_time, kept_sorted = _deliver_dense(
+        nfree, order, sdst, g_pkt, g_arr, IN, cfg, lo=lo)
+    hosts = hosts.replace(stats=hosts.stats.at[:, ST_PKTS_DROP_NET].add(
+        jnp.sum(net_dropped.reshape(Hl, O), axis=1, dtype=jnp.int64)))
 
     # accept flags back into the received-list original order, then
     # back to the SOURCE shards
@@ -258,8 +261,8 @@ def _windows_body(hosts, hp, sh, wstart, wend, cfg, lcfg, max_windows):
         return jax.lax.pmin(jnp.minimum(jnp.min(h.eq_next),
                                         jnp.min(h.ob_next)), AXIS)
 
-    from ..engine.window import ladder_of
-    NR = len(ladder_of(cfg, lcfg.num_hosts)) + 1
+    from ..engine.window import pass_labels
+    NR = len(pass_labels(cfg, lcfg.num_hosts))
 
     def win_cond(carry):
         _, ws, _, i, _ = carry
@@ -270,23 +273,13 @@ def _windows_body(hosts, hp, sh, wstart, wend, cfg, lcfg, max_windows):
         we_eff = jnp.minimum(we, sh.stop_time)
         ran = next_time_global(hosts) < we_eff
 
-        def ev_cond(carry2):
-            h, _ = carry2
-            return next_time_global(h) < we_eff
-
-        def ev_body(carry2):
-            # active-set compaction applies per shard (local rows);
-            # the while cond stays the global pmin so every shard runs
-            # the same number of (possibly no-op) passes — collectives
-            # remain uniform. Rung choice is shard-local (no
-            # collectives inside step_window_pass), so shards may run
-            # different rungs in the same pass; pass counters are
-            # per-shard and psum-reduced by the caller.
-            h, pc2 = carry2
-            h, rung = step_window_pass(h, hp, sh, we_eff, cfg)
-            return h, pc2.at[rung].add(1)
-
-        hosts, pc = jax.lax.while_loop(ev_cond, ev_body, (hosts, pc))
+        # the drain loop is SHARD-LOCAL (engine.window.drain_window has
+        # no collectives): each shard runs only the passes its own rows
+        # need — the reference's per-thread round execution before the
+        # barrier (shd-scheduler.c:602-635). Only the window advance
+        # below is a global decision. Rung choice and pass counters are
+        # per-shard; counters are psum-reduced at return.
+        hosts, pc = drain_window(hosts, hp, sh, we_eff, cfg, pc)
         hosts = update_cap_peaks(hosts)
         ob0 = jax.lax.psum(jnp.sum(hosts.ob_cnt), AXIS)
         hosts = exchange_sharded(hosts, hp, sh, cfg, lcfg)

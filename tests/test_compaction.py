@@ -132,6 +132,26 @@ def test_event_batch_bit_identical():
             r1.cost_model()["passes_total"])
 
 
+def test_exchange_sort_compaction_bit_identical():
+    """The exchange's sort compaction (EngineConfig.exsortcap) is a
+    sort-input change only: a stable sort of the compacted survivor
+    list equals the full stable sort filtered to survivors, so stats
+    must match bit for bit. A tiny cap forces BOTH branches over the
+    run (small windows compact, burst windows fall back)."""
+    full = _run(_skewed_scen(), 0)
+    sim = Simulation(_skewed_scen(), engine_cfg=EngineConfig(
+        num_hosts=8, active_block=0, exsortcap=16, **CFG))
+    compact = sim.run()
+    assert np.array_equal(full.stats, compact.stats)
+    assert full.windows == compact.windows
+    # tiny dstcap exercises BOTH dest-merge branches too (windows with
+    # <= 2 receiving hosts merge compacted, busier ones fall back)
+    sim2 = Simulation(_skewed_scen(), engine_cfg=EngineConfig(
+        num_hosts=8, active_block=0, exsortcap=16, dstcap=2, **CFG))
+    compact2 = sim2.run()
+    assert np.array_equal(full.stats, compact2.stats)
+
+
 def test_compaction_sharded_matches_dense_single():
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 (virtual) devices")

@@ -89,7 +89,7 @@ def build_socks(n_hosts, hops=1, stop=60, size=49152, count=0, pause="5s",
     )
 
 
-def socks_caps(n_hosts, scap=96, active_block=0):
+def socks_caps(n_hosts, scap=96, active_block=-1):
     """Lean engine caps for the SOCKS/Tor configs (see module doc).
 
     scap: each live circuit holds 2 sockets per relay it crosses plus
@@ -184,17 +184,20 @@ def build_bulk_1k(n_hosts=1000, stop=60):
 
 
 CONFIGS = {
-    # name: (builder, caps, default n)
+    # name: (builder, caps, default n). No active_block anywhere: the
+    # engine's automatic rung ladder (EngineConfig.active_block = -1,
+    # engine.window.ladder_of) replaced the round-3 hand-tuned
+    # per-config constants; pass --active-block to override for A/Bs.
     "socks10k": (lambda n, stop: build_socks(n, hops=1, stop=stop,
                                              count=0, pause="5s"),
-                 lambda n: socks_caps(n, scap=96, active_block=256),
+                 lambda n: socks_caps(n, scap=96),
                  10_000),
     "tor50k": (lambda n, stop: build_socks(n, hops=3, stop=stop,
                                            count=0, pause="10s"),
-               lambda n: socks_caps(n, scap=160, active_block=512),
+               lambda n: socks_caps(n, scap=160),
                50_000),
     "bulk1k": (lambda n, stop: build_bulk_1k(n, stop=stop),
-               lambda n: socks_caps(n, scap=32, active_block=128),
+               lambda n: socks_caps(n, scap=32),
                1_000),
 }
 
@@ -247,6 +250,7 @@ def run_config(name, n=None, stop=60, heartbeat=0.0, verbose=False,
         "active_block": cfg.active_block,
         "sock_fail": int(report.stats[:, defs.ST_SOCK_FAIL].sum()),
         "capacity": report.capacity_report(),
+        "cost": report.cost_model(),
     }
     return out
 

@@ -1,0 +1,196 @@
+#!/usr/bin/env python3
+"""Measured (not modeled) per-phase window-loop costs on this backend.
+
+The SimReport cost model (engine.sim.cost_model) prices passes from
+array shapes at HBM-roofline rates; this tool complements it by
+TIMING the phases as separate device calls at steady state:
+
+  - one lockstep pass per ladder rung (and dense), on the live state
+  - the window-boundary exchange
+  - the ready-mask / next-event reductions
+
+Method: build one of the baseline configs, run the normal chunked
+window loop to a warm-up point, then single-step windows manually —
+each phase its own AOT-compiled call, block_until_ready around a
+monotonic clock. Per-call dispatch overhead is measured too (an empty
+donated identity on the same state), so phase walls can be read net of
+it. Results print as one JSON line.
+
+This is the measurement the round-3 verdict asked for ("nobody can say
+what fraction of the hardware bound the TCP tier is"): where the
+reference self-times its scheduler barriers (shd-scheduler.c:250-252),
+the TPU build times its compiled phases.
+
+Usage:
+  python tools/phase_profile.py socks10k [--n 10000] [--stop 20]
+      [--warm-s 5] [--probe-windows 30] [--runahead-ms 10] [--cpu]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def profile(name, n=None, stop=20, warm_s=5.0, probe_windows=30,
+            runahead_ms=0, chunk=8):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from tools.baseline_configs import CONFIGS
+    from shadow_tpu.core.jitcache import AotJit
+    from shadow_tpu.engine.sim import Simulation
+    from shadow_tpu.core.simtime import SIMTIME_MAX
+    from shadow_tpu.engine.window import (exchange, ladder_of,
+                                          run_windows, step_window_pass,
+                                          next_event_time, next_wakeup,
+                                          update_cap_peaks)
+
+    builder, capf, n_default = CONFIGS[name]
+    n = n or n_default
+    sim = Simulation(builder(n, stop), engine_cfg=capf(n))
+    if runahead_ms:
+        sim.sh = sim.sh.replace(min_jump=jnp.int64(runahead_ms * 10**6))
+    hosts, hp, sh, cfg = sim.hosts, sim.hp, sim.sh, sim.cfg
+
+    # --- warm-up through the normal chunked loop to steady state ---
+    t0 = jnp.min(hosts.eq_next)
+    ws, we = t0, t0 + sh.min_jump
+    while float(ws) / 1e9 < warm_s and int(ws) < int(sh.stop_time):
+        hosts, ws, we, _, _ = run_windows(hosts, hp, sh, ws, we, cfg,
+                                          chunk)
+
+    ks = ladder_of(cfg)
+    labels = [f"k{k}" for k in ks] + ["dense"]
+
+    # --- phase programs, each its own Compiled object ---
+    def one_pass(h, wend):
+        return step_window_pass(h, hp, sh, wend, cfg)
+
+    def do_exchange(h):
+        return exchange(update_cap_peaks(h), hp, sh, cfg)
+
+    def reductions(h):
+        return next_event_time(h), next_wakeup(h)
+
+    def identity(h):
+        # dispatch-overhead probe: donated pass-through of the state
+        return h
+
+    p_pass = AotJit(one_pass, donate_argnums=(0,))
+    p_exch = AotJit(do_exchange, donate_argnums=(0,))
+    p_red = AotJit(reductions)
+    p_id = AotJit(identity, donate_argnums=(0,))
+
+    def timed(fn, *args):
+        t = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        return out, time.perf_counter() - t
+
+    # compile everything once off the clock (identity needs real state;
+    # run it twice so both come back donated-warm)
+    hosts, _ = timed(p_id, hosts)
+    nt, wk = p_red(hosts)
+    (hosts, _r), _ = timed(p_pass, hosts,
+                           jnp.minimum(wk + sh.min_jump, sh.stop_time))
+    hosts = p_exch(hosts)
+    jax.block_until_ready(hosts)
+
+    walls = {lbl: [] for lbl in labels}
+    ev_counts = {lbl: [] for lbl in labels}
+    exch_walls, red_walls, id_walls = [], [], []
+    ev_stat = 0  # defs.ST_EVENTS == 0
+
+    wins = 0
+    while wins < probe_windows:
+        (nt, wk), dt = timed(p_red, hosts)
+        red_walls.append(dt)
+        nt = int(nt)
+        if nt >= int(sh.stop_time) or nt >= SIMTIME_MAX:
+            break
+        wend = jnp.int64(min(nt + int(sh.min_jump), int(sh.stop_time)))
+        # drain the window pass by pass
+        while True:
+            hosts, dt = timed(p_id, hosts)
+            id_walls.append(dt)
+            ev0 = int(jnp.sum(hosts.stats[:, ev_stat]))
+            if int(next_event_time(hosts)) >= int(wend):
+                break
+            (hosts, rung), dt = timed(p_pass, hosts, wend)
+            lbl = labels[int(rung)]
+            walls[lbl].append(dt)
+            ev_counts[lbl].append(
+                int(jnp.sum(hosts.stats[:, ev_stat])) - ev0)
+        if int(jnp.sum(hosts.ob_cnt)) > 0:  # real loop skips empty
+            hosts, dt = timed(p_exch, hosts)
+            exch_walls.append(dt)
+        wins += 1
+
+    def ms(xs):
+        return round(1e3 * float(np.mean(xs)), 3) if xs else None
+
+    out = {
+        "config": name, "hosts": n, "backend": jax.default_backend(),
+        "probe_windows": wins,
+        "dispatch_ms": ms(id_walls),
+        "reductions_ms": ms(red_walls),
+        "exchange_ms": ms(exch_walls),
+        "passes": {},
+    }
+    for lbl in labels:
+        if walls[lbl]:
+            out["passes"][lbl] = {
+                "count": len(walls[lbl]),
+                "mean_ms": ms(walls[lbl]),
+                "mean_events": round(float(np.mean(ev_counts[lbl])), 1),
+                "us_per_event": round(
+                    1e6 * float(np.sum(walls[lbl])) /
+                    max(sum(ev_counts[lbl]), 1), 2),
+            }
+    return out
+
+
+def main(argv):
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("config")
+    ap.add_argument("--n", type=int, default=None)
+    ap.add_argument("--stop", type=int, default=20)
+    ap.add_argument("--warm-s", type=float, default=5.0)
+    ap.add_argument("--probe-windows", type=int, default=30)
+    ap.add_argument("--runahead-ms", type=int, default=0)
+    ap.add_argument("--chunk", type=int, default=8)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--active-block", type=int, default=None)
+    args = ap.parse_args(argv)
+    if args.cpu:
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        # chip runs reuse the persistent compile cache (bench.py)
+        sys.path.insert(0, REPO)
+        from bench import _enable_compile_cache
+        _enable_compile_cache()
+    if args.active_block is not None:
+        import dataclasses
+        from tools import baseline_configs as bc
+        nm = args.config
+        b, capf, nd = bc.CONFIGS[nm]
+        bc.CONFIGS[nm] = (b, lambda nn: dataclasses.replace(
+            capf(nn), active_block=args.active_block), nd)
+    print(json.dumps(profile(
+        args.config, n=args.n, stop=args.stop, warm_s=args.warm_s,
+        probe_windows=args.probe_windows,
+        runahead_ms=args.runahead_ms, chunk=args.chunk)))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,210 @@
+#!/usr/bin/env python3
+"""Capture a jax.profiler trace of the window loop and aggregate
+device-op durations WITHOUT tensorboard.
+
+The round-4 profiling problem: the tunnel backend adds ~100 ms to
+every dispatch, so host-side phase timing (tools/phase_profile.py)
+resolves nothing finer than ~10 ms — while the unattributed cost in
+the socks10k wall lives somewhere INSIDE the compiled window program.
+jax.profiler writes .xplane.pb files locally; this tool decodes the
+protobuf wire format directly (XSpace/XPlane/XLine/XEvent — the
+schema is tensorflow/tsl's xplane.proto) and prints the top ops by
+total self duration per plane, which names the hot HLOs (fusions,
+copies, sorts, scatters) exactly.
+
+Usage:
+  python tools/xplane_profile.py socks10k [--n ...] [--warm-s 6]
+      [--trace-windows 16] [--runahead-ms 10] [--top 40] [--cpu]
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+# --- minimal protobuf wire decoding ---------------------------------------
+
+def _varint(buf, i):
+    x = 0
+    s = 0
+    while True:
+        b = buf[i]
+        i += 1
+        x |= (b & 0x7F) << s
+        if not b & 0x80:
+            return x, i
+        s += 7
+
+
+def _fields(buf):
+    """Yield (field_number, wire_type, value) over a message buffer.
+    value: int for varint(0)/fixed(1,5), memoryview for bytes(2)."""
+    i, n = 0, len(buf)
+    while i < n:
+        key, i = _varint(buf, i)
+        fn, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _varint(buf, i)
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 1:
+            v = int.from_bytes(buf[i:i + 8], "little")
+            i += 8
+        elif wt == 5:
+            v = int.from_bytes(buf[i:i + 4], "little")
+            i += 4
+        else:  # groups unsupported/absent in xplane
+            raise ValueError(f"wire type {wt}")
+        yield fn, wt, v
+
+
+def parse_xspace(path):
+    """-> [(plane_name, {op_name: total_duration_ps})]"""
+    buf = memoryview(open(path, "rb").read())
+    planes = []
+    for fn, wt, v in _fields(buf):
+        if fn == 1 and wt == 2:             # XSpace.planes
+            planes.append(_parse_plane(v))
+    return planes
+
+
+def _parse_plane(buf):
+    name = ""
+    meta = {}                                # id -> event name
+    lines = []
+    for fn, wt, v in _fields(buf):
+        if fn == 2 and wt == 2:              # XPlane.name
+            name = bytes(v).decode("utf-8", "replace")
+        elif fn == 3 and wt == 2:            # XPlane.lines
+            lines.append(v)
+        elif fn == 4 and wt == 2:            # XPlane.event_metadata (map)
+            k, m = None, None
+            for fn2, wt2, v2 in _fields(v):
+                if fn2 == 1:
+                    k = v2
+                elif fn2 == 2 and wt2 == 2:
+                    m = v2
+            if k is not None and m is not None:
+                mname = ""
+                for fn3, wt3, v3 in _fields(m):
+                    if fn3 == 2 and wt3 == 2:  # XEventMetadata.name
+                        mname = bytes(v3).decode("utf-8", "replace")
+                meta[k] = mname
+    durs = collections.Counter()
+    counts = collections.Counter()
+    for lbuf in lines:
+        for fn, wt, v in _fields(lbuf):
+            # this build writes XLine.events at field 4 (older schema
+            # revisions used 6 — accept both)
+            if fn in (4, 6) and wt == 2:     # XLine.events
+                mid, dur = None, 0
+                for fn2, wt2, v2 in _fields(v):
+                    if fn2 == 1:             # XEvent.metadata_id
+                        mid = v2
+                    elif fn2 == 3:           # XEvent.duration_ps
+                        dur = v2
+                if mid is not None:
+                    key = meta.get(mid, f"#{mid}")
+                    durs[key] += dur
+                    counts[key] += 1
+    return name, dict(durs), dict(counts)
+
+
+def aggregate(trace_dir, top=40):
+    out = []
+    for path in sorted(glob.glob(
+            os.path.join(trace_dir, "**", "*.xplane.pb"),
+            recursive=True)):
+        for name, durs, counts in parse_xspace(path):
+            if not durs:
+                continue
+            total = sum(durs.values())
+            ops = sorted(durs.items(), key=lambda kv: -kv[1])[:top]
+            out.append({
+                "plane": name,
+                "total_ms": round(total / 1e9, 3),
+                "ops": [{"op": k, "ms": round(v / 1e9, 3),
+                         "n": counts[k],
+                         "pct": round(100 * v / total, 1)}
+                        for k, v in ops],
+            })
+    return out
+
+
+# --- capture ---------------------------------------------------------------
+
+def capture(name, n=None, warm_s=6.0, trace_windows=16, runahead_ms=0,
+            chunk=8, trace_dir="/tmp/shadow_xplane"):
+    import jax
+    import jax.numpy as jnp
+    from tools.baseline_configs import CONFIGS
+    from shadow_tpu.engine.sim import Simulation
+    from shadow_tpu.engine.window import run_windows
+
+    builder, capf, n_default = CONFIGS[name]
+    n = n or n_default
+    sim = Simulation(builder(n, 60), engine_cfg=capf(n))
+    if runahead_ms:
+        sim.sh = sim.sh.replace(min_jump=jnp.int64(runahead_ms * 10**6))
+    hosts, hp, sh, cfg = sim.hosts, sim.hp, sim.sh, sim.cfg
+
+    t0 = jnp.min(hosts.eq_next)
+    ws, we = t0, t0 + sh.min_jump
+    while float(ws) / 1e9 < warm_s:
+        hosts, ws, we, _, _ = run_windows(hosts, hp, sh, ws, we, cfg,
+                                          chunk)
+    ran = 0
+    with jax.profiler.trace(trace_dir):
+        while ran < trace_windows:
+            hosts, ws, we, k, _ = run_windows(hosts, hp, sh, ws, we,
+                                              cfg, chunk)
+            jax.block_until_ready(hosts.stats)
+            ran += int(k)
+    return trace_dir, ran
+
+
+def main(argv):
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("config")
+    ap.add_argument("--n", type=int, default=None)
+    ap.add_argument("--warm-s", type=float, default=6.0)
+    ap.add_argument("--trace-windows", type=int, default=16)
+    ap.add_argument("--runahead-ms", type=int, default=0)
+    ap.add_argument("--chunk", type=int, default=8)
+    ap.add_argument("--top", type=int, default=40)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--parse-only", default=None,
+                    help="skip capture; aggregate this trace dir")
+    args = ap.parse_args(argv)
+    if args.parse_only:
+        print(json.dumps(aggregate(args.parse_only, args.top), indent=1))
+        return
+    if args.cpu:
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        from bench import _enable_compile_cache
+        _enable_compile_cache()
+    import shutil
+    shutil.rmtree("/tmp/shadow_xplane", ignore_errors=True)
+    tdir, ran = capture(args.config, n=args.n, warm_s=args.warm_s,
+                        trace_windows=args.trace_windows,
+                        runahead_ms=args.runahead_ms, chunk=args.chunk)
+    print(json.dumps({"traced_windows": ran,
+                      "planes": aggregate(tdir, args.top)}, indent=1))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
