@@ -30,8 +30,17 @@ class AotJit:
 
         def leaf_sig(x):
             aval = jax.api_util.shaped_abstractify(x)
+            # the input SHARDING is part of the executable contract
+            # too: an AOT program compiled for replicated arrays must
+            # not run against mesh-sharded ones (hosted + mesh runs
+            # call the same op-replay program in both placements)
+            sh = getattr(x, "sharding", None)
+            try:
+                hash(sh)
+            except TypeError:
+                sh = None
             return (aval.shape, str(aval.dtype),
-                    getattr(aval, "weak_type", False))
+                    getattr(aval, "weak_type", False), sh)
 
         return treedef, tuple(leaf_sig(x) for x in leaves)
 

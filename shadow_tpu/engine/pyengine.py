@@ -1514,8 +1514,9 @@ class PyEngine:
         """Route/loss-roll/deliver this window's outboxes. Mirrors the
         round-3 deferral semantics: a destination takes at most
         min(incap, queue headroom) arrivals per window (headroom =
-        free slots - reserve, but never below one when any slot is
-        free); the rest STAY in the source outbox with unchanged send
+        free slots - reserve, floored at one arrival while at least
+        two slots are free); the rest STAY in the source outbox with
+        unchanged send
         times and re-exchange next window (ST_DEFER_FANIN). Returns
         the number of packets that departed an outbox (delivered or
         reliability-dropped) — the engines' shared progress signal."""
@@ -1551,8 +1552,12 @@ class PyEngine:
         for dst, lst in delivered.items():
             host = self.hosts[dst]
             nfree = len(host.free_slots)
+            # progress floor admits one arrival only while a second
+            # free slot remains for internal pushes (mirrors
+            # engine.window._intake_take — THE intake policy)
             allow = min(self.cfg.incap,
-                        max(nfree - self.reserve, min(nfree, 1)))
+                        max(nfree - self.reserve,
+                            1 if nfree >= 2 else 0))
             for ent in lst[:allow]:
                 slot = min(host.free_slots)
                 host.free_slots.remove(slot)

@@ -332,15 +332,20 @@ class Simulation:
                 start_times[idx, p] = proc.start_time
                 has_app[idx, p] = True
                 if proc.plugin.startswith("hosted:"):
-                    if len(spec.processes) > 1:
-                        # hosted op replay runs outside the dispatch
-                        # context, so its sockets would bind to slot 0
+                    # a hosted process may share its host with modeled
+                    # processes (the reference's canonical tor+tgen
+                    # host shape, shd-configuration.h:36-95): the op
+                    # replay stamps the hosted slot so sockets wake it
+                    # (hosting/bridge.py). One hosted process per host:
+                    # the wake-ring records carry no process id, so
+                    # two hosted apps on one host would be ambiguous.
+                    if any(i == idx for i, _, _, _, _ in hosted_specs):
                         raise NotImplementedError(
-                            f"host {name!r} mixes a hosted process "
-                            "with other processes; hosted apps must "
-                            "be their host's only process")
+                            f"host {name!r} declares two hosted "
+                            "processes; at most one per host (modeled "
+                            "processes alongside are fine)")
                     hosted_specs.append(
-                        (idx, name, proc.plugin[len("hosted:"):],
+                        (idx, p, name, proc.plugin[len("hosted:"):],
                          proc.arguments))
         # gossip relay draws target uniformly random ids in [0, n);
         # in a mixed scenario any non-gossip id inside that range eats
@@ -386,9 +391,19 @@ class Simulation:
             from ..hosting.api import lookup
             from ..hosting.runtime import HostingRuntime
             apps = {idx: lookup(app_name)(args)
-                    for idx, _, app_name, args in hosted_specs}
-            hnames = {idx: hname for idx, hname, _, _ in hosted_specs}
-            self.hosting = HostingRuntime(apps, hnames, self.dns, seed)
+                    for idx, _, _, app_name, args in hosted_specs}
+            hnames = {idx: hname for idx, _, hname, _, _ in hosted_specs}
+            procs = {idx: p for idx, p, _, _, _ in hosted_specs}
+            self.hosting = HostingRuntime(apps, hnames, self.dns, seed,
+                                          procs=procs)
+            if self.cfg.scap > 256:
+                # hosting packs socket slots into 8-bit handle fields
+                # (hosting/bridge.py op_pipe_open) — larger tables
+                # would silently alias pipe halves
+                raise ValueError(
+                    f"hosted apps require scap <= 256 (got "
+                    f"{self.cfg.scap}): pipe handles pack the slot "
+                    "into 8 bits")
             if self.cfg.hostedcap < 32:
                 # concurrent wakes within one window (e.g. several
                 # accepts) must all fit the ring or callbacks are lost
@@ -572,9 +587,6 @@ class Simulation:
 
             def step(hosts, ws, we):
                 return run_windows(hosts, hp, sh, ws, we, cfg, chunk)
-        elif self.hosting:
-            raise NotImplementedError(
-                "hosted apps + mesh sharding not supported yet")
         else:
             from ..parallel.shard import (AXIS, device_put_sharded,
                                           run_windows_sharded)
@@ -582,10 +594,18 @@ class Simulation:
             hosts, hp, sh, cfg = self._pad_for_mesh(n)
             hosts, hp, sh = device_put_sharded(hosts, hp, sh, mesh)
             per_chip_h = cfg.num_hosts // n
+            # hosted + mesh: the wake rings are per-host rows, so they
+            # shard with the rest of the state; the drain loop's ring-
+            # overflow pause is shard-local (each shard pauses its own
+            # drain), and the CPU tier reads/writes the global arrays
+            # between chunks (single-process mesh only — the multiproc
+            # gate above still applies). chunk=1: hosted apps need the
+            # CPU between every window.
+            chunk = 1 if self.hosting else cfg.chunk_windows
 
             def step(hosts, ws, we):
                 return run_windows_sharded(hosts, hp, sh, ws, we, cfg,
-                                           cfg.chunk_windows, mesh)
+                                           chunk, mesh)
 
         # cost-model bookkeeping (SimReport.cost_model): pass mix per
         # compaction rung + per-row state bytes
@@ -645,6 +665,12 @@ class Simulation:
             if self.hosting is not None:
                 now = min(ws, int(sh.stop_time))
                 hosts = self.hosting.step(hosts, hp, sh, now)
+                if mesh is not None:
+                    # the op-replay program may hand back differently-
+                    # placed arrays; the AOT sharded window program
+                    # requires its exact input sharding
+                    from ..parallel.shard import put_hosts
+                    hosts = put_hosts(hosts, mesh)
                 dropped = int(np.asarray(hosts.hw_drop).sum())
                 if dropped:
                     raise RuntimeError(

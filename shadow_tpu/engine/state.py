@@ -112,8 +112,9 @@ class EngineConfig:
     #   host set fits the cap merge only those rows ([D] gathers
     #   instead of [H]-wide queue rewrites — the xplane trace showed
     #   the full-width merge's data-dependent gathers were ~45 ms of
-    #   every socks10k window). 0 = auto (min(H, 2048)); bit-identical
-    #   either way (a no-arrival row's merge is the identity).
+    #   every socks10k window). 0 = auto (min(H, 4096) —
+    #   engine.window.dst_cap); bit-identical either way (a no-arrival
+    #   row's merge is the identity).
     event_batch: int = 8    # max consecutive due events drained per
     #   gathered host within ONE sparse compaction pass (engine.window.
     #   sparse_batch; forced to 1 under the CPU model and with hosted

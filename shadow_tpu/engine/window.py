@@ -488,6 +488,22 @@ def dst_cap(cfg: EngineConfig) -> int:
     return min(cfg.num_hosts, 4096)
 
 
+def _intake_take(nfree, count_of, IN, cfg: EngineConfig):
+    """THE per-destination intake policy — the single definition both
+    exchange paths share (and the pyengine oracle mirrors,
+    engine.pyengine._exchange): take = min(count, IN, headroom) where
+    headroom = free queue slots less a reserve for protocol-internal
+    pushes, floored at one arrival while at least TWO slots remain
+    free (forward progress without starving internal pushes into
+    ST_EQ_FULL_LOCAL — advisor round 3). With nfree <= 1 the arrival
+    defers at the source; run_windows' anti-livelock advance drains
+    the destination meanwhile."""
+    reserve = min(8, cfg.qcap // 4)
+    floor = jnp.where(nfree >= 2, 1, 0)
+    allow = jnp.minimum(IN, jnp.maximum(nfree - reserve, floor))
+    return jnp.minimum(count_of, allow)
+
+
 def _trace_append(row, pkts, times, valid, dirv, on):
     """Append up to len(times) records to this host's trace ring
     (obs.pcap). Row-level under vmap; compiled only when tracing."""
@@ -514,11 +530,23 @@ def exchange(hosts, hp, sh, cfg: EngineConfig):
     Round-3 deferral semantics: a packet whose destination cannot take
     it this window (per-window intake budget or queue headroom spent)
     STAYS in the source outbox and re-exchanges next window with its
-    send time — and therefore its arrival time — unchanged. Exact
-    carry, never a drop: the only modeled drop points are the topology
-    reliability roll here and the NIC input buffer
+    send time — and therefore its arrival time — unchanged. Never a
+    drop: the only modeled drop points are the topology reliability
+    roll here and the NIC input buffer
     (shd-network-interface.c:288-311). Engine-capacity pressure shows
-    up as ST_DEFER_FANIN, not as lost packets."""
+    up as ST_DEFER_FANIN, not as lost packets.
+
+    Causal caveat (advisor round 3): the carry preserves arrival
+    STAMPS, not execution order. By the window in which a deferred
+    packet finally merges, its destination may already have executed
+    events with later timestamps (e.g. an RTO that fired before the
+    'earlier' ACK was processed), so the arrival's handler runs with a
+    stale `now` against newer state. This matches the reference's
+    behavior under resource pressure only loosely (the reference
+    blocks the sender instead); both engines (this one and the
+    pyengine oracle) implement the SAME rule, so differential tests
+    stay exact, and TCP handlers are timestamp-robust (stale ACKs/
+    segments are filtered by sequence state, not wall order)."""
     H, O, IN = cfg.num_hosts, cfg.obcap, cfg.incap
     N = H * O
 
@@ -615,10 +643,7 @@ def exchange(hosts, hp, sh, cfg: EngineConfig):
                     jnp.arange(H, dtype=jnp.int32), mode="drop")
                 nfreeD = jnp.sum(h.eq_time[idxD] == SIMTIME_MAX,
                                  axis=1, dtype=jnp.int32)
-                reserve = min(8, cfg.qcap // 4)
-                allowD = jnp.minimum(IN, jnp.maximum(
-                    nfreeD - reserve, jnp.minimum(nfreeD, 1)))
-                take_ofD = jnp.minimum(count_of[idxD], allowD)
+                take_ofD = _intake_take(nfreeD, count_of[idxD], IN, cfg)
                 r = jnp.arange(IN)
                 jD = jnp.clip(first_of[idxD][:, None] + r[None, :],
                               0, C - 1)
@@ -717,9 +742,12 @@ def _deliver_dense(nfree, order, sdst, pkts, arrival,
 
     Per-destination intake = min(IN, queue headroom): the IN window
     budget, bounded by the free event-queue slots less the reserve for
-    protocol-internal pushes — but never less than one arrival when
-    any slot is free, so a jammed destination still makes progress
-    (no livelock). Returns kept_sorted, the accepted mask over the
+    protocol-internal pushes — floored at one arrival while at least
+    TWO slots are free, so a jammed destination still makes progress
+    without the floor consuming the last slot internal pushes need
+    (no livelock either way: with nfree <= 1 the arrival defers at
+    the source and run_windows' anti-livelock advance drains the
+    destination). Returns kept_sorted, the accepted mask over the
     sorted list (False for entries destined outside this block), which
     the caller turns into source-side carries."""
     N = sdst.shape[0]
@@ -728,10 +756,7 @@ def _deliver_dense(nfree, order, sdst, pkts, arrival,
     first_of = jnp.searchsorted(sdst, dsts, side="left")
     count_of = jnp.searchsorted(sdst, dsts, side="right") - first_of
 
-    reserve = min(8, cfg.qcap // 4)
-    allow = jnp.minimum(IN, jnp.maximum(nfree - reserve,
-                                        jnp.minimum(nfree, 1)))
-    take_of = jnp.minimum(count_of, allow)
+    take_of = _intake_take(nfree, count_of, IN, cfg)
 
     r = jnp.arange(IN)
     j = jnp.clip(first_of[:, None] + r[None, :], 0, N - 1)  # [Hl, IN]

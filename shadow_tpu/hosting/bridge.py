@@ -39,9 +39,13 @@ _I32 = jnp.int32
 _I64 = jnp.int64
 
 # --- op encoding (int64 words) ---
-# [host, opcode, a, b, c, d, t]  (t = sim time the app issued the op,
-# i.e. its wake's event time — ops apply at app time, not window time)
-OP_WORDS = 7
+# [host, opcode, a, b, c, d, t, proc]  (t = sim time the app issued the
+# op, i.e. its wake's event time — ops apply at app time, not window
+# time; proc = the hosted process's slot on its host, so sockets the
+# replay allocates carry the right sk_proc and wake back to the hosted
+# process even when modeled processes share the host — the reference's
+# canonical tor+tgen host shape, shd-configuration.h:36-95)
+OP_WORDS = 8
 OP_NOP = 0
 OP_UDP_OPEN = 1      # a=port (0 = ephemeral)           -> slot
 OP_TCP_LISTEN = 2    # a=port                           -> slot
@@ -78,6 +82,11 @@ def _apply_one(hosts, hp, sh, op, results):
     now = op[6]
     row = jax.tree.map(lambda a: a[h], hosts)
     hrow = jax.tree.map(lambda a: a[h], hp)
+    # run the replay in the hosted process's dispatch context: sockets
+    # it opens stamp sk_proc = app_proc (net.socket.sock_alloc), so
+    # their wakes route back to the hosted slot, not process 0
+    PP = row.app_node.shape[0]
+    row = row.replace(app_proc=jnp.clip(op[7], 0, PP - 1).astype(_I32))
 
     K = results.shape[0]
 
@@ -158,10 +167,14 @@ def _apply_one(hosts, hp, sh, op, results):
         return r, _I32(0)
 
     def op_timer(r):
-        wake = rset(rset(rset(jnp.zeros((P.PKT_WORDS,), _I32),
-                              P.ACK, _I32(WAKE_TIMER)),
-                         P.SEQ, _I32(-1)),
-                    P.AUX, op[3].astype(_I32))
+        # slotless wake: P.SRC carries the process slot (the same
+        # convention modeled apps use, apps.base.schedule_wake) so the
+        # timer returns to the hosted process on a multi-process host
+        wake = rset(rset(rset(rset(jnp.zeros((P.PKT_WORDS,), _I32),
+                                   P.ACK, _I32(WAKE_TIMER)),
+                              P.SEQ, _I32(-1)),
+                         P.AUX, op[3].astype(_I32)),
+                    P.SRC, r.app_proc)
         r = equeue.q_push(r, op[2], EV_APP, wake)
         return r, _I32(0)
 
@@ -170,7 +183,12 @@ def _apply_one(hosts, hp, sh, op, results):
         from ..net.channel import pipe_open
         r, a, b, ok = pipe_open(r)
         # pack BOTH halves with their generations:
-        # gen_a(7) | slot_a(8) | gen_b(7) | slot_b(8) — 30 bits
+        # gen_a(7) | slot_a(8) | gen_b(7) | slot_b(8) — 30 bits.
+        # The 8-bit slot fields require scap <= 256 (validated at
+        # Simulation build for hosted scenarios); the 7-bit gen
+        # window means a slot recycled >127 times between an open
+        # and its close could alias — acceptable for pipe lifetimes,
+        # which are bounded by one hosted process's run
         gen_a = _rget(r.sk_timer_gen, a) & 0x7F
         gen_b = _rget(r.sk_timer_gen, b) & 0x7F
         packed = ((gen_a << 23) | ((a & 0xFF) << 15) |
@@ -181,6 +199,8 @@ def _apply_one(hosts, hp, sh, op, results):
         jnp.clip(code, 0, 8),
         [op_nop, op_udp_open, op_listen, op_connect, op_write, op_sendto,
          op_close, op_timer, op_pipe_open], row)
+    # restore the between-dispatches invariant (app_proc == 0)
+    row = row.replace(app_proc=_I32(0))
     hosts = jax.tree.map(lambda a, v: a.at[h].set(v), hosts, row)
     return hosts, result
 

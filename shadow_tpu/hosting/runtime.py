@@ -22,9 +22,13 @@ class HostingRuntime:
     """Owns the hosted app instances and the window-boundary exchange."""
 
     def __init__(self, apps: dict, names: dict, dns, seed: int,
-                 batch_cap: int = 256):
-        # apps: host_id -> HostedApp; names: host_id -> hostname
+                 batch_cap: int = 256, procs: dict = None):
+        # apps: host_id -> HostedApp; names: host_id -> hostname;
+        # procs: host_id -> the hosted process's slot on its host
+        # (0 when the hosted app is the only process — the op replay
+        # stamps it so sockets wake the hosted slot, not process 0)
         self.apps = apps
+        self.procs = procs or {}
         self.batch_cap = batch_cap
         self._now = 0
         self.os = {
@@ -137,7 +141,7 @@ class HostingRuntime:
                 return int(x)
 
             ops[k] = (hid, op.code, enc(op.a), enc(op.b), enc(op.c),
-                      enc(op.d), op.t)
+                      enc(op.d), op.t, self.procs.get(hid, 0))
         hosts, results = apply_ops_jit(hosts, hp, sh, jnp.asarray(ops))
         res = np.asarray(results)
         for k, (hid, os, op) in enumerate(pending):

@@ -39,6 +39,15 @@ Round 3: the full SERVER path (bind/listen/accept) and UDP
 (sendto/recvfrom) — an unmodified epoll server binary accepts
 simulated clients, mirroring the reference's server-side process_emu
 surface (shd-process.c:1993-2605).
+
+Round 4: BLOCKING semantics — per-vfd O_NONBLOCK tracking (fcntl,
+SOCK_NONBLOCK, ioctl FIONBIO) with blocking connect/recv/recvfrom/
+accept parking until their wake, which is what lets stock
+blocking-socket binaries (e.g. the CPython interpreter running a
+plain socket script, tests/test_shim.py) run unmodified. Known gap:
+poll()/select() are not interposed, so clients that wait with those
+(e.g. CPython sockets with a TIMEOUT set, which go nonblocking and
+poll internally) need the epoll or plain-blocking style instead.
 """
 
 from __future__ import annotations
@@ -77,6 +86,7 @@ EPOLLHUP = 0x010
 EINPROGRESS = 115
 ENOTCONN = 107
 EAGAIN = 11
+ECONNREFUSED = 111
 
 EPOLL_CTL_ADD = 1
 EPOLL_CTL_DEL = 2
@@ -159,7 +169,14 @@ class ShimApp(HostedApp):
         # lookup alone would drop e.g. the post-shutdown EOF
         self.epolls = {}          # vepfd -> {vfd: events}
         self.next_fd = 1 << 20
-        self.parked = None        # vepfd the child is blocked in, or None
+        # the child's one blocked call (it is single-threaded): None,
+        # ("epoll", epfd, maxev), ("connect", vfd), ("recv", vfd, n),
+        # ("recvd", vfd, n) [blocking recv() on udp],
+        # ("recvfrom", vfd, n), or ("accept", vfd). Blocking calls park
+        # here until a wake satisfies them (_maybe_unpark) — the
+        # shim's replacement for the reference's rpth block/reenter
+        # (shd-process.c:1076-1263)
+        self.parked = None
         self.park_seq = 0         # increments per park: stale-timeout guard
         self.exited = False
 
@@ -230,16 +247,99 @@ class ShimApp(HostedApp):
             out += EVPAIR.pack(vfd, ev)
         self.chan.sendall(out)
 
+    def _alloc_vfd(self):
+        """Next virtual fd. Fails LOUD at the preload library's
+        per-vfd flag-table bound (shim_preload.c NB_CAP): past it the
+        C side could no longer track O_NONBLOCK and a nonblocking
+        call would silently park — wedging the child — instead of
+        returning EAGAIN."""
+        if self.next_fd - (1 << 20) >= (1 << 16):
+            raise RuntimeError(
+                "hosted binary exhausted the shim's vfd space "
+                "(65536 sockets/epolls over the process lifetime)")
+        vfd = self.next_fd
+        self.next_fd += 1
+        return vfd
+
+    def _rsp_accept(self, vs):
+        """Pop one pending child off a listener and answer the accept
+        call (shared by the immediate and parked paths)."""
+        child, src, sport = vs.accept_q.pop(0)
+        cfd = self._alloc_vfd()
+        cvs = _VSock(kind="tcp")
+        cvs.sock = child
+        cvs.connected = True
+        self.vfds[cfd] = cvs
+        self.by_sock[id(child)] = cfd
+        if child.slot is not None:
+            self.by_key[(child.slot, child.gen)] = cfd
+            cvs.key = (child.slot, child.gen)
+        # peer identity: (virtual host id, port) off the handshake —
+        # servers keying state by accept() address see distinct
+        # simulated clients
+        self._rsp(cfd, src, sport)
+
     def _maybe_unpark(self):
+        """Answer the child's parked blocking call if a wake has made
+        it ready. One parked call at most (single-threaded child)."""
         if self.parked is None:
             return False
-        epfd, maxev = self.parked
-        hits = self._ready(epfd, maxev)
-        if not hits:
+        kind = self.parked[0]
+        if kind == "epoll":
+            _, epfd, maxev = self.parked
+            hits = self._ready(epfd, maxev)
+            if not hits:
+                return False
+            self.parked = None
+            self._rsp_events(hits)
+            return True
+        if kind == "connect":
+            vfd = self.parked[1]
+            vs = self.vfds.get(vfd)
+            if vs is None or vs.eof:
+                self.parked = None
+                self._rsp(-1, ECONNREFUSED)
+                return True
+            if vs.connected:
+                self.parked = None
+                self._rsp(0)
+                return True
             return False
-        self.parked = None
-        self._rsp_events(hits)
-        return True
+        if kind == "recv":
+            _, vfd, n = self.parked
+            vs = self.vfds.get(vfd)
+            if vs is None:
+                self.parked = None
+                self._rsp(0)
+                return True
+            if vs.avail > 0 or vs.eof:
+                k = min(vs.avail, n)
+                vs.avail -= k
+                self.parked = None
+                self._rsp(k)             # 0 = EOF
+                return True
+            return False
+        if kind in ("recvd", "recvfrom"):
+            _, vfd, n = self.parked
+            vs = self.vfds.get(vfd)
+            if vs is None or not vs.dgrams:
+                return False
+            src, sport, nbytes = vs.dgrams.pop(0)
+            self.parked = None
+            if kind == "recvfrom":
+                self._rsp(min(n, nbytes), src, sport)
+            else:
+                self._rsp(min(n, nbytes))
+            return True
+        if kind == "accept":
+            vfd = self.parked[1]
+            vs = self.vfds.get(vfd)
+            if vs is None or not vs.accept_q:
+                return False
+            self.parked = None
+            self._rsp_accept(vs)
+            return True
+        return False
 
     # --- the service loop: run the child until it blocks ---
     def _service(self, os):
@@ -257,8 +357,7 @@ class ShimApp(HostedApp):
 
     def _handle(self, os, op, a, b, c, name):
         if op == OP_SOCKET:
-            vfd = self.next_fd
-            self.next_fd += 1
+            vfd = self._alloc_vfd()
             self.vfds[vfd] = _VSock(kind="udp" if a else "tcp")
             self._rsp(vfd)
         elif op == OP_BIND:
@@ -276,24 +375,12 @@ class ShimApp(HostedApp):
             self._rsp(0)
         elif op == OP_ACCEPT:
             vs = self.vfds[a]
-            if not vs.accept_q:
-                self._rsp(-1, EAGAIN)
+            if vs.accept_q:
+                self._rsp_accept(vs)
+            elif int(b) & 1:             # blocking listener: park
+                self.parked = ("accept", a)
             else:
-                child, src, sport = vs.accept_q.pop(0)
-                cfd = self.next_fd
-                self.next_fd += 1
-                cvs = _VSock(kind="tcp")
-                cvs.sock = child
-                cvs.connected = True
-                self.vfds[cfd] = cvs
-                self.by_sock[id(child)] = cfd
-                if child.slot is not None:
-                    self.by_key[(child.slot, child.gen)] = cfd
-                    cvs.key = (child.slot, child.gen)
-                # peer identity: (virtual host id, port) off the
-                # handshake — servers keying state by accept() address
-                # see distinct simulated clients
-                self._rsp(cfd, src, sport)
+                self._rsp(-1, EAGAIN)
         elif op == OP_SENDTO:
             vs = self.vfds[a]
             if vs.sock is None:        # unbound UDP: ephemeral port
@@ -305,13 +392,17 @@ class ShimApp(HostedApp):
             self._rsp(b)
         elif op == OP_RECVFROM:
             vs = self.vfds[a]
-            if not vs.dgrams:
-                self._rsp(-1, EAGAIN)
-            else:
+            if vs.dgrams:
                 src, sport, nbytes = vs.dgrams.pop(0)
                 self._rsp(min(int(b), nbytes), src, sport)
+            elif int(c) & 1:             # blocking: park until a dgram
+                self.parked = ("recvfrom", a, int(b))
+            else:
+                self._rsp(-1, EAGAIN)
         elif op == OP_CONNECT:
             vs = self.vfds[a]
+            blk = (int(c) >> 16) & 1
+            c = int(c) & 0xFFFF
             if vs.kind == "udp":
                 # connected-UDP: record the default destination; no
                 # handshake, succeeds immediately
@@ -324,7 +415,10 @@ class ShimApp(HostedApp):
             else:
                 vs.sock = os.tcp_connect(int(b), int(c))
                 self.by_sock[id(vs.sock)] = a
-                self._rsp(-1, EINPROGRESS)  # completes via EPOLLOUT
+                if blk:                  # blocking connect: park until
+                    self.parked = ("connect", a)   # established
+                else:
+                    self._rsp(-1, EINPROGRESS)  # completes via EPOLLOUT
         elif op == OP_SEND:
             vs = self.vfds[a]
             if vs.kind == "udp":
@@ -342,17 +436,23 @@ class ShimApp(HostedApp):
                 self._rsp(b)
         elif op == OP_RECV:
             vs = self.vfds[a]
+            blk = int(c) & 1
             if vs.kind == "udp":         # recv() on a datagram socket
-                if not vs.dgrams:
-                    self._rsp(-1, EAGAIN)
-                else:
+                if vs.dgrams:
                     _src, _sp, nbytes = vs.dgrams.pop(0)
                     self._rsp(min(int(b), nbytes))
+                elif blk:
+                    self.parked = ("recvd", a, int(b))
+                else:
+                    self._rsp(-1, EAGAIN)
             else:
                 n = min(vs.avail, int(b))
                 vs.avail -= n
                 if n == 0 and not vs.eof:
-                    self._rsp(-1, EAGAIN)
+                    if blk:              # blocking read: park until
+                        self.parked = ("recv", a, int(b))  # data/EOF
+                    else:
+                        self._rsp(-1, EAGAIN)
                 else:
                     self._rsp(n)         # 0 = EOF
         elif op in (OP_CLOSE, OP_SHUTDOWN):
@@ -370,8 +470,7 @@ class ShimApp(HostedApp):
                     watch.pop(a, None)
             self._rsp(0)
         elif op == OP_EPOLL_CREATE:
-            vfd = self.next_fd
-            self.next_fd += 1
+            vfd = self._alloc_vfd()
             self.epolls[vfd] = {}
             self._rsp(vfd)
         elif op == OP_EPOLL_CTL:
@@ -391,14 +490,21 @@ class ShimApp(HostedApp):
             elif b == 0:
                 self._rsp(0)             # pure poll: never parks
             else:
-                self.parked = (a, maxev)  # block until a wake readies it
+                # block until a wake readies it
+                self.parked = ("epoll", a, maxev)
                 self.park_seq += 1
                 if b > 0:                # bounded wait: sim-time timer,
                     # tagged with this park's sequence so a stale timer
                     # from an earlier (already answered) wait cannot
-                    # cut a later one short
+                    # cut a later one short. The tag rides an i32
+                    # packet word, so the seq is masked to 7 bits
+                    # (sign bit must stay clear); a false match needs
+                    # a stale timer exactly 128 timed parks old AND
+                    # the same epfd AND the child parked — acceptable
+                    # odds vs. the wedge an unmatched timeout causes
                     os.timer(int(b) * 1_000_000,
-                             tag=(self.park_seq << 24) | (a & 0xFFFFFF))
+                             tag=((self.park_seq & 0x7F) << 24) |
+                                 (a & 0xFFFFFF))
         elif op == OP_CLOCK:
             self._rsp(os.now())
         elif op == OP_RESOLVE:
@@ -471,9 +577,9 @@ class ShimApp(HostedApp):
         # still parked in the SAME wait that armed this timer
         epfd = tag & 0xFFFFFF
         seq = tag >> 24
-        if (self.parked is not None and
-                (self.parked[0] & 0xFFFFFF) == epfd and
-                seq == self.park_seq):
+        if (self.parked is not None and self.parked[0] == "epoll" and
+                (self.parked[1] & 0xFFFFFF) == epfd and
+                seq == (self.park_seq & 0x7F)):
             self.parked = None
             self._rsp(0)
         self._service(os)

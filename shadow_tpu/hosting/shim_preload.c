@@ -16,21 +16,48 @@
  *
  * Payload note: the engine models byte counts, not contents; recv()
  * zero-fills the buffer and returns the simulated delivered count.
+ *
+ * Blocking semantics (round 4): each vfd tracks O_NONBLOCK (fcntl /
+ * SOCK_NONBLOCK at creation). Nonblocking fds keep the historical
+ * EINPROGRESS/EAGAIN returns; BLOCKING connect/recv/recvfrom/accept
+ * forward a block flag and the simulator parks the call until the
+ * matching wake (shim.py _maybe_unpark) — the analogue of the
+ * reference's rpth green-thread block/reenter (shd-process.c:
+ * 1076-1263), which is what lets stock blocking-socket binaries
+ * (e.g. a python interpreter running a plain socket script) run
+ * unmodified.
  */
 #define _GNU_SOURCE
 #include <dlfcn.h>
 #include <errno.h>
+#include <fcntl.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <stdint.h>
 #include <stdlib.h>
 #include <string.h>
 #include <sys/epoll.h>
+#include <sys/ioctl.h>
 #include <sys/socket.h>
 #include <time.h>
 #include <unistd.h>
 
 #define VFD_BASE (1 << 20)
+#define NB_CAP (1 << 16)
+
+/* per-vfd O_NONBLOCK bits (vfds are handed out sequentially from
+ * VFD_BASE by shim.py, so a small dense table suffices) */
+static unsigned char nb_flags[NB_CAP];
+
+static int vfd_nb(int fd) {
+    int i = fd - VFD_BASE;
+    return (i >= 0 && i < NB_CAP) ? nb_flags[i] : 0;
+}
+
+static void vfd_set_nb(int fd, int on) {
+    int i = fd - VFD_BASE;
+    if (i >= 0 && i < NB_CAP) nb_flags[i] = (unsigned char)(on != 0);
+}
 
 enum {
     OP_SOCKET = 1, OP_CONNECT, OP_SEND, OP_RECV, OP_CLOSE, OP_SHUTDOWN,
@@ -118,7 +145,9 @@ int socket(int domain, int type, int protocol) {
     if (!active() || domain != AF_INET)
         return real_socket(domain, type, protocol);
     int dgram = (type & 0xFF) == SOCK_DGRAM;
-    return (int)call(OP_SOCKET, dgram, 0, 0, NULL).r0;
+    int fd = (int)call(OP_SOCKET, dgram, 0, 0, NULL).r0;
+    if (fd >= 0) vfd_set_nb(fd, (type & SOCK_NONBLOCK) != 0);
+    return fd;
 }
 
 int bind(int fd, const struct sockaddr *addr, socklen_t len) {
@@ -151,9 +180,9 @@ int accept4(int fd, struct sockaddr *addr, socklen_t *len, int flags) {
         if (!real_accept4) real_accept4 = dlsym(RTLD_NEXT, "accept4");
         return real_accept4(fd, addr, len, flags);
     }
-    (void)flags;                       /* children are always virtual */
-    struct rsp r = call(OP_ACCEPT, fd, 0, 0, NULL);
+    struct rsp r = call(OP_ACCEPT, fd, vfd_nb(fd) ? 0 : 1, 0, NULL);
     if (r.r0 < 0) { errno = (int)r.r1; return -1; }
+    if (flags & SOCK_NONBLOCK) vfd_set_nb((int)r.r0, 1);
     if (addr && len && *len >= sizeof(struct sockaddr_in)) {
         struct sockaddr_in *a = (struct sockaddr_in *)addr;
         memset(a, 0, sizeof *a);
@@ -200,7 +229,8 @@ ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
         if (!real_recvfrom) real_recvfrom = dlsym(RTLD_NEXT, "recvfrom");
         return real_recvfrom(fd, buf, n, flags, addr, alen);
     }
-    struct rsp r = call(OP_RECVFROM, fd, (int64_t)n, 0, NULL);
+    int blk = !vfd_nb(fd) && !(flags & MSG_DONTWAIT);
+    struct rsp r = call(OP_RECVFROM, fd, (int64_t)n, blk, NULL);
     if (r.r0 < 0) { errno = (int)r.r1; return -1; }
     memset(buf, 0, (size_t)r.r0);      /* counts modeled, bytes not */
     if (addr && alen && *alen >= sizeof(struct sockaddr_in)) {
@@ -218,9 +248,12 @@ int connect(int fd, const struct sockaddr *addr, socklen_t len) {
     if (!active() || !is_vfd(fd)) return real_connect(fd, addr, len);
     const struct sockaddr_in *a = (const struct sockaddr_in *)addr;
     /* sin_addr carries the virtual host id verbatim (stamped by our
-     * getaddrinfo); sin_port is network order */
+     * getaddrinfo); sin_port is network order. Bit 16 of the port
+     * word = blocking call: park until established. */
+    int64_t port = ntohs(a->sin_port);
+    if (!vfd_nb(fd)) port |= (int64_t)1 << 16;
     struct rsp r = call(OP_CONNECT, fd, (int64_t)a->sin_addr.s_addr,
-                        ntohs(a->sin_port), NULL);
+                        port, NULL);
     if (r.r0 < 0) { errno = (int)r.r1; return -1; }
     return 0;
 }
@@ -233,7 +266,8 @@ ssize_t send(int fd, const void *buf, size_t n, int flags) {
 
 ssize_t recv(int fd, void *buf, size_t n, int flags) {
     if (!active() || !is_vfd(fd)) return real_recv(fd, buf, n, flags);
-    struct rsp r = call(OP_RECV, fd, (int64_t)n, 0, NULL);
+    int blk = !vfd_nb(fd) && !(flags & MSG_DONTWAIT);
+    struct rsp r = call(OP_RECV, fd, (int64_t)n, blk, NULL);
     if (r.r0 < 0) { errno = (int)r.r1; return -1; }
     memset(buf, 0, (size_t)r.r0);  /* counts are modeled, bytes are not */
     return (ssize_t)r.r0;
@@ -290,7 +324,16 @@ int epoll_wait(int epfd, struct epoll_event *evs, int maxevents,
         while (off < sizeof p) {
             ssize_t m = real_read(chan_fd, (char *)&p + off,
                                   sizeof p - off);
-            if (m <= 0) { errno = EPIPE; return i; }
+            if (m <= 0) {
+                /* short read of a trailing evpair: returning a partial
+                 * count would leave unread bytes in the channel and
+                 * the next call() would parse them as a rsp header —
+                 * a silent protocol desync. Kill the channel and fail
+                 * fast instead. */
+                chan_fd = -1;
+                errno = EPIPE;
+                return -1;
+            }
             off += (size_t)m;
         }
         evs[i].events = (uint32_t)p.events;
@@ -358,12 +401,37 @@ int getsockopt(int fd, int level, int optname, void *optval,
     return real_gso(fd, level, optname, optval, optlen);
 }
 
+int ioctl(int fd, unsigned long req, ...) {
+    __builtin_va_list ap;
+    __builtin_va_start(ap, req);
+    void *argp = __builtin_va_arg(ap, void *);
+    __builtin_va_end(ap);
+    if (active() && is_vfd(fd)) {
+        /* FIONBIO is how CPython's internal_setblocking toggles
+         * blocking mode on Linux — without this, s.setblocking(False)
+         * or any socket timeout in a hosted python script would hit
+         * the real kernel with a virtual fd (EBADF) */
+        if (req == FIONBIO && argp) {
+            vfd_set_nb(fd, *(int *)argp != 0);
+            return 0;
+        }
+        return 0;                       /* FIONREAD etc: accepted */
+    }
+    static int (*real_ioctl)(int, unsigned long, ...);
+    if (!real_ioctl) real_ioctl = dlsym(RTLD_NEXT, "ioctl");
+    return real_ioctl(fd, req, argp);
+}
+
 int fcntl(int fd, int cmd, ...) {
     __builtin_va_list ap;
     __builtin_va_start(ap, cmd);
     long arg = __builtin_va_arg(ap, long);
     __builtin_va_end(ap);
-    if (active() && is_vfd(fd)) return 0;   /* O_NONBLOCK etc: accepted */
+    if (active() && is_vfd(fd)) {
+        if (cmd == F_SETFL) { vfd_set_nb(fd, arg & O_NONBLOCK); return 0; }
+        if (cmd == F_GETFL) return vfd_nb(fd) ? O_NONBLOCK : 0;
+        return 0;                        /* F_SETFD etc: accepted */
+    }
     static int (*real_fcntl)(int, int, ...);
     if (!real_fcntl) real_fcntl = dlsym(RTLD_NEXT, "fcntl");
     return real_fcntl(fd, cmd, arg);

@@ -119,6 +119,77 @@ def test_hosted_tcp_put(simple_topology_xml):
     assert report.stats[0, defs.ST_BYTES_RECV] == 51200
 
 
+def test_hosted_plus_modeled_one_host(simple_topology_xml):
+    """The reference's canonical host shape (tor + tgen on ONE host,
+    shd-configuration.h:36-95): a hosted process sharing its host with
+    a modeled process. The hosted putter runs in process slot 1; its
+    sockets must wake IT (sk_proc routing through the op replay), while
+    the modeled pinger in slot 0 runs its own state machine."""
+    scen = Scenario(
+        stop_time=15 * 10**9,
+        topology_graphml=simple_topology_xml,
+        hosts=[
+            HostSpec(id="srv", processes=[
+                ProcessSpec(plugin="bulkserver", start_time=10**9,
+                            arguments="port=80"),
+                ProcessSpec(plugin="pingserver", start_time=10**9,
+                            arguments="port=8000")]),
+            HostSpec(id="cli", processes=[
+                ProcessSpec(plugin="ping", start_time=2 * 10**9,
+                            arguments="peer=srv port=8000 count=3 "
+                                      "interval=1s size=64"),
+                ProcessSpec(plugin="hosted:test-putter",
+                            start_time=3 * 10**9,
+                            arguments="peer=srv port=80 size=51200")]),
+        ],
+    )
+    sim = Simulation(scen, engine_cfg=EngineConfig(num_hosts=2,
+                                                   procs_per_host=2,
+                                                   **CFG))
+    assert sim.hosting.procs[1] == 1   # hosted app sits in slot 1
+    app = sim.hosting.apps[1]
+    report = sim.run()
+    # hosted TCP put completed and woke the hosted process (on_sent)
+    assert app.done == 1
+    assert report.stats[0, defs.ST_XFER_DONE] == 1
+    # the modeled pinger in slot 0 ran alongside: 3 echoed pings
+    assert report.stats[1, defs.ST_RTT_COUNT] == 3
+    # server got the put bytes plus the ping datagrams
+    assert report.stats[0, defs.ST_BYTES_RECV] >= 51200 + 3 * 64
+
+
+def test_hosted_under_mesh(simple_topology_xml):
+    """Hosted apps under mesh sharding: wake rings shard with the host
+    rows; results match the unsharded run bit-for-bit."""
+    from shadow_tpu.parallel.shard import make_mesh
+
+    def build():
+        scen = Scenario(
+            stop_time=10 * 10**9,
+            topology_graphml=simple_topology_xml,
+            hosts=[
+                HostSpec(id="srv", processes=[
+                    ProcessSpec(plugin="pingserver", start_time=10**9,
+                                arguments="port=8000")]),
+                HostSpec(id="cli", processes=[
+                    ProcessSpec(plugin="hosted:test-pinger",
+                                start_time=2 * 10**9,
+                                arguments="peer=srv port=8000 count=4 "
+                                          "interval_s=1 size=64")]),
+            ],
+        )
+        return Simulation(scen,
+                          engine_cfg=EngineConfig(num_hosts=2, **CFG))
+
+    ref = build().run()
+
+    sim = build()
+    app = sim.hosting.apps[1]
+    rep = sim.run(mesh=make_mesh(2))
+    assert app.sent == 4 and app.echoed == 4
+    assert np.array_equal(rep.stats, ref.stats)
+
+
 def test_hosted_deterministic(simple_topology_xml):
     def go():
         scen = Scenario(
