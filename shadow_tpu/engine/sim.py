@@ -554,13 +554,12 @@ class Simulation:
             if self.hosting:
                 raise NotImplementedError(
                     "hosted apps + multi-process mesh not supported")
-            if pcap_dir is not None:
-                raise NotImplementedError(
-                    "pcap capture + multi-process mesh not supported")
-            # checkpoint/resume IS supported on a multi-process mesh:
-            # saves allgather the sharded state and process 0 writes
-            # ONE global snapshot; every process must be able to read
-            # the snapshot path on resume (shared storage)
+            # checkpoint/resume and pcap ARE supported on a
+            # multi-process mesh: both allgather the relevant state
+            # and process 0 writes the files (pcap rings are a debug
+            # path — the per-chunk DCN hop is the documented price);
+            # every process must be able to read the snapshot path on
+            # resume (shared storage)
 
         tracker = None
         if heartbeat_s:
@@ -569,7 +568,10 @@ class Simulation:
                               logger)
 
         pcap = None
-        if self.cfg.tracecap and pcap_dir is not None:
+        pcap_on_run = bool(self.cfg.tracecap) and pcap_dir is not None
+        if pcap_on_run and (not multiproc or jax.process_index() == 0):
+            # under a multi-process mesh only process 0 writes files;
+            # the drain below allgathers the rings to it
             from ..obs.pcap import PcapWriter
             traced = np.flatnonzero(np.asarray(self.hp.pcap_on))
             pcap = PcapWriter(pcap_dir, self.host_names,
@@ -654,6 +656,8 @@ class Simulation:
         ckpt_at = int(wstart) + next_ckpt if next_ckpt else None
         wall0 = _time.perf_counter()
         first_chunk_wall = None
+        # jitted once, called per chunk (multiproc pcap ring reset)
+        _zeros_like = jax.jit(jnp.zeros_like)
         while True:
             hosts, wstart, wend, n, pc = step(hosts, wstart, wend)
             total_windows += int(n)
@@ -685,10 +689,22 @@ class Simulation:
                 wstart = nt
                 wend = jnp.where(nt == SIMTIME_MAX, nt, nt + sh.min_jump)
                 ws = int(wstart)
-            if pcap is not None:
-                pcap.drain(hosts.tr_time, hosts.tr_pkt, hosts.tr_cnt)
-                hosts = hosts.replace(
-                    tr_cnt=jnp.zeros_like(hosts.tr_cnt))
+            if pcap_on_run:
+                # every process participates in the gather (it is a
+                # collective); only process 0 holds a writer
+                tr_t = dist.gather_stats(hosts.tr_time)
+                tr_p = dist.gather_stats(hosts.tr_pkt)
+                tr_c = dist.gather_stats(hosts.tr_cnt)
+                if pcap is not None:
+                    pcap.drain(tr_t, tr_p, tr_c)
+                if multiproc:
+                    # jitted creation: uniform on all processes, keeps
+                    # the sharded placement (the eager-t0 pattern above)
+                    hosts = hosts.replace(
+                        tr_cnt=_zeros_like(hosts.tr_cnt))
+                else:
+                    hosts = hosts.replace(
+                        tr_cnt=jnp.zeros_like(hosts.tr_cnt))
             if tracker is not None and tracker.due(min(ws,
                                                        int(sh.stop_time))):
                 from ..obs.tracker import socket_columns

@@ -21,6 +21,7 @@ def main():
     rest = sys.argv[5:]
     ckpt = rest[rest.index("--ckpt") + 1] if "--ckpt" in rest else None
     resume = "--resume" in rest
+    pcap = rest[rest.index("--pcap") + 1] if "--pcap" in rest else None
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -38,7 +39,7 @@ def main():
     from shadow_tpu.engine.sim import Simulation
     from scenario_phold import make_scenario, make_cfg
 
-    scen = make_scenario()
+    scen = make_scenario(pcap=bool(pcap))
     cfg = make_cfg()
     mesh = dist.global_mesh()
     assert len(mesh.devices.flat) == 2 * int(nproc)
@@ -47,6 +48,8 @@ def main():
         kw = dict(resume_from=ckpt)
     elif ckpt:
         kw = dict(checkpoint_path=ckpt, checkpoint_every_s=1.0)
+    if pcap:
+        kw["pcap_dir"] = pcap
     r = Simulation(scen, engine_cfg=cfg).run(mesh=mesh, **kw)
     if int(pid) == 0:
         np.save(out, r.stats)

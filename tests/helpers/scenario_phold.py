@@ -18,13 +18,14 @@ TOPO = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
 N_HOSTS = 4
 
 
-def make_scenario():
+def make_scenario(pcap=False):
     from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario
 
     return Scenario(
         stop_time=3 * 10**9,
         topology_graphml=TOPO,
-        hosts=[HostSpec(id="node", quantity=N_HOSTS, processes=[
+        hosts=[HostSpec(id="node", quantity=N_HOSTS, pcap=pcap,
+                        processes=[
             ProcessSpec(plugin="phold", start_time=10**9,
                         arguments="port=9000 mean=200ms size=64 init=1")])],
     )

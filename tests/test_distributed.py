@@ -82,6 +82,36 @@ def _spawn_workers(out, extra, tag):
             f"{tag} proc {pid} failed:\n{text[-3000:]}")
 
 
+def test_multiprocess_pcap_matches_single(tmp_path):
+    """pcap under the multi-process mesh (round 4 — the last
+    stats-only gate on the DCN tier): the rings allgather per chunk
+    and process 0 writes the files; captures must equal the
+    single-process run's byte for byte."""
+    sys.path.insert(0, str(HELPERS))
+    try:
+        from scenario_phold import make_scenario, make_cfg
+    finally:
+        sys.path.pop(0)
+    from shadow_tpu.engine.sim import Simulation
+
+    single_dir = tmp_path / "pcap_single"
+    truth = Simulation(make_scenario(pcap=True),
+                       engine_cfg=make_cfg()).run(
+        pcap_dir=str(single_dir))
+    ref_files = sorted(os.listdir(single_dir))
+    assert ref_files, "single-process run captured nothing"
+
+    multi_dir = tmp_path / "pcap_multi"
+    out = tmp_path / "stats.npy"
+    _spawn_workers(out, ["--pcap", str(multi_dir)], "pcap")
+    assert np.array_equal(np.load(out), truth.stats)
+    assert sorted(os.listdir(multi_dir)) == ref_files
+    for name in ref_files:
+        a = (single_dir / name).read_bytes()
+        b = (multi_dir / name).read_bytes()
+        assert a == b, f"{name} diverges between single and DCN runs"
+
+
 def test_multiprocess_checkpoint_resume(tmp_path):
     """DCN-tier checkpoint/resume (round 3): a 2-process mesh
     checkpoints mid-run (process 0 writes ONE global snapshot), a

@@ -183,6 +183,21 @@ def build_bulk_1k(n_hosts=1000, stop=60):
     )
 
 
+def apply_runahead(sim, runahead_ms):
+    """Override the lookahead window — exactly the reference's
+    --runahead knob (shd-options.c; its no-topology fallback window is
+    this same 10ms, shd-master.c:123). plab's 1ms minimum edge
+    otherwise forces 60k windows per simulated minute; paths shorter
+    than the override see coarser delivery granularity, like the
+    reference under the same setting. The ONE definition all
+    measurement entry points share (bench.py and run_config) so they
+    cannot measure different protocols."""
+    if runahead_ms:
+        import jax.numpy as jnp
+        sim.sh = sim.sh.replace(min_jump=jnp.int64(runahead_ms * 10**6))
+    return sim
+
+
 CONFIGS = {
     # name: (builder, caps, default n). No active_block anywhere: the
     # engine's automatic rung ladder (EngineConfig.active_block = -1,
@@ -203,14 +218,15 @@ CONFIGS = {
 
 
 def run_config(name, n=None, stop=60, heartbeat=0.0, verbose=False,
-               runahead_ms=0, chunk=0, active_block=None):
+               runahead_ms=0, chunk=0, active_block=None,
+               event_batch=None):
     from shadow_tpu.engine.sim import Simulation
 
     builder, capf, n_default = CONFIGS[name]
     n = n or n_default
     scen = builder(n, stop)
     cfg = capf(n)
-    if chunk or active_block is not None:
+    if chunk or active_block is not None or event_batch is not None:
         # a wider runahead packs ~runahead/min-latency more event
         # passes into each window — keep one device dispatch (a chunk)
         # short or the axon worker aborts long-running calls
@@ -220,18 +236,10 @@ def run_config(name, n=None, stop=60, heartbeat=0.0, verbose=False,
             kw["chunk_windows"] = chunk
         if active_block is not None:
             kw["active_block"] = active_block
+        if event_batch is not None:
+            kw["event_batch"] = event_batch
         cfg = dataclasses.replace(cfg, **kw)
-    sim = Simulation(scen, engine_cfg=cfg)
-    if runahead_ms:
-        # lookahead override, exactly the reference's --runahead knob
-        # (shd-options.c; its no-topology fallback window is this same
-        # 10ms, shd-master.c:123). plab's 1ms minimum edge otherwise
-        # forces 60k windows per simulated minute; paths shorter than
-        # the override see coarser delivery granularity, like the
-        # reference under the same setting.
-        import jax.numpy as jnp
-        sim.sh = sim.sh.replace(
-            min_jump=jnp.int64(runahead_ms * 10**6))
+    sim = apply_runahead(Simulation(scen, engine_cfg=cfg), runahead_ms)
     report = sim.run(heartbeat_s=heartbeat, verbose=verbose)
     s = report.summary()
     from shadow_tpu.engine import defs
@@ -273,6 +281,10 @@ def main(argv):
     ap.add_argument("--active-block", type=int, default=None,
                     help="active-set compaction block override "
                          "(0 = dense)")
+    ap.add_argument("--event-batch", type=int, default=None,
+                    help="events drained per gathered host per sparse "
+                         "pass (A/B the pass-count batching; 1 = "
+                         "one event per pass)")
     args = ap.parse_args(argv)
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -293,7 +305,8 @@ def main(argv):
             pass
     out = run_config(args.config, n=args.n, stop=args.stop,
                      verbose=args.verbose, runahead_ms=args.runahead_ms,
-                     chunk=args.chunk, active_block=args.active_block)
+                     chunk=args.chunk, active_block=args.active_block,
+                     event_batch=args.event_batch)
     if args.runahead_ms:
         out["runahead_ms"] = args.runahead_ms
     print(json.dumps(out))
