@@ -99,13 +99,23 @@ def _parse_plane(buf):
                     if fn3 == 2 and wt3 == 2:  # XEventMetadata.name
                         mname = bytes(v3).decode("utf-8", "replace")
                 meta[k] = mname
-    durs = collections.Counter()
-    counts = collections.Counter()
+    # Aggregate PER LINE: device traces nest container ops (module,
+    # while, conditional) on separate lines above the leaf-op line, so
+    # a single merged counter double-counts bodies inside containers
+    # and conds "cost" their whole branch. Per-line tops let the
+    # reader see both views: containers (where the window time sits
+    # structurally) and leaves (which HLOs actually burn it).
+    per_line = []                            # (line_name, durs, counts)
     for lbuf in lines:
+        lname = ""
+        durs = collections.Counter()
+        counts = collections.Counter()
         for fn, wt, v in _fields(lbuf):
+            if fn == 2 and wt == 2:          # XLine.name
+                lname = bytes(v).decode("utf-8", "replace")
             # this build writes XLine.events at field 4 (older schema
             # revisions used 6 — accept both)
-            if fn in (4, 6) and wt == 2:     # XLine.events
+            elif fn in (4, 6) and wt == 2:   # XLine.events
                 mid, dur = None, 0
                 for fn2, wt2, v2 in _fields(v):
                     if fn2 == 1:             # XEvent.metadata_id
@@ -116,7 +126,9 @@ def _parse_plane(buf):
                     key = meta.get(mid, f"#{mid}")
                     durs[key] += dur
                     counts[key] += 1
-    return name, dict(durs), dict(counts)
+        if durs:
+            per_line.append((lname, dict(durs), dict(counts)))
+    return name, per_line
 
 
 def aggregate(trace_dir, top=40):
@@ -124,19 +136,21 @@ def aggregate(trace_dir, top=40):
     for path in sorted(glob.glob(
             os.path.join(trace_dir, "**", "*.xplane.pb"),
             recursive=True)):
-        for name, durs, counts in parse_xspace(path):
-            if not durs:
-                continue
-            total = sum(durs.values())
-            ops = sorted(durs.items(), key=lambda kv: -kv[1])[:top]
-            out.append({
-                "plane": name,
-                "total_ms": round(total / 1e9, 3),
-                "ops": [{"op": k, "ms": round(v / 1e9, 3),
-                         "n": counts[k],
-                         "pct": round(100 * v / total, 1)}
-                        for k, v in ops],
-            })
+        for name, per_line in parse_xspace(path):
+            for lname, durs, counts in per_line:
+                total = sum(durs.values())
+                if not total:
+                    continue
+                ops = sorted(durs.items(), key=lambda kv: -kv[1])[:top]
+                out.append({
+                    "plane": name,
+                    "line": lname,
+                    "total_ms": round(total / 1e9, 3),
+                    "ops": [{"op": k, "ms": round(v / 1e9, 3),
+                             "n": counts[k],
+                             "pct": round(100 * v / total, 1)}
+                            for k, v in ops],
+                })
     return out
 
 
