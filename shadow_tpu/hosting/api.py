@@ -186,6 +186,71 @@ class HostOS:
         self._socks[(sb.slot, sb.gen)] = sb
 
 
+class PayloadBroker:
+    """Host-side per-connection byte streams for hosted apps.
+
+    The engine models byte COUNTS (a DES does not move payloads across
+    the device); when BOTH endpoints of a TCP connection are hosted
+    processes in this simulator, the real bytes can ride host-side: the
+    sender's app appends what it wrote, the receiver pops exactly the
+    count the engine delivered. Delivered counts are in-order stream
+    advances bounded by what was sent, so a FIFO per (connection,
+    direction) reproduces the exact bytes a real network would have
+    delivered. Streams a hosted endpoint writes toward a MODELED peer
+    have no reader; they are capped (and dropped on overflow) so a
+    long run cannot accumulate unbounded buffers — readers of such
+    connections see zero-fill, same as before payloads existed.
+
+    Keys: (cli_host, cli_port, srv_host, srv_port, direction) with
+    direction 0 = client->server, 1 = server->client. Both endpoints
+    derive the same tuple — the server from its accept wake's peer
+    identity, the client from its connected wake's local port (the
+    SYN|ACK's DPORT). A 4-tuple reused by a LATER connection (ephemeral
+    wrap + TIME_WAIT recycling) could alias a stream whose endpoints
+    never closed; closes drop each side's stream so this needs both
+    processes to leak the socket — accepted and documented here.
+    """
+
+    CAP = 64 << 20  # per-stream in-flight bound (hosted->modeled case)
+
+    def __init__(self):
+        self._streams: dict = {}   # key -> bytearray (None = overflowed)
+
+    def open(self, key):
+        """Idempotent create: both endpoints open both directions at
+        connection establishment, so a writer's first push always finds
+        the stream (the accept wake precedes the connected wake in sim
+        time; create-only keeps the later open from clearing bytes the
+        earlier side already pushed)."""
+        self._streams.setdefault(key, bytearray())
+
+    def push(self, key, data: bytes):
+        buf = self._streams.get(key)
+        if buf is None:
+            return                      # no stream (modeled peer never
+        #                                 opened it) or overflowed
+        if len(buf) + len(data) > self.CAP:
+            self._streams[key] = None   # cap blown: a reader-less
+            #   hosted->modeled stream; stop buffering, readers (none)
+            #   would see zero-fill
+            return
+        buf += data
+
+    def pop(self, key, n: int) -> bytes:
+        """Exactly n bytes: the stream's front, zero-padded when the
+        stream is short/absent (peer modeled, or overflowed)."""
+        buf = self._streams.get(key)
+        if not buf:
+            return b"\0" * n
+        k = min(n, len(buf))
+        out = bytes(buf[:k])
+        del buf[:k]
+        return out + b"\0" * (n - k)
+
+    def drop(self, key):
+        self._streams.pop(key, None)
+
+
 class HostedApp:
     """Base class for hosted applications. Override the callbacks you
     need; each receives the HostOS handle first."""
@@ -196,7 +261,12 @@ class HostedApp:
     def on_timer(self, os: HostOS, tag: int):
         pass
 
-    def on_connected(self, os: HostOS, sock: Sock):
+    def on_connected(self, os: HostOS, sock: Sock, lport: int = 0,
+                     peer: tuple = (0, 0)):
+        """`lport` is the connection's local (ephemeral) port and
+        `peer` = (virtual host id, port) of the server — both off the
+        SYN|ACK that completed the handshake, mirroring on_accept's
+        identity args on the passive side."""
         pass
 
     def on_accept(self, os: HostOS, sock: Sock, tag: int, dport: int = 0,

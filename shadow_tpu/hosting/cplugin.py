@@ -215,7 +215,7 @@ class CPluginApp(HostedApp):
         self._wake(os, 2, a=self._handle_of_slot(sock), b=src,
                    c=(aux << 32) | (nbytes & 0xFFFFFFFF))
 
-    def on_connected(self, os, sock):
+    def on_connected(self, os, sock, **_identity):
         self._wake(os, 3, a=self._handle_of_slot(sock))
 
     def on_eof(self, os, sock):

@@ -31,6 +31,15 @@ class HostingRuntime:
         self.procs = procs or {}
         self.batch_cap = batch_cap
         self._now = 0
+        # one per-simulation payload broker (api.PayloadBroker): hosted
+        # apps that move REAL bytes (the LD_PRELOAD shim) share it so
+        # hosted<->hosted TCP connections deliver actual payloads
+        from .api import PayloadBroker
+        self.payloads = PayloadBroker()
+        for app in apps.values():
+            attach = getattr(app, "attach_payload_broker", None)
+            if attach is not None:
+                attach(self.payloads)
         self.os = {
             hid: HostOS(hid, names.get(hid, f"host{hid}"),
                         np.random.default_rng((seed, hid)), dns,
@@ -82,7 +91,12 @@ class HostingRuntime:
             elif reason == WAKE_TIMER:
                 app.on_timer(os, int(wake[P.AUX]))
             elif reason == WAKE_CONNECTED:
-                app.on_connected(os, sock)
+                # the connected wake rides the SYN|ACK: SRC/SPORT are
+                # the server's identity, DPORT our local ephemeral port
+                app.on_connected(os, sock,
+                                 lport=int(wake[P.DPORT]),
+                                 peer=(int(wake[P.SRC]),
+                                       int(wake[P.SPORT])))
             elif reason == WAKE_ACCEPT:
                 # the accept wake rides the SYN packet: SRC/SPORT are
                 # the connecting client's identity, DPORT the listener

@@ -17,10 +17,17 @@ network clients:
   wake (connection established, bytes delivered, EOF) maps to a
   registered epoll interest — so simulated time never advances while
   app code runs, exactly the reference's cooperative model;
-- payload bytes are not materialized (the engine models byte counts);
-  ``recv`` returns the delivered COUNT and the shim hands the app a
-  zero-filled buffer. Clients that parse payloads need the modeled-app
-  tier; clients that move/measure bytes (tgen-style) run unmodified.
+- TCP payload bytes are MATERIALIZED host-side (round 4): the engine
+  models byte counts and timing, while the real bytes ride the control
+  channel into a per-connection FIFO (api.PayloadBroker) keyed by the
+  TCP 4-tuple both endpoints derive from their establishment wakes.
+  Delivered counts are in-order stream advances bounded by what was
+  sent, so popping the FIFO reproduces exactly the bytes a real
+  network would deliver — payload-parsing binaries (HTTP-style
+  request/response) run unmodified when both endpoints are hosted.
+  A hosted endpoint talking to a MODELED app still sees zero-fill
+  (modeled apps have no real bytes), and UDP datagram payloads are
+  not materialized.
 
 Scenario usage: plugin="hosted:shim" with arguments
 ``[out=<stdout file>] cmd=<binary> [child args...]`` — cmd paths
@@ -34,6 +41,9 @@ single-threaded between epoll_waits):
   response = <qqq>      r0, r1, r2         (24 bytes)
   OP_EPOLL_WAIT responses with r0 = n > 0 carry n trailing <qq>
   (fd, events) pairs — multi-event waits honoring maxevents.
+  OP_SEND/OP_SENDTO requests carry b trailing payload bytes (the
+  app's real buffer); successful OP_RECV/OP_RECVFROM responses carry
+  r0 trailing payload bytes (stream contents or zero-fill).
 
 Round 3: the full SERVER path (bind/listen/accept) and UDP
 (sendto/recvfrom) — an unmodified epoll server binary accepts
@@ -129,7 +139,8 @@ class _VSock:
     """Shim-side view of one virtual socket fd."""
 
     __slots__ = ("sock", "avail", "eof", "connected", "closed", "key",
-                 "kind", "bound_port", "accept_q", "dgrams", "dgram_dst")
+                 "kind", "bound_port", "accept_q", "dgrams", "dgram_dst",
+                 "conn", "is_client", "pending_tx")
 
     def __init__(self, kind="tcp"):
         self.sock = None        # hosting.api.Sock once connect issued
@@ -140,9 +151,15 @@ class _VSock:
         self.key = None         # (slot, gen) once resolved
         self.kind = kind        # "tcp" | "udp" | "listen"
         self.bound_port = 0
-        self.accept_q = []      # listener: (child Sock, src, sport)
+        self.accept_q = []      # listener: (child Sock, src, sport, conn)
         self.dgrams = []        # udp: (src_host, sport, nbytes)
         self.dgram_dst = None   # udp: connect()ed default destination
+        # TCP payload stream identity (api.PayloadBroker): the
+        # canonical (cli_host, cli_port, srv_host, srv_port) both
+        # endpoints derive, or None until the connection resolves
+        self.conn = None
+        self.is_client = False
+        self.pending_tx = []    # payloads written before conn resolved
 
 
 class ShimApp(HostedApp):
@@ -179,6 +196,49 @@ class ShimApp(HostedApp):
         self.parked = None
         self.park_seq = 0         # increments per park: stale-timeout guard
         self.exited = False
+        self._payloads = None     # api.PayloadBroker (runtime attaches)
+        self._opened = set()      # broker keys this app opened
+
+    def attach_payload_broker(self, broker):
+        """HostingRuntime wires the per-simulation PayloadBroker in:
+        hosted<->hosted TCP connections then carry REAL bytes (counts
+        still modeled by the engine; hosted<->modeled stays zero-fill)."""
+        self._payloads = broker
+
+    # --- payload streams (api.PayloadBroker) ---
+    def _open_streams(self, vs):
+        """Open both directions at establishment (writer-side open
+        included: the accept wake precedes the connected wake in sim
+        time, so a server's first push must not find a missing
+        stream), then flush sends issued before the identity resolved."""
+        if self._payloads is None or vs.conn is None:
+            return
+        for d in (0, 1):
+            key = vs.conn + (d,)
+            self._payloads.open(key)
+            self._opened.add(key)
+        if vs.pending_tx:
+            out = vs.conn + (0 if vs.is_client else 1,)
+            for data in vs.pending_tx:
+                self._payloads.push(out, data)
+            vs.pending_tx = []
+
+    def _tx_payload(self, vs, data):
+        if (self._payloads is None or vs.kind != "tcp" or not data):
+            return
+        if vs.conn is None:            # optimistic pre-connect write
+            vs.pending_tx.append(data)
+            return
+        self._payloads.push(vs.conn + (0 if vs.is_client else 1,), data)
+
+    def _rx_payload(self, vs, k):
+        """Exactly k bytes for a recv answer: real stream bytes when
+        the peer is hosted, zero-fill otherwise."""
+        if (self._payloads is None or vs is None or vs.conn is None
+                or vs.kind != "tcp"):
+            return b""                 # _rsp_data zero-pads
+        return self._payloads.pop(vs.conn + (1 if vs.is_client else 0,),
+                                  int(k))
 
     # --- child lifecycle ---
     def _spawn(self):
@@ -205,8 +265,27 @@ class ShimApp(HostedApp):
             buf += chunk
         return REQ.unpack(buf)
 
+    def _read_n(self, n):
+        """n trailing payload bytes of an OP_SEND/OP_SENDTO request."""
+        buf = b""
+        n = int(n)
+        while len(buf) < n:
+            chunk = self.chan.recv(min(n - len(buf), 1 << 20))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
     def _rsp(self, r0=0, r1=0, r2=0):
         self.chan.sendall(RSP.pack(int(r0), int(r1), int(r2)))
+
+    def _rsp_data(self, k, data=b"", r1=0, r2=0):
+        """recv-style answer: header then EXACTLY k payload bytes (the
+        C side reads k unconditionally on success; zero-padded when no
+        real payload stream backs the connection)."""
+        k = max(int(k), 0)
+        out = data[:k] + b"\0" * (k - len(data))
+        self.chan.sendall(RSP.pack(k, int(r1), int(r2)) + out)
 
     # --- epoll readiness ---
     def _events_of(self, vfd):
@@ -264,11 +343,14 @@ class ShimApp(HostedApp):
     def _rsp_accept(self, vs):
         """Pop one pending child off a listener and answer the accept
         call (shared by the immediate and parked paths)."""
-        child, src, sport = vs.accept_q.pop(0)
+        child, src, sport, conn = vs.accept_q.pop(0)
         cfd = self._alloc_vfd()
         cvs = _VSock(kind="tcp")
         cvs.sock = child
         cvs.connected = True
+        cvs.conn = conn
+        cvs.is_client = False
+        self._open_streams(cvs)
         self.vfds[cfd] = cvs
         self.by_sock[id(child)] = cfd
         if child.slot is not None:
@@ -310,13 +392,13 @@ class ShimApp(HostedApp):
             vs = self.vfds.get(vfd)
             if vs is None:
                 self.parked = None
-                self._rsp(0)
+                self._rsp_data(0)
                 return True
             if vs.avail > 0 or vs.eof:
                 k = min(vs.avail, n)
                 vs.avail -= k
                 self.parked = None
-                self._rsp(k)             # 0 = EOF
+                self._rsp_data(k, self._rx_payload(vs, k))  # 0 = EOF
                 return True
             return False
         if kind in ("recvd", "recvfrom"):
@@ -327,9 +409,9 @@ class ShimApp(HostedApp):
             src, sport, nbytes = vs.dgrams.pop(0)
             self.parked = None
             if kind == "recvfrom":
-                self._rsp(min(n, nbytes), src, sport)
+                self._rsp_data(min(n, nbytes), b"", src, sport)
             else:
-                self._rsp(min(n, nbytes))
+                self._rsp_data(min(n, nbytes))
             return True
         if kind == "accept":
             vfd = self.parked[1]
@@ -356,6 +438,14 @@ class ShimApp(HostedApp):
             self._handle(os, *req)
 
     def _handle(self, os, op, a, b, c, name):
+        if op in (OP_SEND, OP_SENDTO):
+            # the request carries the app's REAL payload bytes (b = n);
+            # consume them before anything else so the channel stays
+            # framed even on error answers
+            payload = self._read_n(b)
+            if payload is None:
+                self.exited = True
+                return
         if op == OP_SOCKET:
             vfd = self._alloc_vfd()
             self.vfds[vfd] = _VSock(kind="udp" if a else "tcp")
@@ -394,7 +484,7 @@ class ShimApp(HostedApp):
             vs = self.vfds[a]
             if vs.dgrams:
                 src, sport, nbytes = vs.dgrams.pop(0)
-                self._rsp(min(int(b), nbytes), src, sport)
+                self._rsp_data(min(int(b), nbytes), b"", src, sport)
             elif int(c) & 1:             # blocking: park until a dgram
                 self.parked = ("recvfrom", a, int(b))
             else:
@@ -432,6 +522,7 @@ class ShimApp(HostedApp):
                     os.sendto(vs.sock, dst, port, int(b))
                     self._rsp(b)
             else:
+                self._tx_payload(vs, payload)
                 os.write(vs.sock, int(b))
                 self._rsp(b)
         elif op == OP_RECV:
@@ -440,7 +531,7 @@ class ShimApp(HostedApp):
             if vs.kind == "udp":         # recv() on a datagram socket
                 if vs.dgrams:
                     _src, _sp, nbytes = vs.dgrams.pop(0)
-                    self._rsp(min(int(b), nbytes))
+                    self._rsp_data(min(int(b), nbytes))
                 elif blk:
                     self.parked = ("recvd", a, int(b))
                 else:
@@ -454,7 +545,7 @@ class ShimApp(HostedApp):
                     else:
                         self._rsp(-1, EAGAIN)
                 else:
-                    self._rsp(n)         # 0 = EOF
+                    self._rsp_data(n, self._rx_payload(vs, n))  # 0 = EOF
         elif op in (OP_CLOSE, OP_SHUTDOWN):
             vs = self.vfds.get(a)
             if vs is not None and vs.sock is not None and not vs.closed:
@@ -466,6 +557,13 @@ class ShimApp(HostedApp):
                     self.by_key.pop(gone.key, None)
                 if gone is not None:
                     self.by_sock.pop(id(gone.sock), None)
+                    if (gone.conn is not None and
+                            self._payloads is not None):
+                        # I was the reader of my in-direction; the peer
+                        # drops the other one at its own close
+                        key = gone.conn + (1 if gone.is_client else 0,)
+                        self._payloads.drop(key)
+                        self._opened.discard(key)
                 for watch in self.epolls.values():
                     watch.pop(a, None)
             self._rsp(0)
@@ -533,10 +631,17 @@ class ShimApp(HostedApp):
             vs.key = (sock.slot, sock.gen)
         return vfd, vs
 
-    def on_connected(self, os, sock):
+    def on_connected(self, os, sock, lport=0, peer=(0, 0)):
         _, vs = self._vs_of(sock)
         if vs is not None:
             vs.connected = True
+            if vs.conn is None and lport:
+                # payload stream identity off the SYN|ACK: we are the
+                # client side of (cli_host, cli_port, srv_host, srv_port)
+                vs.conn = (os.host_id, int(lport),
+                           int(peer[0]), int(peer[1]))
+                vs.is_client = True
+                self._open_streams(vs)
         self._service(os)
 
     def on_accept(self, os, sock, tag, dport=0, peer=(0, 0)):
@@ -550,7 +655,9 @@ class ShimApp(HostedApp):
                     if vs.bound_port == dport:
                         break
         if target is not None:
-            target.accept_q.append((sock, peer[0], peer[1]))
+            conn = (int(peer[0]), int(peer[1]), os.host_id,
+                    int(dport) or target.bound_port)
+            target.accept_q.append((sock, peer[0], peer[1], conn))
         self._service(os)
 
     def on_dgram(self, os, sock, src, sport, nbytes, aux):
@@ -600,6 +707,12 @@ class ShimApp(HostedApp):
             except Exception:
                 self.proc.kill()
         self.exited = True
+        if self._payloads is not None:
+            # sweep every stream this app opened: a killed child leaves
+            # its sockets unclosed and the broker must not leak them
+            for key in self._opened:
+                self._payloads.drop(key)
+            self._opened.clear()
 
 
 register("shim", ShimApp)

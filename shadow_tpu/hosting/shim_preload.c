@@ -14,8 +14,14 @@
  * libc via dlsym(RTLD_NEXT) — same split as the reference's
  * shadow-fd vs OS-fd descriptor tables (shd-host.c fd mapping).
  *
- * Payload note: the engine models byte counts, not contents; recv()
- * zero-fills the buffer and returns the simulated delivered count.
+ * Payload note (round 4): the engine still models byte COUNTS, but
+ * real payload bytes now ride the control channel host-side: send()
+ * ships the app's buffer to the simulator, which stores it per
+ * connection (api.PayloadBroker) and returns the true stream contents
+ * with each recv() when BOTH endpoints are hosted processes —
+ * payload-parsing binaries (HTTP-style request/response) run
+ * unmodified. recv() from a MODELED peer still zero-fills; UDP
+ * datagram payloads are not materialized.
  *
  * Blocking semantics (round 4): each vfd tracks O_NONBLOCK (fcntl /
  * SOCK_NONBLOCK at creation). Nonblocking fds keep the historical
@@ -114,9 +120,19 @@ static int active(void) {
     return chan_fd >= 0;
 }
 
-/* one lockstep request/response on the control channel */
-static struct rsp call(int32_t op, int32_t a, int64_t b, int64_t c,
-                       const char *name) {
+/* one lockstep request/response on the control channel.
+ *
+ * Payload framing (round 4): OP_SEND/OP_SENDTO requests are followed
+ * by exactly b payload bytes (the app's REAL buffer — the simulator
+ * stores them so hosted<->hosted connections deliver true contents);
+ * successful OP_RECV/OP_RECVFROM responses are followed by exactly r0
+ * payload bytes (real stream bytes, or zeros when the peer is a
+ * modeled app). tx/txn attach request payload; rx/rxcap receive
+ * response payload. A short read/write kills the channel (EPIPE)
+ * rather than desynchronize the framing. */
+static struct rsp call2(int32_t op, int32_t a, int64_t b, int64_t c,
+                        const char *name, const void *tx, size_t txn,
+                        void *rx, size_t rxcap) {
     struct req q;
     struct rsp r = {-1, 0, 0};
     memset(&q, 0, sizeof q);
@@ -125,16 +141,47 @@ static struct rsp call(int32_t op, int32_t a, int64_t b, int64_t c,
     size_t off = 0;
     while (off < sizeof q) {
         ssize_t n = real_write(chan_fd, (char *)&q + off, sizeof q - off);
-        if (n <= 0) { errno = EPIPE; return r; }
+        if (n <= 0) { chan_fd = -1; errno = EPIPE; return r; }
+        off += (size_t)n;
+    }
+    off = 0;
+    while (off < txn) {
+        ssize_t n = real_write(chan_fd, (const char *)tx + off, txn - off);
+        if (n <= 0) { chan_fd = -1; errno = EPIPE; return r; }
         off += (size_t)n;
     }
     off = 0;
     while (off < sizeof r) {
         ssize_t n = real_read(chan_fd, (char *)&r + off, sizeof r - off);
-        if (n <= 0) { errno = EPIPE; struct rsp bad = {-1, 0, 0}; return bad; }
+        if (n <= 0) {
+            chan_fd = -1; errno = EPIPE;
+            struct rsp bad = {-1, 0, 0}; return bad;
+        }
         off += (size_t)n;
     }
+    if (rx && r.r0 > 0) {
+        if ((size_t)r.r0 > rxcap) {   /* protocol violation: the sim
+            * side answered more than we asked — unrecoverable framing */
+            chan_fd = -1; errno = EPIPE;
+            struct rsp bad = {-1, 0, 0}; return bad;
+        }
+        off = 0;
+        while (off < (size_t)r.r0) {
+            ssize_t n = real_read(chan_fd, (char *)rx + off,
+                                  (size_t)r.r0 - off);
+            if (n <= 0) {
+                chan_fd = -1; errno = EPIPE;
+                struct rsp bad = {-1, 0, 0}; return bad;
+            }
+            off += (size_t)n;
+        }
+    }
     return r;
+}
+
+static struct rsp call(int32_t op, int32_t a, int64_t b, int64_t c,
+                       const char *name) {
+    return call2(op, a, b, c, name, NULL, 0, NULL, 0);
 }
 
 static int is_vfd(int fd) { return fd >= VFD_BASE; }
@@ -211,12 +258,12 @@ ssize_t sendto(int fd, const void *buf, size_t n, int flags,
         if (!real_sendto) real_sendto = dlsym(RTLD_NEXT, "sendto");
         return real_sendto(fd, buf, n, flags, addr, alen);
     }
-    (void)buf;
     if (!addr) return send(fd, buf, n, flags);
     const struct sockaddr_in *a = (const struct sockaddr_in *)addr;
     int64_t packed = ((int64_t)a->sin_addr.s_addr << 16) |
                      (int64_t)ntohs(a->sin_port);
-    struct rsp r = call(OP_SENDTO, fd, (int64_t)n, packed, NULL);
+    struct rsp r = call2(OP_SENDTO, fd, (int64_t)n, packed, NULL,
+                         buf, n, NULL, 0);
     if (r.r0 < 0) { errno = (int)r.r1; return -1; }
     return (ssize_t)r.r0;
 }
@@ -230,9 +277,11 @@ ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
         return real_recvfrom(fd, buf, n, flags, addr, alen);
     }
     int blk = !vfd_nb(fd) && !(flags & MSG_DONTWAIT);
-    struct rsp r = call(OP_RECVFROM, fd, (int64_t)n, blk, NULL);
+    /* the response carries r0 payload bytes (zeros for UDP: datagram
+     * payloads are not materialized; see shim.py module doc) */
+    struct rsp r = call2(OP_RECVFROM, fd, (int64_t)n, blk, NULL,
+                         NULL, 0, buf, n);
     if (r.r0 < 0) { errno = (int)r.r1; return -1; }
-    memset(buf, 0, (size_t)r.r0);      /* counts modeled, bytes not */
     if (addr && alen && *alen >= sizeof(struct sockaddr_in)) {
         struct sockaddr_in *a = (struct sockaddr_in *)addr;
         memset(a, 0, sizeof *a);
@@ -260,16 +309,20 @@ int connect(int fd, const struct sockaddr *addr, socklen_t len) {
 
 ssize_t send(int fd, const void *buf, size_t n, int flags) {
     if (!active() || !is_vfd(fd)) return real_send(fd, buf, n, flags);
-    (void)buf;
-    return (ssize_t)call(OP_SEND, fd, (int64_t)n, 0, NULL).r0;
+    /* the request carries the REAL payload: hosted<->hosted TCP
+     * connections deliver true bytes (api.PayloadBroker) */
+    return (ssize_t)call2(OP_SEND, fd, (int64_t)n, 0, NULL,
+                          buf, n, NULL, 0).r0;
 }
 
 ssize_t recv(int fd, void *buf, size_t n, int flags) {
     if (!active() || !is_vfd(fd)) return real_recv(fd, buf, n, flags);
     int blk = !vfd_nb(fd) && !(flags & MSG_DONTWAIT);
-    struct rsp r = call(OP_RECV, fd, (int64_t)n, blk, NULL);
+    /* the response carries r0 payload bytes: the true stream contents
+     * when the peer is hosted, zero-fill when it is a modeled app */
+    struct rsp r = call2(OP_RECV, fd, (int64_t)n, blk, NULL,
+                         NULL, 0, buf, n);
     if (r.r0 < 0) { errno = (int)r.r1; return -1; }
-    memset(buf, 0, (size_t)r.r0);  /* counts are modeled, bytes are not */
     return (ssize_t)r.r0;
 }
 
@@ -377,6 +430,42 @@ void freeaddrinfo(struct addrinfo *res) {
         return;
     }
     if (res) { free(res->ai_addr); free(res); }
+}
+
+/* CPython's socket(fileno=fd) — the path accept() takes to wrap an
+ * accepted fd — calls getsockname() to detect the address family; an
+ * uninterposed call would hit the real kernel with a virtual fd
+ * (EBADF) and kill a hosted python SERVER at its first accept. The
+ * shim answers AF_INET with a zero address: callers use the family,
+ * and peer identity comes from accept4's filled sockaddr instead. */
+int getsockname(int fd, struct sockaddr *addr, socklen_t *len) {
+    if (!active() || !is_vfd(fd)) {
+        static int (*real_gsn)(int, struct sockaddr *, socklen_t *);
+        if (!real_gsn) real_gsn = dlsym(RTLD_NEXT, "getsockname");
+        return real_gsn(fd, addr, len);
+    }
+    if (addr && len && *len >= sizeof(struct sockaddr_in)) {
+        struct sockaddr_in *a = (struct sockaddr_in *)addr;
+        memset(a, 0, sizeof *a);
+        a->sin_family = AF_INET;
+        *len = sizeof *a;
+    }
+    return 0;
+}
+
+int getpeername(int fd, struct sockaddr *addr, socklen_t *len) {
+    if (!active() || !is_vfd(fd)) {
+        static int (*real_gpn)(int, struct sockaddr *, socklen_t *);
+        if (!real_gpn) real_gpn = dlsym(RTLD_NEXT, "getpeername");
+        return real_gpn(fd, addr, len);
+    }
+    if (addr && len && *len >= sizeof(struct sockaddr_in)) {
+        struct sockaddr_in *a = (struct sockaddr_in *)addr;
+        memset(a, 0, sizeof *a);
+        a->sin_family = AF_INET;
+        *len = sizeof *a;
+    }
+    return 0;
 }
 
 /* harmless accepted no-ops on virtual fds */

@@ -60,7 +60,7 @@ class HostedPutter(HostedApp):
     def on_start(self, os):
         self.sock = os.tcp_connect(self.peer, self.port)
 
-    def on_connected(self, os, sock):
+    def on_connected(self, os, sock, **_identity):
         os.write(sock, self.size)
         os.close(sock)
 

@@ -24,7 +24,7 @@ class SelfChannel(HostedApp):
         self.listener = os.tcp_listen(7000)
         self.client = os.tcp_connect(os.host_id, 7000)
 
-    def on_connected(self, os, sock):
+    def on_connected(self, os, sock, **_identity):
         os.write(sock, self.size)
         os.close(sock)
 
