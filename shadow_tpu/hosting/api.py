@@ -211,44 +211,69 @@ class PayloadBroker:
     processes to leak the socket — accepted and documented here.
     """
 
-    CAP = 64 << 20  # per-stream in-flight bound (hosted->modeled case)
+    CAP = 64 << 20  # in-flight bound for READER-LESS streams (a hosted
+    #   endpoint writing toward a modeled process: no one ever pops)
 
     def __init__(self):
         self._streams: dict = {}   # key -> bytearray (None = overflowed)
+        # keys whose actual READER registered (subscribe()): these
+        # streams are never capped (the reader drains them at modeled
+        # delivery pace) and survive the writer's close until the
+        # reader closes. Reader-less keys — the peer process is a
+        # modeled app, even one sharing a host with a hosted app — are
+        # capped and dropped at the writer's close.
+        self._subs: set = set()
 
     def open(self, key):
         """Idempotent create: both endpoints open both directions at
         connection establishment, so a writer's first push always finds
         the stream (the accept wake precedes the connected wake in sim
         time; create-only keeps the later open from clearing bytes the
-        earlier side already pushed)."""
-        self._streams.setdefault(key, bytearray())
+        earlier side already pushed). An overflow-dead marker (None) is
+        revived: it belongs to a previous connection incarnation."""
+        if self._streams.get(key) is None:   # absent OR overflow-dead
+            self._streams[key] = bytearray()
+
+    def subscribe(self, key):
+        """Register as the READER of `key` (each endpoint subscribes
+        its inbound direction at establishment)."""
+        self.open(key)
+        self._subs.add(key)
+
+    def subscribed(self, key) -> bool:
+        return key in self._subs
 
     def push(self, key, data: bytes):
         buf = self._streams.get(key)
         if buf is None:
             return                      # no stream (modeled peer never
         #                                 opened it) or overflowed
-        if len(buf) + len(data) > self.CAP:
-            self._streams[key] = None   # cap blown: a reader-less
-            #   hosted->modeled stream; stop buffering, readers (none)
-            #   would see zero-fill
+        if key not in self._subs and len(buf) + len(data) > self.CAP:
+            self._streams[key] = None   # cap blown on a reader-less
+            #   stream (modeled peer); stop buffering — a subscribed
+            #   stream is never capped, its reader drains it
             return
         buf += data
 
-    def pop(self, key, n: int) -> bytes:
-        """Exactly n bytes: the stream's front, zero-padded when the
-        stream is short/absent (peer modeled, or overflowed)."""
+    def pop(self, key, n: int):
+        """Exactly n bytes off the stream front, or None when the
+        stream cannot cover the request — absent, overflow-dead, or
+        shorter than n. A live writer always stays ahead of delivered
+        counts (bytes are pushed at send time, delivery follows by the
+        modeled latency), so a short stream means no real writer backs
+        it (modeled peer: perpetually empty) or a degraded one
+        (crashed peer / reused key); the caller zero-fills locally and
+        no padding bytes cross the control channel."""
         buf = self._streams.get(key)
-        if not buf:
-            return b"\0" * n
-        k = min(n, len(buf))
-        out = bytes(buf[:k])
-        del buf[:k]
-        return out + b"\0" * (n - k)
+        if buf is None or len(buf) < n:
+            return None
+        out = bytes(buf[:n])
+        del buf[:n]
+        return out
 
     def drop(self, key):
         self._streams.pop(key, None)
+        self._subs.discard(key)
 
 
 class HostedApp:

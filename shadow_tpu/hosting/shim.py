@@ -41,9 +41,12 @@ single-threaded between epoll_waits):
   response = <qqq>      r0, r1, r2         (24 bytes)
   OP_EPOLL_WAIT responses with r0 = n > 0 carry n trailing <qq>
   (fd, events) pairs — multi-event waits honoring maxevents.
-  OP_SEND/OP_SENDTO requests carry b trailing payload bytes (the
-  app's real buffer); successful OP_RECV/OP_RECVFROM responses carry
-  r0 trailing payload bytes (stream contents or zero-fill).
+  OP_SEND requests on STREAM sockets carry b trailing payload bytes
+  (the app's real buffer; both ends key the same per-vfd dgram
+  table); successful OP_RECV responses with r1 == 1 carry r0 trailing
+  payload bytes (real stream contents — r1 == 0 means no live stream
+  covers the read and the C side zero-fills locally). Datagram
+  OP_SEND, OP_SENDTO and OP_RECVFROM never carry payload.
 
 Round 3: the full SERVER path (bind/listen/accept) and UDP
 (sendto/recvfrom) — an unmodified epoll server binary accepts
@@ -198,6 +201,10 @@ class ShimApp(HostedApp):
         self.exited = False
         self._payloads = None     # api.PayloadBroker (runtime attaches)
         self._opened = set()      # broker keys this app opened
+        self._mysubs = set()      # the subset I subscribed (I read)
+        self._vfd_dgram = {}      # vfd -> created SOCK_DGRAM (never
+        #   pruned: mirrors the C side's dg table so send-payload
+        #   framing agrees even for fds the app already closed)
 
     def attach_payload_broker(self, broker):
         """HostingRuntime wires the per-simulation PayloadBroker in:
@@ -209,14 +216,20 @@ class ShimApp(HostedApp):
     def _open_streams(self, vs):
         """Open both directions at establishment (writer-side open
         included: the accept wake precedes the connected wake in sim
-        time, so a server's first push must not find a missing
-        stream), then flush sends issued before the identity resolved."""
+        time, so a server's first push must not find a missing stream)
+        and SUBSCRIBE the inbound one — subscription marks the stream
+        as having a real reader, which exempts it from the reader-less
+        cap and preserves it across the writer's close. Then flush
+        sends issued before the identity resolved."""
         if self._payloads is None or vs.conn is None:
             return
         for d in (0, 1):
             key = vs.conn + (d,)
             self._payloads.open(key)
             self._opened.add(key)
+        inkey = vs.conn + (1 if vs.is_client else 0,)
+        self._payloads.subscribe(inkey)
+        self._mysubs.add(inkey)
         if vs.pending_tx:
             out = vs.conn + (0 if vs.is_client else 1,)
             for data in vs.pending_tx:
@@ -232,11 +245,13 @@ class ShimApp(HostedApp):
         self._payloads.push(vs.conn + (0 if vs.is_client else 1,), data)
 
     def _rx_payload(self, vs, k):
-        """Exactly k bytes for a recv answer: real stream bytes when
-        the peer is hosted, zero-fill otherwise."""
+        """Exactly k real stream bytes for a recv answer, or None when
+        no live stream backs the connection (peer modeled) — the C side
+        then zero-fills locally instead of moving k zeros over the
+        channel."""
         if (self._payloads is None or vs is None or vs.conn is None
                 or vs.kind != "tcp"):
-            return b""                 # _rsp_data zero-pads
+            return None
         return self._payloads.pop(vs.conn + (1 if vs.is_client else 0,),
                                   int(k))
 
@@ -267,25 +282,30 @@ class ShimApp(HostedApp):
 
     def _read_n(self, n):
         """n trailing payload bytes of an OP_SEND/OP_SENDTO request."""
-        buf = b""
+        buf = bytearray()
         n = int(n)
         while len(buf) < n:
             chunk = self.chan.recv(min(n - len(buf), 1 << 20))
             if not chunk:
                 return None
             buf += chunk
-        return buf
+        return bytes(buf)
 
     def _rsp(self, r0=0, r1=0, r2=0):
         self.chan.sendall(RSP.pack(int(r0), int(r1), int(r2)))
 
-    def _rsp_data(self, k, data=b"", r1=0, r2=0):
-        """recv-style answer: header then EXACTLY k payload bytes (the
-        C side reads k unconditionally on success; zero-padded when no
-        real payload stream backs the connection)."""
+    def _rsp_data(self, k, data=None):
+        """OP_RECV answer: header then, when `data` is real stream
+        bytes (r1 = 1), EXACTLY k trailing payload bytes. data=None
+        means no live stream backs the connection — r1 = 0, no
+        trailing bytes, and the C side zero-fills locally (keeps the
+        hosted<->modeled hot path free of per-byte channel traffic)."""
         k = max(int(k), 0)
+        if data is None:
+            self.chan.sendall(RSP.pack(k, 0, 0))
+            return
         out = data[:k] + b"\0" * (k - len(data))
-        self.chan.sendall(RSP.pack(k, int(r1), int(r2)) + out)
+        self.chan.sendall(RSP.pack(k, 1, 0) + out)
 
     # --- epoll readiness ---
     def _events_of(self, vfd):
@@ -409,7 +429,9 @@ class ShimApp(HostedApp):
             src, sport, nbytes = vs.dgrams.pop(0)
             self.parked = None
             if kind == "recvfrom":
-                self._rsp_data(min(n, nbytes), b"", src, sport)
+                # OP_RECVFROM answers never carry payload (r1/r2 are
+                # the datagram's source identity; the C side zero-fills)
+                self._rsp(min(n, nbytes), src, sport)
             else:
                 self._rsp_data(min(n, nbytes))
             return True
@@ -423,6 +445,24 @@ class ShimApp(HostedApp):
             return True
         return False
 
+    def _sweep_streams(self):
+        """Runs when the child is gone (exit or terminate). Drops the
+        streams I READ (my subscriptions — nothing will pop them
+        again, and a hosted peer pushing into a dead subscriber would
+        grow one unbounded, since subscribed streams are exempt from
+        the reader-less cap) and reader-less streams I wrote. Streams
+        the PEER subscribed stay: it may still be draining bytes I
+        sent before exiting (a server that serves, closes and exits
+        while the client reads); the peer drops them at its own
+        close/exit."""
+        if self._payloads is None:
+            return
+        for key in list(self._opened):
+            if key in self._mysubs or not self._payloads.subscribed(key):
+                self._payloads.drop(key)
+                self._opened.discard(key)
+        self._mysubs.clear()
+
     # --- the service loop: run the child until it blocks ---
     def _service(self, os):
         if self.exited:
@@ -434,21 +474,30 @@ class ShimApp(HostedApp):
                 self.exited = True
                 if self.proc is not None:
                     self.proc.wait()
-                return
+                break
             self._handle(os, *req)
+        if self.exited:
+            self._sweep_streams()
 
     def _handle(self, os, op, a, b, c, name):
-        if op in (OP_SEND, OP_SENDTO):
-            # the request carries the app's REAL payload bytes (b = n);
-            # consume them before anything else so the channel stays
-            # framed even on error answers
+        if op == OP_SEND and not self._vfd_dgram.get(a, False):
+            # a stream-socket send carries the app's REAL payload bytes
+            # (b = n); consume them before anything else so the channel
+            # stays framed even on error answers. Datagram sends and
+            # OP_SENDTO never carry payload (UDP contents are not
+            # materialized) — the C side keys the same per-vfd
+            # dgram table, so both ends agree on the framing even for
+            # closed/unknown vfds
             payload = self._read_n(b)
             if payload is None:
                 self.exited = True
                 return
+        else:
+            payload = b""
         if op == OP_SOCKET:
             vfd = self._alloc_vfd()
             self.vfds[vfd] = _VSock(kind="udp" if a else "tcp")
+            self._vfd_dgram[vfd] = bool(a)
             self._rsp(vfd)
         elif op == OP_BIND:
             vs = self.vfds[a]
@@ -484,7 +533,7 @@ class ShimApp(HostedApp):
             vs = self.vfds[a]
             if vs.dgrams:
                 src, sport, nbytes = vs.dgrams.pop(0)
-                self._rsp_data(min(int(b), nbytes), b"", src, sport)
+                self._rsp(min(int(b), nbytes), src, sport)
             elif int(c) & 1:             # blocking: park until a dgram
                 self.parked = ("recvfrom", a, int(b))
             else:
@@ -564,6 +613,17 @@ class ShimApp(HostedApp):
                         key = gone.conn + (1 if gone.is_client else 0,)
                         self._payloads.drop(key)
                         self._opened.discard(key)
+                        self._mysubs.discard(key)
+                        # my OUT-direction: no subscribed reader means
+                        # the peer process is modeled and nothing will
+                        # ever drain it — drop now, not at end-of-run
+                        # (a many-connection run would accumulate one
+                        # capped stream per connection). A subscribed
+                        # stream survives until ITS reader closes.
+                        out = gone.conn + (0 if gone.is_client else 1,)
+                        if not self._payloads.subscribed(out):
+                            self._payloads.drop(out)
+                            self._opened.discard(out)
                 for watch in self.epolls.values():
                     watch.pop(a, None)
             self._rsp(0)
@@ -658,6 +718,17 @@ class ShimApp(HostedApp):
             conn = (int(peer[0]), int(peer[1]), os.host_id,
                     int(dport) or target.bound_port)
             target.accept_q.append((sock, peer[0], peer[1], conn))
+            # subscribe our inbound direction NOW, at the wake — not
+            # at the app's accept() call, which it may make arbitrarily
+            # later: the client's first pushes land between this wake
+            # and that call, and an unsubscribed stream would cap and
+            # die under them (api.PayloadBroker.push)
+            if self._payloads is not None:
+                for d in (0, 1):
+                    self._payloads.open(conn + (d,))
+                    self._opened.add(conn + (d,))
+                self._payloads.subscribe(conn + (0,))
+                self._mysubs.add(conn + (0,))
         self._service(os)
 
     def on_dgram(self, os, sock, src, sport, nbytes, aux):
@@ -707,12 +778,7 @@ class ShimApp(HostedApp):
             except Exception:
                 self.proc.kill()
         self.exited = True
-        if self._payloads is not None:
-            # sweep every stream this app opened: a killed child leaves
-            # its sockets unclosed and the broker must not leak them
-            for key in self._opened:
-                self._payloads.drop(key)
-            self._opened.clear()
+        self._sweep_streams()
 
 
 register("shim", ShimApp)

@@ -65,6 +65,16 @@ static void vfd_set_nb(int fd, int on) {
     if (i >= 0 && i < NB_CAP) nb_flags[i] = (unsigned char)(on != 0);
 }
 
+/* per-vfd SOCK_DGRAM bit: datagram sends never attach payload (UDP
+ * contents are not materialized). Never cleared on close — shim.py
+ * mirrors this table so both ends agree on framing for any vfd. */
+static unsigned char dg_flags[NB_CAP];
+
+static int vfd_dg(int fd) {
+    int i = fd - VFD_BASE;
+    return (i >= 0 && i < NB_CAP) ? dg_flags[i] : 0;
+}
+
 enum {
     OP_SOCKET = 1, OP_CONNECT, OP_SEND, OP_RECV, OP_CLOSE, OP_SHUTDOWN,
     OP_EPOLL_CREATE, OP_EPOLL_CTL, OP_EPOLL_WAIT, OP_CLOCK, OP_RESOLVE,
@@ -122,14 +132,18 @@ static int active(void) {
 
 /* one lockstep request/response on the control channel.
  *
- * Payload framing (round 4): OP_SEND/OP_SENDTO requests are followed
- * by exactly b payload bytes (the app's REAL buffer — the simulator
- * stores them so hosted<->hosted connections deliver true contents);
- * successful OP_RECV/OP_RECVFROM responses are followed by exactly r0
- * payload bytes (real stream bytes, or zeros when the peer is a
- * modeled app). tx/txn attach request payload; rx/rxcap receive
- * response payload. A short read/write kills the channel (EPIPE)
- * rather than desynchronize the framing. */
+ * Payload framing (round 4): OP_SEND requests on STREAM sockets are
+ * followed by exactly b payload bytes (the app's REAL buffer — the
+ * simulator stores them so hosted<->hosted connections deliver true
+ * contents); datagram OP_SEND and OP_SENDTO attach nothing (UDP
+ * contents are not materialized). Successful OP_RECV responses with
+ * r1 == 1 are followed by exactly r0 payload bytes (real stream
+ * contents); r1 == 0 means no live stream covers the read (modeled
+ * peer) and the CALLER zero-fills locally — no per-byte channel
+ * traffic on that path. OP_RECVFROM responses never carry payload
+ * (r1/r2 hold the datagram source). tx/txn attach request payload;
+ * rx/rxcap receive response payload. A short read/write kills the
+ * channel (EPIPE) rather than desynchronize the framing. */
 static struct rsp call2(int32_t op, int32_t a, int64_t b, int64_t c,
                         const char *name, const void *tx, size_t txn,
                         void *rx, size_t rxcap) {
@@ -159,7 +173,7 @@ static struct rsp call2(int32_t op, int32_t a, int64_t b, int64_t c,
         }
         off += (size_t)n;
     }
-    if (rx && r.r0 > 0) {
+    if (rx && r.r0 > 0 && r.r1 == 1) {
         if ((size_t)r.r0 > rxcap) {   /* protocol violation: the sim
             * side answered more than we asked — unrecoverable framing */
             chan_fd = -1; errno = EPIPE;
@@ -193,7 +207,11 @@ int socket(int domain, int type, int protocol) {
         return real_socket(domain, type, protocol);
     int dgram = (type & 0xFF) == SOCK_DGRAM;
     int fd = (int)call(OP_SOCKET, dgram, 0, 0, NULL).r0;
-    if (fd >= 0) vfd_set_nb(fd, (type & SOCK_NONBLOCK) != 0);
+    if (fd >= 0) {
+        vfd_set_nb(fd, (type & SOCK_NONBLOCK) != 0);
+        int i = fd - VFD_BASE;
+        if (i >= 0 && i < NB_CAP) dg_flags[i] = (unsigned char)dgram;
+    }
     return fd;
 }
 
@@ -262,8 +280,9 @@ ssize_t sendto(int fd, const void *buf, size_t n, int flags,
     const struct sockaddr_in *a = (const struct sockaddr_in *)addr;
     int64_t packed = ((int64_t)a->sin_addr.s_addr << 16) |
                      (int64_t)ntohs(a->sin_port);
-    struct rsp r = call2(OP_SENDTO, fd, (int64_t)n, packed, NULL,
-                         buf, n, NULL, 0);
+    /* OP_SENDTO never attaches payload: datagram contents are not
+     * materialized, so there is nothing for the simulator to keep */
+    struct rsp r = call(OP_SENDTO, fd, (int64_t)n, packed, NULL);
     if (r.r0 < 0) { errno = (int)r.r1; return -1; }
     return (ssize_t)r.r0;
 }
@@ -277,11 +296,12 @@ ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
         return real_recvfrom(fd, buf, n, flags, addr, alen);
     }
     int blk = !vfd_nb(fd) && !(flags & MSG_DONTWAIT);
-    /* the response carries r0 payload bytes (zeros for UDP: datagram
-     * payloads are not materialized; see shim.py module doc) */
-    struct rsp r = call2(OP_RECVFROM, fd, (int64_t)n, blk, NULL,
-                         NULL, 0, buf, n);
+    struct rsp r = call(OP_RECVFROM, fd, (int64_t)n, blk, NULL);
     if (r.r0 < 0) { errno = (int)r.r1; return -1; }
+    if ((size_t)r.r0 > n) {  /* protocol violation: never overrun buf */
+        chan_fd = -1; errno = EPIPE; return -1;
+    }
+    memset(buf, 0, (size_t)r.r0);  /* datagram payloads not materialized */
     if (addr && alen && *alen >= sizeof(struct sockaddr_in)) {
         struct sockaddr_in *a = (struct sockaddr_in *)addr;
         memset(a, 0, sizeof *a);
@@ -309,8 +329,11 @@ int connect(int fd, const struct sockaddr *addr, socklen_t len) {
 
 ssize_t send(int fd, const void *buf, size_t n, int flags) {
     if (!active() || !is_vfd(fd)) return real_send(fd, buf, n, flags);
-    /* the request carries the REAL payload: hosted<->hosted TCP
-     * connections deliver true bytes (api.PayloadBroker) */
+    /* stream sends carry the REAL payload: hosted<->hosted TCP
+     * connections deliver true bytes (api.PayloadBroker). Datagram
+     * sends attach nothing — UDP contents are not materialized. */
+    if (vfd_dg(fd))
+        return (ssize_t)call(OP_SEND, fd, (int64_t)n, 0, NULL).r0;
     return (ssize_t)call2(OP_SEND, fd, (int64_t)n, 0, NULL,
                           buf, n, NULL, 0).r0;
 }
@@ -318,11 +341,15 @@ ssize_t send(int fd, const void *buf, size_t n, int flags) {
 ssize_t recv(int fd, void *buf, size_t n, int flags) {
     if (!active() || !is_vfd(fd)) return real_recv(fd, buf, n, flags);
     int blk = !vfd_nb(fd) && !(flags & MSG_DONTWAIT);
-    /* the response carries r0 payload bytes: the true stream contents
-     * when the peer is hosted, zero-fill when it is a modeled app */
+    /* r1 == 1: the response carries the true stream contents (hosted
+     * peer); r1 == 0: modeled peer, zero-fill locally */
     struct rsp r = call2(OP_RECV, fd, (int64_t)n, blk, NULL,
                          NULL, 0, buf, n);
     if (r.r0 < 0) { errno = (int)r.r1; return -1; }
+    if ((size_t)r.r0 > n) {  /* protocol violation: never overrun buf */
+        chan_fd = -1; errno = EPIPE; return -1;
+    }
+    if (r.r1 != 1) memset(buf, 0, (size_t)r.r0);
     return (ssize_t)r.r0;
 }
 
