@@ -1,0 +1,91 @@
+/* libcprobe: an UNMODIFIED binary exercising the non-socket libc
+ * surface the simulator must virtualize (reference equivalents:
+ * shd-process.c:3055 nanosleep, :4329-4389 clocks, shd-host.c:574
+ * entropy; determinism dual-run shd-test-determinism.c:15-60).
+ *
+ *   ./libcprobe <sleep_ms> <nrandom>
+ *
+ * 1. reads all three clock surfaces (clock_gettime, gettimeofday,
+ *    time) — under the sim they must agree on SIMULATED time;
+ * 2. sleeps sleep_ms via nanosleep + usleep + sleep (one third each)
+ *    and reports the clock delta — under the sim the delta is SIM
+ *    time (the process never burns wallclock);
+ * 3. draws nrandom bytes from getrandom() AND /dev/urandom and prints
+ *    them as hex — under the sim these come from the host's
+ *    deterministic PRNG, so two runs print IDENTICAL lines;
+ * 4. tries pthread_create — under the sim it must FAIL (EAGAIN), not
+ *    silently spawn a real thread.
+ *
+ * Output (one line each):
+ *   clocks mono=<s> real=<s> tod=<s> time=<s>
+ *   slept requested=<s> measured=<s>
+ *   entropy getrandom=<hex> urandom=<hex>
+ *   threads pthread_create=<rc>
+ */
+#define _GNU_SOURCE
+#include <fcntl.h>
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/random.h>
+#include <sys/time.h>
+#include <time.h>
+#include <unistd.h>
+
+static void *thread_main(void *arg) { (void)arg; return NULL; }
+
+static void hex(const unsigned char *b, int n, char *out) {
+    for (int i = 0; i < n; i++) sprintf(out + 2 * i, "%02x", b[i]);
+    out[2 * n] = 0;
+}
+
+int main(int argc, char **argv) {
+    long sleep_ms = argc > 1 ? atol(argv[1]) : 900;
+    int nrand = argc > 2 ? atoi(argv[2]) : 16;
+    if (nrand > 64) nrand = 64;
+
+    struct timespec mono, real;
+    struct timeval tod;
+    clock_gettime(CLOCK_MONOTONIC, &mono);
+    clock_gettime(CLOCK_REALTIME, &real);
+    gettimeofday(&tod, NULL);
+    time_t tt = time(NULL);
+    printf("clocks mono=%.3f real=%.3f tod=%.3f time=%ld\n",
+           mono.tv_sec + mono.tv_nsec / 1e9,
+           real.tv_sec + real.tv_nsec / 1e9,
+           tod.tv_sec + tod.tv_usec / 1e6, (long)tt);
+
+    struct timespec t0, t1;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+    long third_ns = sleep_ms * 1000000L / 3;
+    struct timespec req = {third_ns / 1000000000L,
+                           third_ns % 1000000000L};
+    nanosleep(&req, NULL);
+    usleep(third_ns / 1000);
+    if (third_ns >= 1000000000L) sleep(third_ns / 1000000000L);
+    else usleep(third_ns / 1000);
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    double measured = (t1.tv_sec - t0.tv_sec) +
+                      (t1.tv_nsec - t0.tv_nsec) / 1e9;
+    printf("slept requested=%.3f measured=%.3f\n",
+           sleep_ms / 1000.0, measured);
+
+    unsigned char gr[64], ur[64];
+    char grh[129], urh[129];
+    memset(gr, 0, sizeof gr);
+    memset(ur, 0, sizeof ur);
+    if (getrandom(gr, nrand, 0) != nrand) perror("getrandom");
+    int fd = open("/dev/urandom", O_RDONLY);
+    if (fd < 0 || read(fd, ur, nrand) != nrand) perror("urandom");
+    if (fd >= 0) close(fd);
+    hex(gr, nrand, grh);
+    hex(ur, nrand, urh);
+    printf("entropy getrandom=%s urandom=%s\n", grh, urh);
+
+    pthread_t th;
+    int rc = pthread_create(&th, NULL, thread_main, NULL);
+    if (rc == 0) pthread_join(th, NULL);
+    printf("threads pthread_create=%d\n", rc);
+    return 0;
+}

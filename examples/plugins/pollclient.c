@@ -1,0 +1,124 @@
+/* pollclient: a plain, UNMODIFIED poll()/select()-based TCP upload
+ * client — the wait-styles epclient does NOT cover (the reference
+ * interposes poll and select for exactly this class of binary,
+ * process_emu_poll/select, shd-process.c:2606-2899).
+ *
+ * Uses only ordinary libc networking: getaddrinfo, nonblocking
+ * connect completed via poll(POLLOUT), send gated by poll(POLLOUT),
+ * then recv-until-EOF gated by select(readfds). getsockname() is
+ * called on every established connection and its port must be
+ * nonzero (round-5 shim: real simulated identity, not zeros).
+ *
+ * The same binary runs:
+ *   natively:   ./pollclient <host> <port> <bytes> <count>
+ *   simulated:  plugin="hosted:shim" cmd=.../pollclient <server> ...
+ *
+ * Prints one summary line:
+ *   pollclient done transfers=N bytes=B ports_ok=N secs=S
+ */
+#include <errno.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/select.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+#include <fcntl.h>
+
+static int fatal(const char *msg) { perror(msg); exit(1); }
+
+int main(int argc, char **argv) {
+    if (argc < 5) {
+        fprintf(stderr,
+                "usage: %s <host> <port> <bytes-per-transfer> <count>\n",
+                argv[0]);
+        return 2;
+    }
+    const char *host = argv[1], *port = argv[2];
+    long nbytes = atol(argv[3]);
+    int count = atoi(argv[4]);
+
+    struct addrinfo hints, *ai;
+    memset(&hints, 0, sizeof hints);
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(host, port, &hints, &ai) != 0)
+        fatal("getaddrinfo");
+
+    char *buf = calloc(1, 65536);
+    long total = 0;
+    int done = 0, ports_ok = 0;
+
+    struct timespec t0;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+
+    for (int i = 0; i < count; i++) {
+        int fd = socket(AF_INET, SOCK_STREAM, 0);
+        if (fd < 0) fatal("socket");
+        fcntl(fd, F_SETFL, O_NONBLOCK);
+        if (connect(fd, ai->ai_addr, ai->ai_addrlen) < 0 &&
+            errno != EINPROGRESS)
+            fatal("connect");
+
+        /* completion via poll(POLLOUT) */
+        struct pollfd p = {fd, POLLOUT, 0};
+        if (poll(&p, 1, 30000) <= 0) fatal("poll connect");
+        int err = 0;
+        socklen_t el = sizeof err;
+        getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &el);
+        if (err) { errno = err; fatal("SO_ERROR"); }
+
+        struct sockaddr_in self;
+        socklen_t sl = sizeof self;
+        if (getsockname(fd, (struct sockaddr *)&self, &sl) == 0 &&
+            ntohs(self.sin_port) != 0)
+            ports_ok++;
+
+        long left = nbytes;
+        while (left > 0) {
+            struct pollfd w = {fd, POLLOUT, 0};
+            if (poll(&w, 1, 30000) <= 0) fatal("poll send");
+            ssize_t k = send(fd, buf, left > 65536 ? 65536 : left, 0);
+            if (k < 0) {
+                if (errno == EAGAIN) continue;
+                fatal("send");
+            }
+            left -= k;
+            total += k;
+        }
+        shutdown(fd, SHUT_WR);
+
+        /* wait for the server's close with select() */
+        for (;;) {
+            fd_set rs;
+            FD_ZERO(&rs);
+            FD_SET(fd, &rs);
+            struct timeval tv = {30, 0};
+            int rc = select(fd + 1, &rs, NULL, NULL, &tv);
+            if (rc <= 0) fatal("select eof");
+            char tmp[4096];
+            ssize_t k = recv(fd, tmp, sizeof tmp, 0);
+            if (k < 0) {
+                if (errno == EAGAIN) continue;
+                fatal("recv");
+            }
+            if (k == 0) break;           /* EOF: server closed */
+        }
+        close(fd);
+        done++;
+    }
+
+    struct timespec t1;
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    double secs = (t1.tv_sec - t0.tv_sec) +
+                  (t1.tv_nsec - t0.tv_nsec) / 1e9;
+    printf("pollclient done transfers=%d bytes=%ld ports_ok=%d "
+           "secs=%.3f\n", done, total, ports_ok, secs);
+    freeaddrinfo(ai);
+    free(buf);
+    return done == count ? 0 : 1;
+}

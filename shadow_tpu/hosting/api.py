@@ -80,6 +80,14 @@ class HostOS:
         """Deterministic per-host uniform [0, 1)."""
         return float(self._rng.random())
 
+    def random_bytes(self, n: int) -> bytes:
+        """n deterministic entropy bytes from the per-host PRNG — the
+        backing store for hosted getrandom/getentropy//dev/u?random
+        (reference: the host random source serves /dev/random reads,
+        shd-host.c:574, which is what makes entropy-drawing binaries
+        run identically across runs, shd-test-determinism.c:15-60)."""
+        return self._rng.bytes(int(n))
+
     def resolve(self, name: str) -> int:
         """Virtual DNS lookup -> host id."""
         return self._dns.resolve(name)
@@ -263,9 +271,19 @@ class PayloadBroker:
         modeled latency), so a short stream means no real writer backs
         it (modeled peer: perpetually empty) or a degraded one
         (crashed peer / reused key); the caller zero-fills locally and
-        no padding bytes cross the control channel."""
+        no padding bytes cross the control channel.
+
+        A SHORT stream (nonempty but < n) is marked overflow-dead: one
+        uncovered read has already zero-filled, so later covered pops
+        would return real bytes at the wrong stream offset — shifted
+        partial replay is worse than degrading to consistent zero-fill
+        (round-4 advisor)."""
         buf = self._streams.get(key)
-        if buf is None or len(buf) < n:
+        if buf is None:
+            return None
+        if len(buf) < n:
+            if len(buf) > 0:
+                self._streams[key] = None
             return None
         out = bytes(buf[:n])
         del buf[:n]

@@ -4,30 +4,39 @@ The reference's defining trick is running real, unmodified binaries by
 interposing 262 libc symbols (/root/reference/src/preload/
 shd-interposer.c:211-222, shd-preload-defs.h) and re-entering blocked
 app code with green threads (shd-process.c:1076-1263). This module is
-the TPU build's minimal realization of that capability for epoll-style
-network clients:
+the TPU build's realization of that capability:
 
 - the REAL binary runs as a separate OS process with
   ``libshadow_shim.so`` LD_PRELOADed (hosting/shim_preload.c);
-- the shim interposes the socket/epoll/clock libc surface and forwards
-  each call over an inherited socketpair to :class:`ShimApp`, a hosted
-  app (hosting.api) inside the simulator;
+- the shim interposes the socket/epoll/poll/select/clock/sleep/entropy
+  libc surface and forwards each call over an inherited socketpair to
+  :class:`ShimApp`, a hosted app (hosting.api) inside the simulator;
 - blocking semantics replace rpth: the binary only ever blocks inside
-  a forwarded ``epoll_wait``; the simulator answers it when a device
-  wake (connection established, bytes delivered, EOF) maps to a
-  registered epoll interest — so simulated time never advances while
-  app code runs, exactly the reference's cooperative model;
+  a forwarded wait (epoll_wait/poll/select, blocking connect/recv/
+  accept, nanosleep); the simulator answers it when a device wake
+  (connection established, bytes delivered, EOF, timer) maps to it —
+  so simulated time never advances while app code runs, exactly the
+  reference's cooperative model;
+- ALL clocks read simulated time (clock_gettime, gettimeofday,
+  time — reference shd-process.c:4329-4389), sleeps advance sim time
+  (process_emu_nanosleep, shd-process.c:3055), and entropy
+  (getrandom/getentropy//dev/u?random) comes from the host's
+  deterministic PRNG (shd-host.c:574) — a hosted binary that draws
+  randomness runs bit-identically across runs (the reference's
+  determinism dual-run, shd-test-determinism.c:15-60, realized in
+  tests/test_shim_libc.py);
 - TCP payload bytes are MATERIALIZED host-side (round 4): the engine
   models byte counts and timing, while the real bytes ride the control
   channel into a per-connection FIFO (api.PayloadBroker) keyed by the
   TCP 4-tuple both endpoints derive from their establishment wakes.
-  Delivered counts are in-order stream advances bounded by what was
-  sent, so popping the FIFO reproduces exactly the bytes a real
-  network would deliver — payload-parsing binaries (HTTP-style
-  request/response) run unmodified when both endpoints are hosted.
-  A hosted endpoint talking to a MODELED app still sees zero-fill
-  (modeled apps have no real bytes), and UDP datagram payloads are
-  not materialized.
+  A hosted endpoint talking to a MODELED app sees zero-fill, and UDP
+  datagram payloads are not materialized.
+
+Virtual fd numbering (round 5): the C side reserves a real kernel fd
+(an open /dev/null placeholder) per virtual fd and the simulator keys
+its state by that number — vfds stay small (select()'s fd_set caps
+fds at 1024), never collide with the process's real fds, and close()
+retires both together. Creating ops carry the reserved number.
 
 Scenario usage: plugin="hosted:shim" with arguments
 ``[out=<stdout file>] cmd=<binary> [child args...]`` — cmd paths
@@ -36,31 +45,18 @@ preload library builds on demand with cc into SHADOW_SHIM_BUILD or the
 temp dir (hosting.shim.build_shim).
 
 Protocol (one request, one response, in lockstep — the child is
-single-threaded between epoll_waits):
+single-threaded between waits):
   request  = <iiqq64s>  op, a, b, c, name  (88 bytes)
   response = <qqq>      r0, r1, r2         (24 bytes)
-  OP_EPOLL_WAIT responses with r0 = n > 0 carry n trailing <qq>
-  (fd, events) pairs — multi-event waits honoring maxevents.
-  OP_SEND requests on STREAM sockets carry b trailing payload bytes
-  (the app's real buffer; both ends key the same per-vfd dgram
-  table); successful OP_RECV responses with r1 == 1 carry r0 trailing
-  payload bytes (real stream contents — r1 == 0 means no live stream
-  covers the read and the C side zero-fills locally). Datagram
-  OP_SEND, OP_SENDTO and OP_RECVFROM never carry payload.
-
-Round 3: the full SERVER path (bind/listen/accept) and UDP
-(sendto/recvfrom) — an unmodified epoll server binary accepts
-simulated clients, mirroring the reference's server-side process_emu
-surface (shd-process.c:1993-2605).
-
-Round 4: BLOCKING semantics — per-vfd O_NONBLOCK tracking (fcntl,
-SOCK_NONBLOCK, ioctl FIONBIO) with blocking connect/recv/recvfrom/
-accept parking until their wake, which is what lets stock
-blocking-socket binaries (e.g. the CPython interpreter running a
-plain socket script, tests/test_shim.py) run unmodified. Known gap:
-poll()/select() are not interposed, so clients that wait with those
-(e.g. CPython sockets with a TIMEOUT set, which go nonblocking and
-poll internally) need the epoll or plain-blocking style instead.
+  OP_EPOLL_WAIT / OP_POLL responses with r0 = n > 0 carry n trailing
+  <qq> (fd, events) pairs. OP_POLL requests carry b trailing payload
+  bytes (the virtual pollfd set as <qq> pairs). OP_SEND requests with
+  c == 1 carry b trailing payload bytes (stream sends; datagram sends
+  set c = 0 and attach nothing). Successful OP_RECV / OP_RANDOM
+  responses with r1 == 1 carry r0 trailing payload bytes (real stream
+  contents / PRNG bytes — r1 == 0 on OP_RECV means no live stream
+  covers the read and the C side zero-fills locally). OP_RECVFROM
+  responses never carry payload.
 """
 
 from __future__ import annotations
@@ -91,6 +87,10 @@ OP_LISTEN = 13
 OP_ACCEPT = 14
 OP_SENDTO = 15
 OP_RECVFROM = 16
+OP_SLEEP = 17
+OP_POLL = 18
+OP_RANDOM = 19
+OP_GETNAME = 20
 
 EPOLLIN = 0x001
 EPOLLOUT = 0x004
@@ -105,8 +105,30 @@ EPOLL_CTL_ADD = 1
 EPOLL_CTL_DEL = 2
 EPOLL_CTL_MOD = 3
 
+# sim-time timer tags (ride an i32 packet word; sign bit must stay
+# clear): bits 0-19 = fd/id operand, bits 20-22 = kind, bits 24-30 =
+# park sequence. A stale timer can only false-match the CURRENT park if
+# kind, operand AND a 128-window sequence all line up — acceptable odds
+# vs. the wedge an unmatched timeout causes.
+TK_EPOLL = 0    # epoll_wait timeout (operand = epfd)
+TK_SLEEP = 1    # nanosleep/usleep/sleep deadline
+TK_POLL = 2     # poll/select timeout
+TK_GRACE = 3    # deferred payload-stream drop (operand = grace id)
+
+
+def _tag(kind, operand, seq):
+    return ((seq & 0x7F) << 24) | ((kind & 0x7) << 20) | (operand & 0xFFFFF)
+
+
 _SRC = _os.path.dirname(_os.path.abspath(__file__))
 SHIM_C = _os.path.join(_SRC, "shim_preload.c")
+
+# sim-time grace before an unsubscribed out-direction payload stream is
+# dropped at close: long enough for the peer's establishment wake (one
+# path latency) to arrive and subscribe — a hosted server that writes
+# and closes within its accept window (banner-then-close) must not lose
+# its bytes (round-4 advisor, shim OP_CLOSE)
+GRACE_NS = 30 * 10**9
 
 
 def build_shim(out_dir: str = None) -> str:
@@ -133,7 +155,7 @@ def build_shim(out_dir: str = None) -> str:
         if st.st_uid == _os.getuid() and not (st.st_mode & 0o022):
             return so
     subprocess.run(["cc", "-shared", "-fPIC", "-O2", "-o", so, SHIM_C,
-                    "-ldl"], check=True)
+                    "-ldl", "-lpthread"], check=True)
     _os.chmod(so, 0o755)
     return so
 
@@ -181,30 +203,28 @@ class ShimApp(HostedApp):
         self.out_path = kv.get("out")   # child stdout -> file (tests)
         self.proc = None
         self.chan = None          # our end of the socketpair
-        self.vfds = {}            # vfd -> _VSock
+        self.vfds = {}            # vfd -> _VSock (vfd = C-reserved fd)
         self.by_sock = {}         # id(Sock) -> vfd (pre-resolution)
         self.by_key = {}          # (slot, gen) -> vfd: wakes arriving
         # after os.close() carry a FRESH Sock object for the same
         # incarnation (HostOS retires closed handles), so identity
         # lookup alone would drop e.g. the post-shutdown EOF
         self.epolls = {}          # vepfd -> {vfd: events}
-        self.next_fd = 1 << 20
         # the child's one blocked call (it is single-threaded): None,
         # ("epoll", epfd, maxev), ("connect", vfd), ("recv", vfd, n),
         # ("recvd", vfd, n) [blocking recv() on udp],
-        # ("recvfrom", vfd, n), or ("accept", vfd). Blocking calls park
-        # here until a wake satisfies them (_maybe_unpark) — the
-        # shim's replacement for the reference's rpth block/reenter
-        # (shd-process.c:1076-1263)
+        # ("recvfrom", vfd, n), ("accept", vfd, cfd), ("sleep",), or
+        # ("poll", interest). Blocking calls park here until a wake
+        # satisfies them (_maybe_unpark) — the shim's replacement for
+        # the reference's rpth block/reenter (shd-process.c:1076-1263)
         self.parked = None
         self.park_seq = 0         # increments per park: stale-timeout guard
         self.exited = False
         self._payloads = None     # api.PayloadBroker (runtime attaches)
         self._opened = set()      # broker keys this app opened
         self._mysubs = set()      # the subset I subscribed (I read)
-        self._vfd_dgram = {}      # vfd -> created SOCK_DGRAM (never
-        #   pruned: mirrors the C side's dg table so send-payload
-        #   framing agrees even for fds the app already closed)
+        self._grace = {}          # grace id -> stream key pending drop
+        self._next_grace = 0
 
     def attach_payload_broker(self, broker):
         """HostingRuntime wires the per-simulation PayloadBroker in:
@@ -281,7 +301,7 @@ class ShimApp(HostedApp):
         return REQ.unpack(buf)
 
     def _read_n(self, n):
-        """n trailing payload bytes of an OP_SEND/OP_SENDTO request."""
+        """n trailing payload bytes of an OP_SEND/OP_POLL request."""
         buf = bytearray()
         n = int(n)
         while len(buf) < n:
@@ -295,7 +315,7 @@ class ShimApp(HostedApp):
         self.chan.sendall(RSP.pack(int(r0), int(r1), int(r2)))
 
     def _rsp_data(self, k, data=None):
-        """OP_RECV answer: header then, when `data` is real stream
+        """OP_RECV/OP_RANDOM answer: header then, when `data` is real
         bytes (r1 = 1), EXACTLY k trailing payload bytes. data=None
         means no live stream backs the connection — r1 = 0, no
         trailing bytes, and the C side zero-fills locally (keeps the
@@ -307,7 +327,7 @@ class ShimApp(HostedApp):
         out = data[:k] + b"\0" * (k - len(data))
         self.chan.sendall(RSP.pack(k, 1, 0) + out)
 
-    # --- epoll readiness ---
+    # --- epoll/poll readiness ---
     def _events_of(self, vfd):
         vs = self.vfds.get(vfd)
         if vs is None:
@@ -338,33 +358,41 @@ class ShimApp(HostedApp):
                     break
         return hits
 
+    def _poll_ready(self, interest):
+        """poll() readiness over an explicit {vfd: events} interest
+        set (POLLIN/POLLOUT share EPOLL bit values)."""
+        hits = []
+        for vfd, events in interest.items():
+            ev = self._events_of(vfd) & (events | EPOLLRDHUP | EPOLLHUP)
+            if ev:
+                hits.append((vfd, ev))
+        return hits
+
     def _rsp_events(self, hits):
-        """Multi-event epoll_wait answer: header with the count, then
-        one (fd, events) pair per event (shim_preload.c evpair)."""
+        """Multi-event epoll_wait/poll answer: header with the count,
+        then one (fd, events) pair per event (shim_preload.c evpair)."""
         out = RSP.pack(len(hits), 0, 0)
         for vfd, ev in hits:
             out += EVPAIR.pack(vfd, ev)
         self.chan.sendall(out)
 
-    def _alloc_vfd(self):
-        """Next virtual fd. Fails LOUD at the preload library's
-        per-vfd flag-table bound (shim_preload.c NB_CAP): past it the
-        C side could no longer track O_NONBLOCK and a nonblocking
-        call would silently park — wedging the child — instead of
-        returning EAGAIN."""
-        if self.next_fd - (1 << 20) >= (1 << 16):
+    def _take_vfd(self, vfd):
+        """Adopt the C-side reserved fd number as a vfd id. The number
+        is a live kernel fd in the child, so it cannot collide with
+        another LIVE vfd — a collision means close-tracking desynced,
+        which must fail loud, not corrupt state."""
+        vfd = int(vfd)
+        if vfd in self.vfds or vfd in self.epolls:
             raise RuntimeError(
-                "hosted binary exhausted the shim's vfd space "
-                "(65536 sockets/epolls over the process lifetime)")
-        vfd = self.next_fd
-        self.next_fd += 1
+                f"shim protocol error: vfd {vfd} re-reserved while live")
         return vfd
 
-    def _rsp_accept(self, vs):
+    def _rsp_accept(self, vs, cfd):
         """Pop one pending child off a listener and answer the accept
-        call (shared by the immediate and parked paths)."""
+        call with the C-reserved child fd (shared by the immediate and
+        parked paths)."""
         child, src, sport, conn = vs.accept_q.pop(0)
-        cfd = self._alloc_vfd()
+        cfd = self._take_vfd(cfd)
         cvs = _VSock(kind="tcp")
         cvs.sock = child
         cvs.connected = True
@@ -390,6 +418,14 @@ class ShimApp(HostedApp):
         if kind == "epoll":
             _, epfd, maxev = self.parked
             hits = self._ready(epfd, maxev)
+            if not hits:
+                return False
+            self.parked = None
+            self._rsp_events(hits)
+            return True
+        if kind == "poll":
+            interest = self.parked[1]
+            hits = self._poll_ready(interest)
             if not hits:
                 return False
             self.parked = None
@@ -436,13 +472,14 @@ class ShimApp(HostedApp):
                 self._rsp_data(min(n, nbytes))
             return True
         if kind == "accept":
-            vfd = self.parked[1]
+            _, vfd, cfd = self.parked
             vs = self.vfds.get(vfd)
             if vs is None or not vs.accept_q:
                 return False
             self.parked = None
-            self._rsp_accept(vs)
+            self._rsp_accept(vs, cfd)
             return True
+        # "sleep" parks resolve only via their timer (on_timer)
         return False
 
     def _sweep_streams(self):
@@ -462,6 +499,7 @@ class ShimApp(HostedApp):
                 self._payloads.drop(key)
                 self._opened.discard(key)
         self._mysubs.clear()
+        self._grace.clear()
 
     # --- the service loop: run the child until it blocks ---
     def _service(self, os):
@@ -479,25 +517,50 @@ class ShimApp(HostedApp):
         if self.exited:
             self._sweep_streams()
 
+    def _park_timer(self, os, ns, kind, operand=0):
+        """Arm a sim-time timer tagged to the CURRENT park (park_seq
+        must already be bumped). See the tag layout above."""
+        os.timer(int(ns), tag=_tag(kind, operand, self.park_seq))
+
     def _handle(self, os, op, a, b, c, name):
-        if op == OP_SEND and not self._vfd_dgram.get(a, False):
+        if op == OP_SEND and int(c) == 1:
             # a stream-socket send carries the app's REAL payload bytes
             # (b = n); consume them before anything else so the channel
-            # stays framed even on error answers. Datagram sends and
-            # OP_SENDTO never carry payload (UDP contents are not
-            # materialized) — the C side keys the same per-vfd
-            # dgram table, so both ends agree on the framing even for
-            # closed/unknown vfds
+            # stays framed even on error answers. Datagram sends set
+            # c = 0 and OP_SENDTO never carries payload (UDP contents
+            # are not materialized) — the C side stamps the flag from
+            # its own per-fd state, so framing never depends on
+            # mirrored tables
             payload = self._read_n(b)
             if payload is None:
                 self.exited = True
                 return
         else:
             payload = b""
+        if op == OP_POLL:
+            raw = self._read_n(b)
+            if raw is None:
+                self.exited = True
+                return
+            interest = {}
+            for i in range(int(a)):
+                fd, events = EVPAIR.unpack_from(raw, i * EVPAIR.size)
+                interest[int(fd)] = interest.get(int(fd), 0) | int(events)
+            hits = self._poll_ready(interest)
+            timeout_ms = int(c)
+            if hits:
+                self._rsp_events(hits)
+            elif timeout_ms == 0:
+                self._rsp_events([])
+            else:
+                self.parked = ("poll", interest)
+                self.park_seq += 1
+                if timeout_ms > 0:
+                    self._park_timer(os, timeout_ms * 1_000_000, TK_POLL)
+            return
         if op == OP_SOCKET:
-            vfd = self._alloc_vfd()
+            vfd = self._take_vfd(b)
             self.vfds[vfd] = _VSock(kind="udp" if a else "tcp")
-            self._vfd_dgram[vfd] = bool(a)
             self._rsp(vfd)
         elif op == OP_BIND:
             vs = self.vfds[a]
@@ -515,9 +578,10 @@ class ShimApp(HostedApp):
         elif op == OP_ACCEPT:
             vs = self.vfds[a]
             if vs.accept_q:
-                self._rsp_accept(vs)
+                self._rsp_accept(vs, int(c))
             elif int(b) & 1:             # blocking listener: park
-                self.parked = ("accept", a)
+                self.parked = ("accept", a, int(c))
+                self.park_seq += 1
             else:
                 self._rsp(-1, EAGAIN)
         elif op == OP_SENDTO:
@@ -536,6 +600,7 @@ class ShimApp(HostedApp):
                 self._rsp(min(int(b), nbytes), src, sport)
             elif int(c) & 1:             # blocking: park until a dgram
                 self.parked = ("recvfrom", a, int(b))
+                self.park_seq += 1
             else:
                 self._rsp(-1, EAGAIN)
         elif op == OP_CONNECT:
@@ -556,6 +621,7 @@ class ShimApp(HostedApp):
                 self.by_sock[id(vs.sock)] = a
                 if blk:                  # blocking connect: park until
                     self.parked = ("connect", a)   # established
+                    self.park_seq += 1
                 else:
                     self._rsp(-1, EINPROGRESS)  # completes via EPOLLOUT
         elif op == OP_SEND:
@@ -583,6 +649,7 @@ class ShimApp(HostedApp):
                     self._rsp_data(min(int(b), nbytes))
                 elif blk:
                     self.parked = ("recvd", a, int(b))
+                    self.park_seq += 1
                 else:
                     self._rsp(-1, EAGAIN)
             else:
@@ -591,11 +658,19 @@ class ShimApp(HostedApp):
                 if n == 0 and not vs.eof:
                     if blk:              # blocking read: park until
                         self.parked = ("recv", a, int(b))  # data/EOF
+                        self.park_seq += 1
                     else:
                         self._rsp(-1, EAGAIN)
                 else:
                     self._rsp_data(n, self._rx_payload(vs, n))  # 0 = EOF
         elif op in (OP_CLOSE, OP_SHUTDOWN):
+            if op == OP_CLOSE and a in self.epolls:
+                # closing an epoll instance: forget its interest set
+                # (with C-reserved fd numbers the number WILL be
+                # reused; stale state would collide in _take_vfd)
+                del self.epolls[a]
+                self._rsp(0)
+                return
             vs = self.vfds.get(a)
             if vs is not None and vs.sock is not None and not vs.closed:
                 os.close(vs.sock)
@@ -614,21 +689,27 @@ class ShimApp(HostedApp):
                         self._payloads.drop(key)
                         self._opened.discard(key)
                         self._mysubs.discard(key)
-                        # my OUT-direction: no subscribed reader means
-                        # the peer process is modeled and nothing will
-                        # ever drain it — drop now, not at end-of-run
-                        # (a many-connection run would accumulate one
-                        # capped stream per connection). A subscribed
-                        # stream survives until ITS reader closes.
+                        # my OUT-direction: if no reader subscribed YET,
+                        # the peer is either modeled (nothing will ever
+                        # drain it) or a hosted process whose
+                        # establishment wake hasn't arrived (a server
+                        # that writes and closes within its accept
+                        # window — banner-then-close). Don't drop now:
+                        # give the peer a sim-time GRACE window to
+                        # subscribe, then drop if still reader-less
+                        # (round-4 advisor: the immediate drop silently
+                        # discarded such a server's bytes)
                         out = gone.conn + (0 if gone.is_client else 1,)
                         if not self._payloads.subscribed(out):
-                            self._payloads.drop(out)
-                            self._opened.discard(out)
+                            gid = self._next_grace & 0xFFFFF
+                            self._next_grace += 1
+                            self._grace[gid] = out
+                            os.timer(GRACE_NS, tag=_tag(TK_GRACE, gid, 0))
                 for watch in self.epolls.values():
                     watch.pop(a, None)
             self._rsp(0)
         elif op == OP_EPOLL_CREATE:
-            vfd = self._alloc_vfd()
+            vfd = self._take_vfd(b)
             self.epolls[vfd] = {}
             self._rsp(vfd)
         elif op == OP_EPOLL_CTL:
@@ -651,18 +732,25 @@ class ShimApp(HostedApp):
                 # block until a wake readies it
                 self.parked = ("epoll", a, maxev)
                 self.park_seq += 1
-                if b > 0:                # bounded wait: sim-time timer,
-                    # tagged with this park's sequence so a stale timer
-                    # from an earlier (already answered) wait cannot
-                    # cut a later one short. The tag rides an i32
-                    # packet word, so the seq is masked to 7 bits
-                    # (sign bit must stay clear); a false match needs
-                    # a stale timer exactly 128 timed parks old AND
-                    # the same epfd AND the child parked — acceptable
-                    # odds vs. the wedge an unmatched timeout causes
-                    os.timer(int(b) * 1_000_000,
-                             tag=((self.park_seq & 0x7F) << 24) |
-                                 (a & 0xFFFFFF))
+                if b > 0:                # bounded wait: sim-time timer
+                    self._park_timer(os, int(b) * 1_000_000, TK_EPOLL, a)
+        elif op == OP_SLEEP:
+            # sleeping advances SIM time (reference shd-process.c:3055):
+            # park until the deadline timer fires
+            self.parked = ("sleep",)
+            self.park_seq += 1
+            self._park_timer(os, int(b), TK_SLEEP)
+        elif op == OP_RANDOM:
+            # deterministic entropy from the host PRNG (reference
+            # shd-host.c:574; determinism shd-test-determinism.c)
+            n = max(int(b), 0)
+            self._rsp_data(n, os.random_bytes(n))
+        elif op == OP_GETNAME:
+            vs = self.vfds.get(a)
+            if vs is None:
+                self._rsp(-1, ENOTCONN)
+            else:
+                self._rsp(*self._name_of(os, vs, which=int(b)))
         elif op == OP_CLOCK:
             self._rsp(os.now())
         elif op == OP_RESOLVE:
@@ -673,6 +761,23 @@ class ShimApp(HostedApp):
             self._rsp(hid)
         else:
             self._rsp(-1)
+
+    def _name_of(self, os, vs, which):
+        """getsockname (which=0) / getpeername (which=1) answer:
+        (0, host, port) from the connection identity, or the bound
+        port pre-establishment."""
+        if vs.conn is not None:
+            cli_host, cli_port, srv_host, srv_port = vs.conn
+            if which == 0:
+                return (0, os.host_id,
+                        cli_port if vs.is_client else srv_port)
+            return ((0, srv_host, srv_port) if vs.is_client
+                    else (0, cli_host, cli_port))
+        if which == 0:
+            return (0, os.host_id, max(vs.bound_port, 0))
+        if vs.kind == "udp" and vs.dgram_dst is not None:
+            return (0, vs.dgram_dst[0], vs.dgram_dst[1])
+        return (-1, ENOTCONN, 0)
 
     # --- hosted-app callbacks: map device wakes to epoll readiness ---
     def on_start(self, os):
@@ -705,8 +810,8 @@ class ShimApp(HostedApp):
         self._service(os)
 
     def on_accept(self, os, sock, tag, dport=0, peer=(0, 0)):
-        # queue the accepted child on its listener (matched by bound
-        # port; fall back to the only listener when ports are unset)
+        # queue the accepted child on its listener, matched by bound
+        # port (fall back to the only listener when ports are unset)
         target = None
         for vs in self.vfds.values():
             if vs.kind == "listen":
@@ -715,6 +820,7 @@ class ShimApp(HostedApp):
                     if vs.bound_port == dport:
                         break
         if target is not None:
+            matched = (not dport) or target.bound_port == dport
             conn = (int(peer[0]), int(peer[1]), os.host_id,
                     int(dport) or target.bound_port)
             target.accept_q.append((sock, peer[0], peer[1], conn))
@@ -722,8 +828,14 @@ class ShimApp(HostedApp):
             # at the app's accept() call, which it may make arbitrarily
             # later: the client's first pushes land between this wake
             # and that call, and an unsubscribed stream would cap and
-            # die under them (api.PayloadBroker.push)
-            if self._payloads is not None:
+            # die under them (api.PayloadBroker.push). ONLY when the
+            # SYN's port matched the listener — a mismatched fallback
+            # connection may never be accepted, and its subscribed
+            # (cap-exempt) stream would accumulate forever (round-4
+            # advisor); if the app does accept it, _rsp_accept's
+            # _open_streams subscribes then, with the cap protecting
+            # the interim
+            if self._payloads is not None and matched:
                 for d in (0, 1):
                     self._payloads.open(conn + (d,))
                     self._opened.add(conn + (d,))
@@ -751,12 +863,33 @@ class ShimApp(HostedApp):
         self._service(os)
 
     def on_timer(self, os, tag):
-        # epoll_wait timeout expiry: answer 0 events iff the child is
-        # still parked in the SAME wait that armed this timer
-        epfd = tag & 0xFFFFFF
-        seq = tag >> 24
-        if (self.parked is not None and self.parked[0] == "epoll" and
-                (self.parked[1] & 0xFFFFFF) == epfd and
+        kind = (tag >> 20) & 0x7
+        seq = (tag >> 24) & 0x7F
+        operand = tag & 0xFFFFF
+        if kind == TK_GRACE:
+            # deferred payload-stream drop (see OP_CLOSE): drop only if
+            # still reader-less — a peer that subscribed meanwhile owns
+            # the stream until ITS close
+            key = self._grace.pop(operand, None)
+            if (key is not None and self._payloads is not None and
+                    not self._payloads.subscribed(key)):
+                self._payloads.drop(key)
+                self._opened.discard(key)
+        elif (kind == TK_EPOLL and self.parked is not None and
+                self.parked[0] == "epoll" and
+                (self.parked[1] & 0xFFFFF) == operand and
+                seq == (self.park_seq & 0x7F)):
+            # epoll_wait timeout expiry: answer 0 events iff the child
+            # is still parked in the SAME wait that armed this timer
+            self.parked = None
+            self._rsp(0)
+        elif (kind == TK_POLL and self.parked is not None and
+                self.parked[0] == "poll" and
+                seq == (self.park_seq & 0x7F)):
+            self.parked = None
+            self._rsp_events([])
+        elif (kind == TK_SLEEP and self.parked is not None and
+                self.parked[0] == "sleep" and
                 seq == (self.park_seq & 0x7F)):
             self.parked = None
             self._rsp(0)

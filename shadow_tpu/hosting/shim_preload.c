@@ -1,37 +1,58 @@
-/* LD_PRELOAD shim: run an unmodified epoll-based network client under
- * the simulator.
+/* LD_PRELOAD shim: run an unmodified network binary under the
+ * simulator.
  *
  * The minimal realization of the reference's interposition library
  * (/root/reference/src/preload/shd-interposer.c: 262 PRELOADDEF
  * wrappers dispatching to process_emu_* or the real libc): this shim
- * interposes the socket/epoll/clock surface a typical nonblocking
- * client uses and forwards each call as a fixed-size request over the
- * socketpair inherited in SHADOW_SHIM_FD; the simulator-side peer is
- * shadow_tpu/hosting/shim.py (protocol defined there).
+ * interposes the socket/epoll/poll/select/clock/sleep/entropy surface
+ * a typical network client or server uses and forwards each call as a
+ * fixed-size request over the socketpair inherited in SHADOW_SHIM_FD;
+ * the simulator-side peer is shadow_tpu/hosting/shim.py (protocol
+ * defined there).
  *
- * Virtualization boundary: only fds >= VFD_BASE (handed out by the
- * simulator) are virtual; everything else falls through to the real
- * libc via dlsym(RTLD_NEXT) — same split as the reference's
- * shadow-fd vs OS-fd descriptor tables (shd-host.c fd mapping).
+ * Virtual fd numbering (round 5): a virtual fd IS a real fd number —
+ * each simulated socket/epoll/random-device reserves a kernel fd by
+ * opening /dev/null and the simulator keys its state by that number.
+ * This keeps vfds small and dense (select()'s fd_set caps fds at
+ * FD_SETSIZE=1024, and real apps assume small fds), guarantees no
+ * collision with the process's real fds (the kernel can't hand the
+ * number out twice), and gives close() ordinary semantics (placeholder
+ * and simulator state retire together). The reference solves the same
+ * problem with a shadow descriptor table layered over the process fd
+ * space (shd-host.c fd mapping).
  *
- * Payload note (round 4): the engine still models byte COUNTS, but
- * real payload bytes now ride the control channel host-side: send()
- * ships the app's buffer to the simulator, which stores it per
- * connection (api.PayloadBroker) and returns the true stream contents
- * with each recv() when BOTH endpoints are hosted processes —
- * payload-parsing binaries (HTTP-style request/response) run
- * unmodified. recv() from a MODELED peer still zero-fills; UDP
- * datagram payloads are not materialized.
+ * Virtualized beyond sockets (round 5, reference shd-process.c
+ * equivalents in parens):
+ *  - poll/ppoll/select/pselect on virtual fds (process_emu_poll/
+ *    select, shd-process.c:2606-2899);
+ *  - gettimeofday/time/clock_gettime all read SIMULATED time
+ *    (shd-process.c:4329-4389 — one leaking wallclock call breaks
+ *    determinism);
+ *  - nanosleep/usleep/sleep advance SIM time, not wall time
+ *    (process_emu_nanosleep, shd-process.c:3055);
+ *  - getrandom/getentropy and open("/dev/u?random") serve bytes from
+ *    the host's deterministic PRNG (shd-host.c:574; determinism test
+ *    src/test/determinism/shd-test-determinism.c:15-60);
+ *  - getsockname/getpeername answer the real simulated identity;
+ *  - pthread_create fails LOUDLY (EAGAIN + stderr): a silently-real
+ *    thread would corrupt sim semantics — multi-threaded hosting
+ *    (the reference's rpth + pthread emu, shd-process.c:5074-7449)
+ *    is not implemented.
+ *
+ * Payload note (round 4): the engine models byte COUNTS, but real
+ * payload bytes ride the control channel host-side: send() ships the
+ * app's buffer to the simulator, which stores it per connection
+ * (api.PayloadBroker) and returns the true stream contents with each
+ * recv() when BOTH endpoints are hosted processes. recv() from a
+ * MODELED peer zero-fills; UDP datagram payloads are not materialized.
  *
  * Blocking semantics (round 4): each vfd tracks O_NONBLOCK (fcntl /
- * SOCK_NONBLOCK at creation). Nonblocking fds keep the historical
- * EINPROGRESS/EAGAIN returns; BLOCKING connect/recv/recvfrom/accept
+ * SOCK_NONBLOCK at creation). Nonblocking fds keep EINPROGRESS/EAGAIN
+ * returns; BLOCKING connect/recv/recvfrom/accept/poll/epoll_wait
  * forward a block flag and the simulator parks the call until the
  * matching wake (shim.py _maybe_unpark) — the analogue of the
  * reference's rpth green-thread block/reenter (shd-process.c:
- * 1076-1263), which is what lets stock blocking-socket binaries
- * (e.g. a python interpreter running a plain socket script) run
- * unmodified.
+ * 1076-1263).
  */
 #define _GNU_SOURCE
 #include <dlfcn.h>
@@ -39,52 +60,57 @@
 #include <fcntl.h>
 #include <netdb.h>
 #include <netinet/in.h>
+#include <poll.h>
+#include <pthread.h>
+#include <stdarg.h>
 #include <stdint.h>
+#include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
 #include <sys/epoll.h>
 #include <sys/ioctl.h>
+#include <sys/random.h>
+#include <sys/select.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <time.h>
 #include <unistd.h>
 
-#define VFD_BASE (1 << 20)
-#define NB_CAP (1 << 16)
+#define FD_CAP (1 << 16)
 
-/* per-vfd O_NONBLOCK bits (vfds are handed out sequentially from
- * VFD_BASE by shim.py, so a small dense table suffices) */
-static unsigned char nb_flags[NB_CAP];
+/* per-fd state bits (vfds are real fd numbers < FD_CAP in practice;
+ * an fd past the cap simply cannot become virtual) */
+#define VS_VFD 1      /* simulator-managed fd */
+#define VS_NB 2       /* O_NONBLOCK */
+#define VS_DGRAM 4    /* SOCK_DGRAM: sends never attach payload */
+#define VS_RANDOM 8   /* /dev/u?random: reads serve host PRNG bytes */
+static unsigned char vstate[FD_CAP];
 
-static int vfd_nb(int fd) {
-    int i = fd - VFD_BASE;
-    return (i >= 0 && i < NB_CAP) ? nb_flags[i] : 0;
+static int is_vfd(int fd) {
+    return fd >= 0 && fd < FD_CAP && (vstate[fd] & VS_VFD);
 }
+
+static int vfd_nb(int fd) { return is_vfd(fd) && (vstate[fd] & VS_NB); }
 
 static void vfd_set_nb(int fd, int on) {
-    int i = fd - VFD_BASE;
-    if (i >= 0 && i < NB_CAP) nb_flags[i] = (unsigned char)(on != 0);
+    if (fd >= 0 && fd < FD_CAP) {
+        if (on) vstate[fd] |= VS_NB; else vstate[fd] &= ~VS_NB;
+    }
 }
 
-/* per-vfd SOCK_DGRAM bit: datagram sends never attach payload (UDP
- * contents are not materialized). Never cleared on close — shim.py
- * mirrors this table so both ends agree on framing for any vfd. */
-static unsigned char dg_flags[NB_CAP];
-
-static int vfd_dg(int fd) {
-    int i = fd - VFD_BASE;
-    return (i >= 0 && i < NB_CAP) ? dg_flags[i] : 0;
-}
+static int vfd_dg(int fd) { return is_vfd(fd) && (vstate[fd] & VS_DGRAM); }
 
 enum {
     OP_SOCKET = 1, OP_CONNECT, OP_SEND, OP_RECV, OP_CLOSE, OP_SHUTDOWN,
     OP_EPOLL_CREATE, OP_EPOLL_CTL, OP_EPOLL_WAIT, OP_CLOCK, OP_RESOLVE,
     OP_BIND, OP_LISTEN, OP_ACCEPT, OP_SENDTO, OP_RECVFROM,
+    OP_SLEEP, OP_POLL, OP_RANDOM, OP_GETNAME,
 };
 
 struct req { int32_t op; int32_t a; int64_t b; int64_t c; char name[64]; };
 struct rsp { int64_t r0; int64_t r1; int64_t r2; };
-/* OP_EPOLL_WAIT responses with r0 = n > 0 are followed by n of these
- * (multi-event wait honoring maxevents; see shim.py _rsp_events) */
+/* OP_EPOLL_WAIT / OP_POLL responses with r0 = n > 0 are followed by n
+ * of these (fd, events/revents pairs; see shim.py _rsp_events) */
 struct evpair { int64_t fd; int64_t events; };
 
 static int chan_fd = -1;
@@ -103,6 +129,8 @@ static int (*real_clock_gettime)(clockid_t, struct timespec *);
 static int (*real_getaddrinfo)(const char *, const char *,
                                const struct addrinfo *,
                                struct addrinfo **);
+static int (*real_poll)(struct pollfd *, nfds_t, int);
+static int (*real_open)(const char *, int, ...);
 
 static void shim_init(void) {
     static int done = 0;
@@ -121,6 +149,8 @@ static void shim_init(void) {
     real_epoll_wait = dlsym(RTLD_NEXT, "epoll_wait");
     real_clock_gettime = dlsym(RTLD_NEXT, "clock_gettime");
     real_getaddrinfo = dlsym(RTLD_NEXT, "getaddrinfo");
+    real_poll = dlsym(RTLD_NEXT, "poll");
+    real_open = dlsym(RTLD_NEXT, "open");
     const char *env = getenv("SHADOW_SHIM_FD");
     if (env) chan_fd = atoi(env);
 }
@@ -130,20 +160,41 @@ static int active(void) {
     return chan_fd >= 0;
 }
 
+/* Reserve a kernel fd number for a new virtual fd. The placeholder
+ * (an open /dev/null) pins the number so no real open can collide
+ * with it; the simulator keys its state by this number. Returns -1
+ * (EMFILE/ENFILE errno from open) on failure. */
+static int vfd_reserve(void) {
+    int fd = real_open("/dev/null", O_RDWR | O_CLOEXEC);
+    if (fd < 0) return -1;
+    if (fd >= FD_CAP) {   /* cannot track state past the table */
+        real_close(fd);
+        errno = EMFILE;
+        return -1;
+    }
+    vstate[fd] = VS_VFD;
+    return fd;
+}
+
+static void vfd_release(int fd) {
+    if (fd >= 0 && fd < FD_CAP) {
+        vstate[fd] = 0;
+        real_close(fd);
+    }
+}
+
 /* one lockstep request/response on the control channel.
  *
- * Payload framing (round 4): OP_SEND requests on STREAM sockets are
- * followed by exactly b payload bytes (the app's REAL buffer — the
- * simulator stores them so hosted<->hosted connections deliver true
- * contents); datagram OP_SEND and OP_SENDTO attach nothing (UDP
- * contents are not materialized). Successful OP_RECV responses with
- * r1 == 1 are followed by exactly r0 payload bytes (real stream
- * contents); r1 == 0 means no live stream covers the read (modeled
- * peer) and the CALLER zero-fills locally — no per-byte channel
- * traffic on that path. OP_RECVFROM responses never carry payload
- * (r1/r2 hold the datagram source). tx/txn attach request payload;
- * rx/rxcap receive response payload. A short read/write kills the
- * channel (EPIPE) rather than desynchronize the framing. */
+ * Payload framing: OP_SEND requests on STREAM sockets are followed by
+ * exactly b payload bytes (the app's REAL buffer); OP_POLL requests
+ * are followed by a * 16 bytes of evpairs (the virtual pollfd set).
+ * Datagram OP_SEND and OP_SENDTO attach nothing. Successful OP_RECV /
+ * OP_RANDOM responses with r1 == 1 are followed by exactly r0 payload
+ * bytes; r1 == 0 means no live stream covers the read (modeled peer)
+ * and the CALLER zero-fills locally. OP_RECVFROM responses never carry
+ * payload (r1/r2 hold the datagram source). tx/txn attach request
+ * payload; rx/rxcap receive response payload. A short read/write kills
+ * the channel (EPIPE) rather than desynchronize the framing. */
 static struct rsp call2(int32_t op, int32_t a, int64_t b, int64_t c,
                         const char *name, const void *tx, size_t txn,
                         void *rx, size_t rxcap) {
@@ -198,20 +249,18 @@ static struct rsp call(int32_t op, int32_t a, int64_t b, int64_t c,
     return call2(op, a, b, c, name, NULL, 0, NULL, 0);
 }
 
-static int is_vfd(int fd) { return fd >= VFD_BASE; }
-
 /* --- interposed surface ------------------------------------------------ */
 
 int socket(int domain, int type, int protocol) {
     if (!active() || domain != AF_INET)
         return real_socket(domain, type, protocol);
     int dgram = (type & 0xFF) == SOCK_DGRAM;
-    int fd = (int)call(OP_SOCKET, dgram, 0, 0, NULL).r0;
-    if (fd >= 0) {
-        vfd_set_nb(fd, (type & SOCK_NONBLOCK) != 0);
-        int i = fd - VFD_BASE;
-        if (i >= 0 && i < NB_CAP) dg_flags[i] = (unsigned char)dgram;
-    }
+    int fd = vfd_reserve();
+    if (fd < 0) return -1;
+    struct rsp r = call(OP_SOCKET, dgram, fd, 0, NULL);
+    if (r.r0 < 0) { vfd_release(fd); errno = EMFILE; return -1; }
+    if (type & SOCK_NONBLOCK) vstate[fd] |= VS_NB;
+    if (dgram) vstate[fd] |= VS_DGRAM;
     return fd;
 }
 
@@ -245,9 +294,11 @@ int accept4(int fd, struct sockaddr *addr, socklen_t *len, int flags) {
         if (!real_accept4) real_accept4 = dlsym(RTLD_NEXT, "accept4");
         return real_accept4(fd, addr, len, flags);
     }
-    struct rsp r = call(OP_ACCEPT, fd, vfd_nb(fd) ? 0 : 1, 0, NULL);
-    if (r.r0 < 0) { errno = (int)r.r1; return -1; }
-    if (flags & SOCK_NONBLOCK) vfd_set_nb((int)r.r0, 1);
+    int cfd = vfd_reserve();   /* the child's number, picked up front */
+    if (cfd < 0) return -1;
+    struct rsp r = call(OP_ACCEPT, fd, vfd_nb(fd) ? 0 : 1, cfd, NULL);
+    if (r.r0 < 0) { vfd_release(cfd); errno = (int)r.r1; return -1; }
+    if (flags & SOCK_NONBLOCK) vstate[cfd] |= VS_NB;
     if (addr && len && *len >= sizeof(struct sockaddr_in)) {
         struct sockaddr_in *a = (struct sockaddr_in *)addr;
         memset(a, 0, sizeof *a);
@@ -256,7 +307,7 @@ int accept4(int fd, struct sockaddr *addr, socklen_t *len, int flags) {
         a->sin_port = htons((uint16_t)r.r2);
         *len = sizeof *a;
     }
-    return (int)r.r0;
+    return cfd;
 }
 
 int accept(int fd, struct sockaddr *addr, socklen_t *len) {
@@ -334,12 +385,15 @@ ssize_t send(int fd, const void *buf, size_t n, int flags) {
      * sends attach nothing — UDP contents are not materialized. */
     if (vfd_dg(fd))
         return (ssize_t)call(OP_SEND, fd, (int64_t)n, 0, NULL).r0;
-    return (ssize_t)call2(OP_SEND, fd, (int64_t)n, 0, NULL,
+    return (ssize_t)call2(OP_SEND, fd, (int64_t)n, 1, NULL,
                           buf, n, NULL, 0).r0;
 }
 
 ssize_t recv(int fd, void *buf, size_t n, int flags) {
     if (!active() || !is_vfd(fd)) return real_recv(fd, buf, n, flags);
+    if (vstate[fd] & VS_RANDOM) {       /* via recv on a random vfd */
+        errno = ENOTSOCK; return -1;
+    }
     int blk = !vfd_nb(fd) && !(flags & MSG_DONTWAIT);
     /* r1 == 1: the response carries the true stream contents (hosted
      * peer); r1 == 0: modeled peer, zero-fill locally */
@@ -353,6 +407,21 @@ ssize_t recv(int fd, void *buf, size_t n, int flags) {
     return (ssize_t)r.r0;
 }
 
+/* serve n deterministic PRNG bytes from the simulator (chunked so one
+ * huge read cannot wedge the channel) */
+static ssize_t random_fill(void *buf, size_t n) {
+    size_t got = 0;
+    while (got < n) {
+        size_t k = n - got;
+        if (k > (1 << 16)) k = 1 << 16;
+        struct rsp r = call2(OP_RANDOM, 0, (int64_t)k, 0, NULL,
+                             NULL, 0, (char *)buf + got, k);
+        if (r.r0 <= 0) return got ? (ssize_t)got : -1;
+        got += (size_t)r.r0;
+    }
+    return (ssize_t)got;
+}
+
 ssize_t write(int fd, const void *buf, size_t n) {
     if (active() && is_vfd(fd)) return send(fd, buf, n, 0);
     shim_init();
@@ -360,7 +429,10 @@ ssize_t write(int fd, const void *buf, size_t n) {
 }
 
 ssize_t read(int fd, void *buf, size_t n) {
-    if (active() && is_vfd(fd)) return recv(fd, buf, n, 0);
+    if (active() && is_vfd(fd)) {
+        if (vstate[fd] & VS_RANDOM) return random_fill(buf, n);
+        return recv(fd, buf, n, 0);
+    }
     shim_init();
     return real_read(fd, buf, n);
 }
@@ -372,12 +444,19 @@ int shutdown(int fd, int how) {
 
 int close(int fd) {
     if (!active() || !is_vfd(fd)) { shim_init(); return real_close(fd); }
-    return (int)call(OP_CLOSE, fd, 0, 0, NULL).r0;
+    int rnd = vstate[fd] & VS_RANDOM;
+    int rc = rnd ? 0 : (int)call(OP_CLOSE, fd, 0, 0, NULL).r0;
+    vfd_release(fd);        /* free the placeholder + state bits */
+    return rc;
 }
 
 int epoll_create1(int flags) {
     if (!active()) return real_epoll_create1(flags);
-    return (int)call(OP_EPOLL_CREATE, 0, 0, 0, NULL).r0;
+    int fd = vfd_reserve();
+    if (fd < 0) return -1;
+    struct rsp r = call(OP_EPOLL_CREATE, 0, fd, 0, NULL);
+    if (r.r0 < 0) { vfd_release(fd); errno = EMFILE; return -1; }
+    return fd;
 }
 
 int epoll_create(int size) { (void)size; return epoll_create1(0); }
@@ -389,15 +468,9 @@ int epoll_ctl(int epfd, int op, int fd, struct epoll_event *ev) {
     return (int)call(OP_EPOLL_CTL, epfd, packed, fd, NULL).r0;
 }
 
-int epoll_wait(int epfd, struct epoll_event *evs, int maxevents,
-               int timeout) {
-    if (!active() || !is_vfd(epfd))
-        return real_epoll_wait(epfd, evs, maxevents, timeout);
-    if (maxevents < 1) { errno = EINVAL; return -1; }
-    struct rsp r = call(OP_EPOLL_WAIT, epfd, timeout, maxevents, NULL);
-    if (r.r0 <= 0) return (int)r.r0;
-    /* r0 = n ready events; read the n trailing (fd, events) pairs */
-    int n = (int)r.r0;
+/* read n trailing evpairs of a wait/poll response into out[] (cap
+ * entries); returns n or -1 on channel failure */
+static int read_evpairs(int n, struct evpair *out, int cap) {
     for (int i = 0; i < n; i++) {
         struct evpair p;
         size_t off = 0;
@@ -416,11 +489,172 @@ int epoll_wait(int epfd, struct epoll_event *evs, int maxevents,
             }
             off += (size_t)m;
         }
+        if (i < cap) out[i] = p;
+    }
+    return n;
+}
+
+int epoll_wait(int epfd, struct epoll_event *evs, int maxevents,
+               int timeout) {
+    if (!active() || !is_vfd(epfd))
+        return real_epoll_wait(epfd, evs, maxevents, timeout);
+    if (maxevents < 1) { errno = EINVAL; return -1; }
+    struct rsp r = call(OP_EPOLL_WAIT, epfd, timeout, maxevents, NULL);
+    if (r.r0 <= 0) return (int)r.r0;
+    /* r0 = n <= maxevents ready events (the sim honors maxevents);
+     * read the n trailing (fd, events) pairs straight into evs */
+    int n = (int)r.r0;
+    if (n > maxevents) { chan_fd = -1; errno = EPIPE; return -1; }
+    for (int i = 0; i < n; i++) {
+        struct evpair p;
+        if (read_evpairs(1, &p, 1) < 0) return -1;
         evs[i].events = (uint32_t)p.events;
         evs[i].data.fd = (int)p.fd;
     }
     return n;
 }
+
+int epoll_pwait(int epfd, struct epoll_event *evs, int maxevents,
+                int timeout, const sigset_t *mask) {
+    (void)mask;   /* no signals are delivered to parked hosted code */
+    if (!active() || !is_vfd(epfd)) {
+        static int (*real_ep)(int, struct epoll_event *, int, int,
+                              const sigset_t *);
+        if (!real_ep) real_ep = dlsym(RTLD_NEXT, "epoll_pwait");
+        return real_ep(epfd, evs, maxevents, timeout, mask);
+    }
+    return epoll_wait(epfd, evs, maxevents, timeout);
+}
+
+/* --- poll / select ----------------------------------------------------- */
+
+/* Forward the VIRTUAL subset of a pollfd array to the simulator.
+ * Mixed sets (virtual + real fds) wait only on the virtual ones —
+ * real fds report no events (documented limitation: the simulator
+ * cannot wait on kernel fds, and hosted binaries' interesting fds are
+ * exactly the virtual ones). Returns the poll() result over fds[]. */
+static int vpoll(struct pollfd *fds, nfds_t nfds, int timeout_ms) {
+    struct evpair want[256];
+    int nv = 0;
+    for (nfds_t i = 0; i < nfds && nv < 256; i++) {
+        fds[i].revents = 0;
+        if (is_vfd(fds[i].fd)) {
+            want[nv].fd = fds[i].fd;
+            want[nv].events = fds[i].events;
+            nv++;
+        }
+    }
+    if (nv == 0) return real_poll(fds, nfds, timeout_ms);
+    struct rsp r = call2(OP_POLL, nv, (int64_t)nv * sizeof(struct evpair),
+                         timeout_ms, NULL, want,
+                         (size_t)nv * sizeof(struct evpair), NULL, 0);
+    if (r.r0 < 0) { errno = (int)r.r1 ? (int)r.r1 : EPIPE; return -1; }
+    int n = (int)r.r0;
+    struct evpair pairs[256];
+    if (n > 256) { chan_fd = -1; errno = EPIPE; return -1; }
+    if (n > 0 && read_evpairs(n, pairs, 256) < 0) return -1;
+    int hits = 0;
+    for (nfds_t i = 0; i < nfds; i++) {
+        for (int j = 0; j < n; j++) {
+            if (pairs[j].fd == fds[i].fd) {
+                fds[i].revents = (short)pairs[j].events;
+                break;
+            }
+        }
+        if (fds[i].revents) hits++;
+    }
+    return hits;
+}
+
+int poll(struct pollfd *fds, nfds_t nfds, int timeout) {
+    if (!active()) { shim_init(); return real_poll(fds, nfds, timeout); }
+    return vpoll(fds, nfds, timeout);
+}
+
+int ppoll(struct pollfd *fds, nfds_t nfds, const struct timespec *ts,
+          const sigset_t *mask) {
+    (void)mask;
+    if (!active()) {
+        static int (*real_pp)(struct pollfd *, nfds_t,
+                              const struct timespec *, const sigset_t *);
+        if (!real_pp) real_pp = dlsym(RTLD_NEXT, "ppoll");
+        return real_pp(fds, nfds, ts, mask);
+    }
+    int ms = ts ? (int)(ts->tv_sec * 1000 +
+                        (ts->tv_nsec + 999999) / 1000000) : -1;
+    return vpoll(fds, nfds, ms);
+}
+
+/* select() rebuilt on vpoll: only meaningful for fds < FD_SETSIZE —
+ * which all vfds are, because a vfd IS a small real fd number */
+static int vselect(int nfds, fd_set *rs, fd_set *ws, fd_set *es,
+                   int timeout_ms) {
+    struct pollfd pfds[FD_SETSIZE];
+    int np = 0;
+    for (int fd = 0; fd < nfds && fd < FD_SETSIZE; fd++) {
+        short ev = 0;
+        if (rs && FD_ISSET(fd, rs)) ev |= POLLIN;
+        if (ws && FD_ISSET(fd, ws)) ev |= POLLOUT;
+        if (es && FD_ISSET(fd, es)) ev |= POLLPRI;
+        if (ev) { pfds[np].fd = fd; pfds[np].events = ev; np++; }
+    }
+    int rc = vpoll(pfds, np, timeout_ms);
+    if (rc < 0) return -1;
+    if (rs) FD_ZERO(rs);
+    if (ws) FD_ZERO(ws);
+    if (es) FD_ZERO(es);
+    int bits = 0;
+    for (int i = 0; i < np; i++) {
+        short rev = pfds[i].revents;
+        if (rs && (rev & (POLLIN | POLLHUP | POLLERR | POLLRDHUP))) {
+            FD_SET(pfds[i].fd, rs); bits++;
+        }
+        if (ws && (rev & (POLLOUT | POLLERR))) {
+            FD_SET(pfds[i].fd, ws); bits++;
+        }
+    }
+    return bits;
+}
+
+static int fdset_has_vfd(int nfds, fd_set *s) {
+    if (!s) return 0;
+    for (int fd = 0; fd < nfds && fd < FD_SETSIZE; fd++)
+        if (FD_ISSET(fd, s) && is_vfd(fd)) return 1;
+    return 0;
+}
+
+int select(int nfds, fd_set *rs, fd_set *ws, fd_set *es,
+           struct timeval *tv) {
+    shim_init();
+    static int (*real_select)(int, fd_set *, fd_set *, fd_set *,
+                              struct timeval *);
+    if (!real_select) real_select = dlsym(RTLD_NEXT, "select");
+    if (!active() || (!fdset_has_vfd(nfds, rs) &&
+                      !fdset_has_vfd(nfds, ws) &&
+                      !fdset_has_vfd(nfds, es)))
+        return real_select(nfds, rs, ws, es, tv);
+    int ms = tv ? (int)(tv->tv_sec * 1000 +
+                        (tv->tv_usec + 999) / 1000) : -1;
+    return vselect(nfds, rs, ws, es, ms);
+}
+
+int pselect(int nfds, fd_set *rs, fd_set *ws, fd_set *es,
+            const struct timespec *ts, const sigset_t *mask) {
+    (void)mask;
+    shim_init();
+    static int (*real_ps)(int, fd_set *, fd_set *, fd_set *,
+                          const struct timespec *, const sigset_t *);
+    if (!real_ps) real_ps = dlsym(RTLD_NEXT, "pselect");
+    if (!active() || (!fdset_has_vfd(nfds, rs) &&
+                      !fdset_has_vfd(nfds, ws) &&
+                      !fdset_has_vfd(nfds, es)))
+        return real_ps(nfds, rs, ws, es, ts, mask);
+    int ms = ts ? (int)(ts->tv_sec * 1000 +
+                        (ts->tv_nsec + 999999) / 1000000) : -1;
+    return vselect(nfds, rs, ws, es, ms);
+}
+
+/* --- time, sleep, entropy ---------------------------------------------- */
 
 int clock_gettime(clockid_t clk, struct timespec *ts) {
     if (!active()) return real_clock_gettime(clk, ts);
@@ -429,6 +663,197 @@ int clock_gettime(clockid_t clk, struct timespec *ts) {
     ts->tv_nsec = ns % 1000000000LL;
     return 0;
 }
+
+int gettimeofday(struct timeval *tv, void *tz) {
+    (void)tz;
+    if (!active()) {
+        static int (*real_gtod)(struct timeval *, void *);
+        if (!real_gtod) real_gtod = dlsym(RTLD_NEXT, "gettimeofday");
+        return real_gtod(tv, tz);
+    }
+    if (tv) {
+        int64_t ns = call(OP_CLOCK, CLOCK_REALTIME, 0, 0, NULL).r0;
+        tv->tv_sec = ns / 1000000000LL;
+        tv->tv_usec = (ns % 1000000000LL) / 1000;
+    }
+    return 0;
+}
+
+time_t time(time_t *tloc) {
+    if (!active()) {
+        static time_t (*real_time)(time_t *);
+        if (!real_time) real_time = dlsym(RTLD_NEXT, "time");
+        return real_time(tloc);
+    }
+    time_t t = (time_t)(call(OP_CLOCK, CLOCK_REALTIME, 0, 0, NULL).r0 /
+                        1000000000LL);
+    if (tloc) *tloc = t;
+    return t;
+}
+
+/* sleeping advances SIMULATED time: the call parks until a sim-time
+ * timer fires (reference process_emu_nanosleep, shd-process.c:3055 —
+ * a real sleep would burn wallclock while sim time is frozen) */
+static int vsleep_ns(int64_t ns) {
+    if (ns <= 0) return 0;
+    struct rsp r = call(OP_SLEEP, 0, ns, 0, NULL);
+    return (int)r.r0;
+}
+
+int nanosleep(const struct timespec *req, struct timespec *rem) {
+    if (!active()) {
+        static int (*real_ns)(const struct timespec *, struct timespec *);
+        if (!real_ns) real_ns = dlsym(RTLD_NEXT, "nanosleep");
+        return real_ns(req, rem);
+    }
+    if (!req || req->tv_sec < 0 || req->tv_nsec < 0 ||
+        req->tv_nsec > 999999999L) {
+        errno = EINVAL;
+        return -1;
+    }
+    int rc = vsleep_ns(req->tv_sec * 1000000000LL + req->tv_nsec);
+    if (rem) { rem->tv_sec = 0; rem->tv_nsec = 0; }
+    return rc;
+}
+
+int clock_nanosleep(clockid_t clk, int flags, const struct timespec *req,
+                    struct timespec *rem) {
+    if (!active()) {
+        static int (*real_cns)(clockid_t, int, const struct timespec *,
+                               struct timespec *);
+        if (!real_cns) real_cns = dlsym(RTLD_NEXT, "clock_nanosleep");
+        return real_cns(clk, flags, req, rem);
+    }
+    if (flags & TIMER_ABSTIME) {
+        struct timespec now;
+        clock_gettime(clk, &now);
+        int64_t d = (req->tv_sec - now.tv_sec) * 1000000000LL +
+                    (req->tv_nsec - now.tv_nsec);
+        vsleep_ns(d);
+        return 0;
+    }
+    return nanosleep(req, rem) ? errno : 0;
+}
+
+int usleep(useconds_t us) {
+    if (!active()) {
+        static int (*real_us)(useconds_t);
+        if (!real_us) real_us = dlsym(RTLD_NEXT, "usleep");
+        return real_us(us);
+    }
+    return vsleep_ns((int64_t)us * 1000);
+}
+
+unsigned int sleep(unsigned int seconds) {
+    if (!active()) {
+        static unsigned int (*real_sleep)(unsigned int);
+        if (!real_sleep) real_sleep = dlsym(RTLD_NEXT, "sleep");
+        return real_sleep(seconds);
+    }
+    vsleep_ns((int64_t)seconds * 1000000000LL);
+    return 0;
+}
+
+/* entropy from the host's deterministic PRNG (reference shd-host.c:574
+ * random source; determinism dual-run test shd-test-determinism.c) */
+ssize_t getrandom(void *buf, size_t n, unsigned int flags) {
+    (void)flags;
+    if (!active()) {
+        static ssize_t (*real_gr)(void *, size_t, unsigned int);
+        if (!real_gr) real_gr = dlsym(RTLD_NEXT, "getrandom");
+        if (real_gr) return real_gr(buf, n, flags);
+        errno = ENOSYS;
+        return -1;
+    }
+    return random_fill(buf, n);
+}
+
+int getentropy(void *buf, size_t n) {
+    if (!active()) {
+        static int (*real_ge)(void *, size_t);
+        if (!real_ge) real_ge = dlsym(RTLD_NEXT, "getentropy");
+        if (real_ge) return real_ge(buf, n);
+        errno = ENOSYS;
+        return -1;
+    }
+    if (n > 256) { errno = EIO; return -1; }
+    return random_fill(buf, n) == (ssize_t)n ? 0 : -1;
+}
+
+static int is_random_path(const char *path) {
+    return path && (!strcmp(path, "/dev/random") ||
+                    !strcmp(path, "/dev/urandom") ||
+                    !strcmp(path, "/dev/srandom"));
+}
+
+int open(const char *path, int flags, ...) {
+    va_list ap;
+    va_start(ap, flags);
+    mode_t mode = va_arg(ap, mode_t);
+    va_end(ap);
+    shim_init();
+    if (active() && is_random_path(path)) {
+        int fd = vfd_reserve();
+        if (fd >= 0) vstate[fd] |= VS_RANDOM;
+        return fd;
+    }
+    return real_open(path, flags, mode);
+}
+
+int open64(const char *path, int flags, ...) {
+    va_list ap;
+    va_start(ap, flags);
+    mode_t mode = va_arg(ap, mode_t);
+    va_end(ap);
+    shim_init();
+    if (active() && is_random_path(path)) {
+        int fd = vfd_reserve();
+        if (fd >= 0) vstate[fd] |= VS_RANDOM;
+        return fd;
+    }
+    static int (*real_open64)(const char *, int, ...);
+    if (!real_open64) real_open64 = dlsym(RTLD_NEXT, "open64");
+    return real_open64(path, flags, mode);
+}
+
+int openat(int dirfd, const char *path, int flags, ...) {
+    va_list ap;
+    va_start(ap, flags);
+    mode_t mode = va_arg(ap, mode_t);
+    va_end(ap);
+    shim_init();
+    if (active() && is_random_path(path)) {
+        int fd = vfd_reserve();
+        if (fd >= 0) vstate[fd] |= VS_RANDOM;
+        return fd;
+    }
+    static int (*real_openat)(int, const char *, int, ...);
+    if (!real_openat) real_openat = dlsym(RTLD_NEXT, "openat");
+    return real_openat(dirfd, path, flags, mode);
+}
+
+/* --- threads: fail LOUDLY until multi-threaded hosting exists ---------- */
+
+int pthread_create(pthread_t *thread, const pthread_attr_t *attr,
+                   void *(*start)(void *), void *arg) {
+    shim_init();
+    if (!active()) {
+        static int (*real_pc)(pthread_t *, const pthread_attr_t *,
+                              void *(*)(void *), void *);
+        if (!real_pc) real_pc = dlsym(RTLD_NEXT, "pthread_create");
+        return real_pc(thread, attr, start, arg);
+    }
+    (void)thread; (void)attr; (void)start; (void)arg;
+    /* A silently-real thread would make raw libc calls outside the
+     * lockstep channel protocol and corrupt sim semantics — refuse
+     * visibly instead (the reference runs threads as rpth green
+     * threads, shd-process.c:5074-7449; not implemented here). */
+    fprintf(stderr, "shadow-shim: pthread_create refused — "
+            "multi-threaded hosted processes are not supported\n");
+    return EAGAIN;
+}
+
+/* --- name service & identity ------------------------------------------- */
 
 int getaddrinfo(const char *node, const char *service,
                 const struct addrinfo *hints, struct addrinfo **res) {
@@ -459,25 +884,31 @@ void freeaddrinfo(struct addrinfo *res) {
     if (res) { free(res->ai_addr); free(res); }
 }
 
-/* CPython's socket(fileno=fd) — the path accept() takes to wrap an
- * accepted fd — calls getsockname() to detect the address family; an
- * uninterposed call would hit the real kernel with a virtual fd
- * (EBADF) and kill a hosted python SERVER at its first accept. The
- * shim answers AF_INET with a zero address: callers use the family,
- * and peer identity comes from accept4's filled sockaddr instead. */
+/* the real simulated identity (round 5; was fixed zeros): servers
+ * that bind port 0 / learn their port via getsockname, and apps that
+ * key peers by getpeername, see true virtual addresses */
+static int vgetname(int fd, struct sockaddr *addr, socklen_t *len,
+                    int which) {
+    struct rsp r = call(OP_GETNAME, fd, which, 0, NULL);
+    if (r.r0 < 0) { errno = (int)r.r1 ? (int)r.r1 : ENOTCONN; return -1; }
+    if (addr && len && *len >= sizeof(struct sockaddr_in)) {
+        struct sockaddr_in *a = (struct sockaddr_in *)addr;
+        memset(a, 0, sizeof *a);
+        a->sin_family = AF_INET;
+        a->sin_addr.s_addr = (uint32_t)r.r1;
+        a->sin_port = htons((uint16_t)r.r2);
+        *len = sizeof *a;
+    }
+    return 0;
+}
+
 int getsockname(int fd, struct sockaddr *addr, socklen_t *len) {
     if (!active() || !is_vfd(fd)) {
         static int (*real_gsn)(int, struct sockaddr *, socklen_t *);
         if (!real_gsn) real_gsn = dlsym(RTLD_NEXT, "getsockname");
         return real_gsn(fd, addr, len);
     }
-    if (addr && len && *len >= sizeof(struct sockaddr_in)) {
-        struct sockaddr_in *a = (struct sockaddr_in *)addr;
-        memset(a, 0, sizeof *a);
-        a->sin_family = AF_INET;
-        *len = sizeof *a;
-    }
-    return 0;
+    return vgetname(fd, addr, len, 0);
 }
 
 int getpeername(int fd, struct sockaddr *addr, socklen_t *len) {
@@ -486,13 +917,7 @@ int getpeername(int fd, struct sockaddr *addr, socklen_t *len) {
         if (!real_gpn) real_gpn = dlsym(RTLD_NEXT, "getpeername");
         return real_gpn(fd, addr, len);
     }
-    if (addr && len && *len >= sizeof(struct sockaddr_in)) {
-        struct sockaddr_in *a = (struct sockaddr_in *)addr;
-        memset(a, 0, sizeof *a);
-        a->sin_family = AF_INET;
-        *len = sizeof *a;
-    }
-    return 0;
+    return vgetname(fd, addr, len, 1);
 }
 
 /* harmless accepted no-ops on virtual fds */
@@ -526,7 +951,7 @@ int ioctl(int fd, unsigned long req, ...) {
         /* FIONBIO is how CPython's internal_setblocking toggles
          * blocking mode on Linux — without this, s.setblocking(False)
          * or any socket timeout in a hosted python script would hit
-         * the real kernel with a virtual fd (EBADF) */
+         * the real kernel with a virtual fd's placeholder */
         if (req == FIONBIO && argp) {
             vfd_set_nb(fd, *(int *)argp != 0);
             return 0;
