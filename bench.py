@@ -280,10 +280,31 @@ def bench_tgen_tcp():
 
 def main():
     _enable_compile_cache()
+    # optional observability (shadow_tpu/obs/README.md): installed
+    # process-wide so ALL configs/reps share one timeline/registry —
+    # Simulation.run() sees the recorders already enabled and leaves
+    # their lifecycle to us. The registry's `sim.*` section then holds
+    # the LAST run's summary (the same dict each _emit line reads).
+    from shadow_tpu.obs import metrics as _MT
+    from shadow_tpu.obs import trace as _TR
+    trace_path = os.environ.get("SHADOW_TPU_TRACE")
+    metrics_path = os.environ.get("SHADOW_TPU_METRICS")
+    if trace_path:
+        _TR.install(trace_path)
+    if metrics_path:
+        _MT.install(metrics_path,
+                    jsonl_path=metrics_path + ".chunks.jsonl")
+    import atexit
+    if trace_path:
+        atexit.register(_TR.finish)
+    if metrics_path:
+        atexit.register(_MT.finish)
     if len(sys.argv) > 1 and sys.argv[1].isdigit():
         # legacy single-config mode: phold-N [stop_s]
         n = int(sys.argv[1])
         stop_s = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+        if metrics_path:
+            _MT.REGISTRY.label = f"phold-{n}"
         base = _run_pyengine(_phold_scenario(min(n, 512), 4),
                              _phold_cfg(min(n, 512)))
         s = _run_compiled(_phold_scenario(n, stop_s), _phold_cfg(n))
@@ -300,6 +321,11 @@ def main():
     t0 = time.perf_counter()
     for fn in (bench_tgen_tcp, bench_gossip, bench_phold):
         try:
+            if metrics_path:
+                # label the registry's chunk lines so N configs x R
+                # reps interleaved in one chunks.jsonl stay
+                # partitionable by run
+                _MT.REGISTRY.label = fn.__name__
             fn()
         except Exception as e:  # pragma: no cover
             print(json.dumps({"metric": fn.__name__, "error": repr(e)}),

@@ -14,21 +14,31 @@
  *    them as hex — under the sim these come from the host's
  *    deterministic PRNG, so two runs print IDENTICAL lines;
  * 4. tries pthread_create — under the sim it must FAIL (EAGAIN), not
- *    silently spawn a real thread.
+ *    silently spawn a real thread;
+ * 5. write()s to /dev/urandom — under the sim this must fail cleanly
+ *    (EBADF), not crash the simulator's protocol handler;
+ * 6. sleeps via poll(NULL,0,ms) + select(0,...,&tv) — the portable
+ *    sleep idioms — and reports the clock delta, which under the sim
+ *    must be SIMULATED time (OP_SLEEP), not frozen.
  *
  * Output (one line each):
  *   clocks mono=<s> real=<s> tod=<s> time=<s>
  *   slept requested=<s> measured=<s>
  *   entropy getrandom=<hex> urandom=<hex>
  *   threads pthread_create=<rc>
+ *   urandomwrite rc=<rc> errno=<errno>
+ *   pollsleep requested=<s> measured=<s>
  */
 #define _GNU_SOURCE
+#include <errno.h>
 #include <fcntl.h>
+#include <poll.h>
 #include <pthread.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
 #include <sys/random.h>
+#include <sys/select.h>
 #include <sys/time.h>
 #include <time.h>
 #include <unistd.h>
@@ -87,5 +97,21 @@ int main(int argc, char **argv) {
     int rc = pthread_create(&th, NULL, thread_main, NULL);
     if (rc == 0) pthread_join(th, NULL);
     printf("threads pthread_create=%d\n", rc);
+
+    errno = 0;
+    int wfd = open("/dev/urandom", O_RDWR);
+    long wrc = wfd >= 0 ? (long)write(wfd, gr, 8) : -2;
+    int werr = errno;
+    if (wfd >= 0) close(wfd);
+    printf("urandomwrite rc=%ld errno=%d\n", wrc, werr);
+
+    struct timespec p0, p1;
+    clock_gettime(CLOCK_MONOTONIC, &p0);
+    poll(NULL, 0, 150);
+    struct timeval ptv = {0, 150 * 1000};
+    select(0, NULL, NULL, NULL, &ptv);
+    clock_gettime(CLOCK_MONOTONIC, &p1);
+    printf("pollsleep requested=0.300 measured=%.3f\n",
+           (p1.tv_sec - p0.tv_sec) + (p1.tv_nsec - p0.tv_nsec) / 1e9);
     return 0;
 }

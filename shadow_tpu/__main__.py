@@ -5,6 +5,16 @@ The L7 equivalent of the reference's ``shadow [options] config.xml``
 shd-options.c:82-140). There is no relaunch/LD_PRELOAD machinery to
 bootstrap — the engine selection is ``--engine`` and the device mesh
 replaces worker threads (``--workers`` maps to mesh shards).
+
+Observability (shadow_tpu/obs/README.md):
+
+  --trace FILE     record a Chrome trace-event timeline of the run
+                   (per-chunk spans with sim-time args; open FILE in
+                   https://ui.perfetto.dev or summarize it with
+                   ``python tools/trace_report.py FILE``)
+  --metrics FILE   write a final metrics snapshot (events/sec, wall
+                   per sim-second, shim per-op counts) to FILE and
+                   per-chunk JSON lines to FILE.chunks.jsonl
 """
 
 from __future__ import annotations
@@ -133,6 +143,12 @@ def main(argv=None):
                         "the constant modeled event cost to zero)")
     p.add_argument("--pcap-dir", default=None, metavar="DIR",
                    help="write pcap files for hosts with logpcap set")
+    p.add_argument("--trace", default=None, metavar="FILE",
+                   help="record a Chrome trace-event timeline "
+                        "(Perfetto / tools/trace_report.py)")
+    p.add_argument("--metrics", default=None, metavar="FILE",
+                   help="write a metrics snapshot to FILE and "
+                        "per-chunk JSON lines to FILE.chunks.jsonl")
     p.add_argument("--checkpoint", default=None, metavar="PATH")
     p.add_argument("--checkpoint-every", type=float, default=0,
                    metavar="SEC")
@@ -240,7 +256,8 @@ def main(argv=None):
                      heartbeat_s=args.heartbeat_frequency, logger=logger,
                      checkpoint_path=args.checkpoint,
                      checkpoint_every_s=args.checkpoint_every,
-                     resume_from=args.resume, pcap_dir=args.pcap_dir)
+                     resume_from=args.resume, pcap_dir=args.pcap_dir,
+                     trace=args.trace, metrics=args.metrics)
     s = report.summary()
     logger.message(report.sim_time_ns, "main",
                    f"done: {s['events']} events in {s['wall_seconds']:.2f}s "

@@ -1582,11 +1582,18 @@ class PyEngine:
 
     # --- main loop ---
     def run(self):
+        from ..obs import metrics as MT
+        from ..obs import trace as TR
         nt = min(self._next_time(h) for h in self.hosts)
         windows = 0
+        ev0 = int(self.stats[:, defs.ST_EVENTS].sum())
         while nt < self.stop and nt < SIMTIME_MAX:
+            if TR.ENABLED:
+                _w0 = TR.TRACER.now()
+                _ws = int(nt)
             wend = min(nt + self.min_jump, self.stop)
             executed = False
+            nexec = 0
             progressed = True
             while progressed:
                 progressed = False
@@ -1594,6 +1601,7 @@ class PyEngine:
                     while host.events and self._next_time(host) < wend:
                         t, seq, kind, pkt = self._q_pop_min(host)
                         self.stats[host.hid, defs.ST_EVENTS] += 1
+                        nexec += 1
                         if kind == EV_APP:
                             self._app(host, t, pkt)
                         elif kind == EV_PKT:
@@ -1608,6 +1616,14 @@ class PyEngine:
                         executed = True
             shipped = self._exchange()
             windows += 1
+            if TR.ENABLED:
+                # the oracle's window loop on the same timeline as the
+                # compiled engine's chunks: span per window (the
+                # tracer's MAX_EVENTS cap bounds long runs)
+                TR.TRACER.complete(
+                    "pyengine.window", _w0,
+                    args={"sim_ns_start": _ws, "sim_ns_end": int(wend),
+                          "events": nexec, "shipped": shipped})
             nt_eq = min(self._next_time(h) for h in self.hosts)
             if executed or shipped:
                 # window-advance bound includes carried arrivals
@@ -1617,4 +1633,9 @@ class PyEngine:
                 # the earliest queue event so jammed queues drain
                 nt = nt_eq
         self.windows = windows
+        if MT.ENABLED:
+            reg = MT.REGISTRY
+            reg.counter("pyengine.windows").inc(windows)
+            reg.counter("pyengine.events").inc(
+                int(self.stats[:, defs.ST_EVENTS].sum()) - ev0)
         return self.stats

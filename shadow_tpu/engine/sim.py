@@ -157,16 +157,23 @@ class SimReport:
         }
 
     def summary(self) -> dict:
+        """The run's headline figures. When the metrics registry is
+        enabled (obs.metrics) the dict is also published as ``sim.*``
+        gauges, so the CLI, the tracker, bench.py and the metrics.json
+        snapshot all read one source of truth."""
         mean_rtt_us = (self.total(defs.ST_RTT_SUM_US) /
                        max(self.total(defs.ST_RTT_COUNT), 1))
-        return {
+        sim_s = self.sim_time_ns / SIMTIME_ONE_SECOND
+        s = {
             "hosts": len(self.host_names),
             "events": self.events,
             "windows": self.windows,
-            "sim_seconds": self.sim_time_ns / SIMTIME_ONE_SECOND,
+            "sim_seconds": sim_s,
             "wall_seconds": self.wall_seconds,
             "events_per_sec": self.events_per_sec,
             "speedup": self.speedup,
+            "wall_per_sim_second": (self.wall_seconds / sim_s
+                                    if sim_s else 0.0),
             "pkts_sent": self.total(defs.ST_PKTS_SENT),
             "pkts_recv": self.total(defs.ST_PKTS_RECV),
             "drop_net": self.total(defs.ST_PKTS_DROP_NET),
@@ -181,6 +188,10 @@ class SimReport:
             "transfers_aborted": self.total(defs.ST_TGEN_ABORT),
             "mean_rtt_us": mean_rtt_us,
         }
+        from ..obs import metrics as M
+        if M.ENABLED:
+            M.REGISTRY.publish("sim", s)
+        return s
 
 
 def auto_engine_config(scenario: Scenario, topo: Topology) -> EngineConfig:
@@ -536,16 +547,77 @@ class Simulation:
     def run(self, verbose: bool = False, mesh=None, heartbeat_s: float = 0,
             logger=None, checkpoint_path: str = None,
             checkpoint_every_s: float = 0,
-            resume_from: str = None, pcap_dir: str = None) -> SimReport:
+            resume_from: str = None, pcap_dir: str = None,
+            trace: str = None, metrics: str = None) -> SimReport:
         """Run to the stop time. With `mesh` (a 1-D jax Mesh over a
         "hosts" axis) the window program runs under shard_map with the
         host dimension block-sharded — same results, N chips.
         `heartbeat_s` > 0 emits tracker heartbeats on that sim-time
         interval (obs.tracker). `checkpoint_path` + `checkpoint_every_s`
         snapshot state periodically; `resume_from` restores one.
+
+        `trace` writes a Chrome trace-event JSON timeline (obs.trace:
+        per-chunk spans with sim-time args, compile/hosting/tracker/
+        pcap/checkpoint spans). `metrics` writes a final metrics.json
+        snapshot (obs.metrics) plus per-chunk JSON lines at
+        ``<metrics>.chunks.jsonl``. Both install the process-global
+        recorders for the duration of this run only; with both unset
+        the chunk loop pays a single boolean check per chunk. If a
+        recorder is ALREADY installed process-wide (an outer harness
+        like bench.py holding one timeline open across runs), the
+        path argument is ignored — this run's records flow into the
+        existing recorder and a warning says so. Under a multi-process
+        mesh every process collects (the per-chunk stats fetch is a
+        collective and must run uniformly) but only process 0 writes
+        files.
         """
         assert not self._ran, "Simulation objects are single-use"
         self._ran = True
+        from ..obs import metrics as MT
+        from ..obs import trace as TR
+        from ..parallel import dist
+        own_tr = own_mt = False
+        if trace is not None or metrics is not None:
+            writer = (not dist.is_multiprocess()
+                      or jax.process_index() == 0)
+            if trace is not None and not TR.ENABLED:
+                TR.install(trace if writer else None)
+                own_tr = True
+            if metrics is not None and not MT.ENABLED:
+                MT.install(metrics if writer else None,
+                           jsonl_path=(metrics + ".chunks.jsonl"
+                                       if writer else None))
+                own_mt = True
+            if ((trace is not None and not own_tr) or
+                    (metrics is not None and not own_mt)):
+                import sys as _sys
+                _sys.stderr.write(
+                    "shadow_tpu: warning: a trace/metrics recorder is "
+                    "already installed process-wide; the path passed "
+                    "to run() is ignored and this run's records flow "
+                    "into the existing recorder\n")
+        try:
+            return self._run_impl(
+                verbose=verbose, mesh=mesh, heartbeat_s=heartbeat_s,
+                logger=logger, checkpoint_path=checkpoint_path,
+                checkpoint_every_s=checkpoint_every_s,
+                resume_from=resume_from, pcap_dir=pcap_dir)
+        finally:
+            if own_tr:
+                TR.finish()
+            if own_mt:
+                MT.finish()
+
+    def _run_impl(self, verbose, mesh, heartbeat_s, logger,
+                  checkpoint_path, checkpoint_every_s, resume_from,
+                  pcap_dir) -> SimReport:
+        from ..obs import metrics as MT
+        from ..obs import trace as TR
+        # hot-loop observability guard: with --trace/--metrics off the
+        # per-chunk cost of the whole obs layer is this one boolean
+        obs_on = TR.ENABLED or MT.ENABLED
+        if TR.ENABLED:
+            _s0 = TR.TRACER.now()
         H = self.cfg.num_hosts
 
         from ..parallel import dist
@@ -654,19 +726,45 @@ class Simulation:
         next_ckpt = (int(checkpoint_every_s * 10**9)
                      if checkpoint_every_s else 0)
         ckpt_at = int(wstart) + next_ckpt if next_ckpt else None
+        if TR.ENABLED:
+            # everything up to here: topology/mesh placement, writers,
+            # checkpoint fingerprint/restore — the pre-loop cost
+            TR.TRACER.complete("run.setup", _s0)
         wall0 = _time.perf_counter()
         first_chunk_wall = None
+        chunk_i = 0
         # jitted once, called per chunk (multiproc pcap ring reset)
         _zeros_like = jax.jit(jnp.zeros_like)
+        # per-chunk events total as a jitted reduction: a replicated
+        # scalar on every process (the eager-t0 pattern above — eager
+        # ops cannot run on non-addressable global arrays) and one
+        # column's sum instead of a full stats gather. Padded inert
+        # rows never execute events, so the all-rows sum equals [:H].
+        _ev_sum = jax.jit(lambda s: jnp.sum(s[:, defs.ST_EVENTS]))
+        # resumed runs restore pre-checkpoint ST_EVENTS with the state:
+        # baseline the per-chunk delta on it or the first chunk's
+        # telemetry would claim the whole pre-checkpoint history
+        prev_events = (int(_ev_sum(hosts.stats))
+                       if obs_on and resume_from else 0)
         while True:
+            if obs_on:
+                _ws0 = int(wstart)
+                _c0 = _time.perf_counter_ns()
             hosts, wstart, wend, n, pc = step(hosts, wstart, wend)
             total_windows += int(n)
             pass_acc += np.asarray(pc)
             if first_chunk_wall is None:
                 # everything after this excludes the cold compile
                 first_chunk_wall = _time.perf_counter() - wall0
+                if TR.ENABLED:
+                    # where the cold XLA build went (the cost model's
+                    # "warm" exclusion) — nested inside the first
+                    # chunk span so self-times attribute correctly
+                    TR.TRACER.complete("compile+first_chunk", _c0)
             ws = int(wstart)
             if self.hosting is not None:
+                if TR.ENABLED:
+                    _h0 = TR.TRACER.now()
                 now = min(ws, int(sh.stop_time))
                 hosts = self.hosting.step(hosts, hp, sh, now)
                 if mesh is not None:
@@ -689,7 +787,11 @@ class Simulation:
                 wstart = nt
                 wend = jnp.where(nt == SIMTIME_MAX, nt, nt + sh.min_jump)
                 ws = int(wstart)
+                if TR.ENABLED:
+                    TR.TRACER.complete("hosting.step", _h0)
             if pcap_on_run:
+                if TR.ENABLED:
+                    _p0 = TR.TRACER.now()
                 # every process participates in the gather (it is a
                 # collective); only process 0 holds a writer
                 tr_t = dist.gather_stats(hosts.tr_time)
@@ -705,8 +807,12 @@ class Simulation:
                 else:
                     hosts = hosts.replace(
                         tr_cnt=jnp.zeros_like(hosts.tr_cnt))
+                if TR.ENABLED:
+                    TR.TRACER.complete("pcap.drain", _p0)
             if tracker is not None and tracker.due(min(ws,
                                                        int(sh.stop_time))):
+                if TR.ENABLED:
+                    _t0 = TR.TRACER.now()
                 from ..obs.tracker import socket_columns
                 # [socket]/[ram] columns are per-process state; under a
                 # multi-process mesh only the stats all-gather exists,
@@ -715,7 +821,11 @@ class Simulation:
                     min(ws, int(sh.stop_time)),
                     dist.gather_stats(hosts.stats)[:H],
                     socks=None if multiproc else socket_columns(hosts))
+                if TR.ENABLED:
+                    TR.TRACER.complete("tracker.heartbeat", _t0)
             if checkpoint_path and ckpt_at is not None and ws >= ckpt_at:
+                if TR.ENABLED:
+                    _k0 = TR.TRACER.now()
                 to_save = hosts
                 if multiproc:
                     # materialize the GLOBAL state on every process
@@ -728,6 +838,42 @@ class Simulation:
                     ckpt.save(checkpoint_path, to_save, ws, int(wend),
                               total_windows, fingerprint)
                 ckpt_at += next_ckpt
+                if TR.ENABLED:
+                    TR.TRACER.complete("checkpoint.save", _k0)
+            if obs_on:
+                # per-chunk sim<->wall correlation: one jitted scalar
+                # reduction per chunk (replicated on every process
+                # under a multi-process mesh — must run uniformly; see
+                # run() docstring) buys the events-executed annotation
+                # on every chunk record
+                sim_end = min(ws, int(sh.stop_time))
+                ev_total = int(_ev_sum(hosts.stats))
+                ev = ev_total - prev_events
+                prev_events = ev_total
+                if TR.ENABLED:
+                    TR.TRACER.complete(
+                        "chunk", _c0,
+                        args={"sim_ns_start": _ws0,
+                              "sim_ns_end": sim_end,
+                              "windows": int(n), "events": ev})
+                if MT.ENABLED:
+                    reg = MT.REGISTRY
+                    reg.counter("engine.chunks").inc()
+                    reg.counter("engine.windows").inc(int(n))
+                    reg.counter("engine.events").inc(ev)
+                    reg.gauge("engine.sim_ns").set(sim_end)
+                    chunk_wall = (_time.perf_counter_ns() - _c0) / 1e9
+                    chunk_sim = max(sim_end - _ws0, 0) / 1e9
+                    reg.chunk(
+                        chunk=chunk_i, sim_ns_start=_ws0,
+                        sim_ns_end=sim_end, windows=int(n), events=ev,
+                        wall_s=round(chunk_wall, 6),
+                        events_per_sec=(round(ev / chunk_wall, 1)
+                                        if chunk_wall else None),
+                        wall_per_sim_second=(
+                            round(chunk_wall / chunk_sim, 6)
+                            if chunk_sim else None))
+                chunk_i += 1
             if verbose:
                 print(f"  t={ws / SIMTIME_ONE_SECOND:.3f}s "
                       f"windows={total_windows}")
@@ -735,6 +881,8 @@ class Simulation:
                 break
         if pcap is not None:
             pcap.close()
+        if TR.ENABLED:
+            _f0 = TR.TRACER.now()
         stats = dist.gather_stats(hosts.stats)[:H]
         wall = _time.perf_counter() - wall0
         self.final_hosts = hosts
@@ -764,11 +912,20 @@ class Simulation:
             "hbm_peak_gbps": float(_os.environ.get(
                 "SHADOW_TPU_HBM_GBPS", "819")),
         }
-        return SimReport(stats=stats, host_names=self.host_names,
-                         sim_time_ns=sim_ns, wall_seconds=wall,
-                         windows=total_windows,
-                         heartbeats=(tracker.lines if tracker else []),
-                         capacity=capacity, cost=cost)
+        report = SimReport(stats=stats, host_names=self.host_names,
+                           sim_time_ns=sim_ns, wall_seconds=wall,
+                           windows=total_windows,
+                           heartbeats=(tracker.lines if tracker else []),
+                           capacity=capacity, cost=cost)
+        if TR.ENABLED:
+            TR.TRACER.complete("report.finalize", _f0)
+        if MT.ENABLED:
+            MT.REGISTRY.gauge("engine.first_chunk_wall_s").set(
+                first_chunk_wall or 0.0)
+            # summary() publishes itself into the registry (sim.*
+            # gauges) — the snapshot's BENCH-diffable section
+            report.summary()
+        return report
 
 
 def run_scenario(scenario: Scenario, **kw) -> SimReport:

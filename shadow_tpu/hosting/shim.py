@@ -64,8 +64,10 @@ from __future__ import annotations
 import os as _os
 import struct
 import subprocess
+import time as _time
 
 from .api import HostedApp, register
+from ..obs import metrics as _MT
 
 REQ = struct.Struct("<iiqq64s")
 RSP = struct.Struct("<qqq")
@@ -91,6 +93,19 @@ OP_SLEEP = 17
 OP_POLL = 18
 OP_RANDOM = 19
 OP_GETNAME = 20
+
+# op code -> metric name (obs.metrics shim.op.* counters and
+# shim.op_us.* latency histograms, recorded per served request)
+OP_NAMES = {
+    OP_SOCKET: "socket", OP_CONNECT: "connect", OP_SEND: "send",
+    OP_RECV: "recv", OP_CLOSE: "close", OP_SHUTDOWN: "shutdown",
+    OP_EPOLL_CREATE: "epoll_create", OP_EPOLL_CTL: "epoll_ctl",
+    OP_EPOLL_WAIT: "epoll_wait", OP_CLOCK: "clock",
+    OP_RESOLVE: "resolve", OP_BIND: "bind", OP_LISTEN: "listen",
+    OP_ACCEPT: "accept", OP_SENDTO: "sendto", OP_RECVFROM: "recvfrom",
+    OP_SLEEP: "sleep", OP_POLL: "poll", OP_RANDOM: "random",
+    OP_GETNAME: "getname",
+}
 
 EPOLLIN = 0x001
 EPOLLOUT = 0x004
@@ -491,15 +506,23 @@ class ShimApp(HostedApp):
         the PEER subscribed stay: it may still be draining bytes I
         sent before exiting (a server that serves, closes and exits
         while the client reads); the peer drops them at its own
-        close/exit."""
+        close/exit. Streams under a close-time GRACE deferral also
+        stay — dropping them here would defeat the grace window for a
+        child that writes, closes and exits before the peer's
+        establishment wake subscribes (the banner-then-close case;
+        round-5 advisor): their pending TK_GRACE timers keep firing
+        after child exit (on_timer runs without the child) and perform
+        the deferred reader-less check then."""
         if self._payloads is None:
             return
+        deferred = set(self._grace.values())
         for key in list(self._opened):
+            if key in deferred:
+                continue
             if key in self._mysubs or not self._payloads.subscribed(key):
                 self._payloads.drop(key)
                 self._opened.discard(key)
         self._mysubs.clear()
-        self._grace.clear()
 
     # --- the service loop: run the child until it blocks ---
     def _service(self, os):
@@ -513,7 +536,14 @@ class ShimApp(HostedApp):
                 if self.proc is not None:
                     self.proc.wait()
                 break
+            # per-op protocol metrics: count + HANDLER latency (a call
+            # that parks is counted when it arrives; the sim-time it
+            # stays parked is not wall cost)
+            _t0 = _time.perf_counter_ns() if _MT.ENABLED else None
             self._handle(os, *req)
+            if _t0 is not None:
+                _MT.shim_op(OP_NAMES.get(req[0], str(req[0])),
+                            _time.perf_counter_ns() - _t0)
         if self.exited:
             self._sweep_streams()
 

@@ -380,6 +380,14 @@ int connect(int fd, const struct sockaddr *addr, socklen_t len) {
 
 ssize_t send(int fd, const void *buf, size_t n, int flags) {
     if (!active() || !is_vfd(fd)) return real_send(fd, buf, n, flags);
+    if (vstate[fd] & VS_RANDOM) {   /* write() to /dev/u?random (app
+        * entropy seeding) — not a socket: forwarding OP_SEND would
+        * make shim.py's handler KeyError on an fd it never tracked
+        * and kill the whole simulation. Refuse like the recv() guard
+        * (round-5 advisor). */
+        errno = EBADF;
+        return -1;
+    }
     /* stream sends carry the REAL payload: hosted<->hosted TCP
      * connections deliver true bytes (api.PayloadBroker). Datagram
      * sends attach nothing — UDP contents are not materialized. */
@@ -528,6 +536,8 @@ int epoll_pwait(int epfd, struct epoll_event *evs, int maxevents,
 
 /* --- poll / select ----------------------------------------------------- */
 
+static int vsleep_ns(int64_t ns);   /* defined with the sleep surface */
+
 /* Forward the VIRTUAL subset of a pollfd array to the simulator.
  * Mixed sets (virtual + real fds) wait only on the virtual ones —
  * real fds report no events (documented limitation: the simulator
@@ -535,16 +545,49 @@ int epoll_pwait(int epfd, struct epoll_event *evs, int maxevents,
  * exactly the virtual ones). Returns the poll() result over fds[]. */
 static int vpoll(struct pollfd *fds, nfds_t nfds, int timeout_ms) {
     struct evpair want[256];
-    int nv = 0;
-    for (nfds_t i = 0; i < nfds && nv < 256; i++) {
-        fds[i].revents = 0;
+    int nv = 0, nreal = 0;
+    nfds_t nvirt = 0;
+    for (nfds_t i = 0; i < nfds; i++) {
+        fds[i].revents = 0;   /* ALL entries, unconditionally: a stale
+            * revents on an entry past any cap would report phantom
+            * readiness (round-5 advisor) */
+        if (fds[i].fd < 0) continue;   /* negative fd = ignore entry */
         if (is_vfd(fds[i].fd)) {
-            want[nv].fd = fds[i].fd;
-            want[nv].events = fds[i].events;
-            nv++;
+            nvirt++;
+            if (nv < 256) {
+                want[nv].fd = fds[i].fd;
+                want[nv].events = fds[i].events;
+                nv++;
+            }
+        } else {
+            nreal++;
         }
     }
-    if (nv == 0) return real_poll(fds, nfds, timeout_ms);
+    if (nvirt > 256) {   /* fail LOUD instead of silently waiting on a
+        * truncated subset (events on the dropped fds would never
+        * wake the caller) */
+        errno = EINVAL;
+        return -1;
+    }
+    if (nv == 0) {
+        /* a poll that waits on NOTHING (empty array, or every entry
+         * disabled with fd < 0 — both standard sleep idioms) must
+         * advance SIM time: a real poll would burn wallclock while
+         * the virtual clock stays frozen, so `while (time() <
+         * deadline) poll(0,0,100)` would never terminate (round-5
+         * advisor; mirrors nanosleep -> OP_SLEEP). The infinite form
+         * (timeout -1, the pause() idiom) must not reach the REAL
+         * poll either — it would block the child forever in wallclock
+         * and wedge the whole simulator (shim.py waits in _read_req);
+         * park it past any stop_time instead (the run's teardown
+         * releases the child). */
+        if (nreal == 0 && timeout_ms != 0) {
+            vsleep_ns(timeout_ms > 0 ? (int64_t)timeout_ms * 1000000LL
+                                     : (int64_t)1 << 62);
+            return 0;
+        }
+        return real_poll(fds, nfds, timeout_ms);
+    }
     struct rsp r = call2(OP_POLL, nv, (int64_t)nv * sizeof(struct evpair),
                          timeout_ms, NULL, want,
                          (size_t)nv * sizeof(struct evpair), NULL, 0);
@@ -623,6 +666,13 @@ static int fdset_has_vfd(int nfds, fd_set *s) {
     return 0;
 }
 
+static int fdset_any(int nfds, fd_set *s) {
+    if (!s) return 0;
+    for (int fd = 0; fd < nfds && fd < FD_SETSIZE; fd++)
+        if (FD_ISSET(fd, s)) return 1;
+    return 0;
+}
+
 int select(int nfds, fd_set *rs, fd_set *ws, fd_set *es,
            struct timeval *tv) {
     shim_init();
@@ -631,8 +681,30 @@ int select(int nfds, fd_set *rs, fd_set *ws, fd_set *es,
     if (!real_select) real_select = dlsym(RTLD_NEXT, "select");
     if (!active() || (!fdset_has_vfd(nfds, rs) &&
                       !fdset_has_vfd(nfds, ws) &&
-                      !fdset_has_vfd(nfds, es)))
+                      !fdset_has_vfd(nfds, es))) {
+        /* empty-set select with a timeout is the classic portable
+         * sleep — advance SIM time like poll(NULL,0,ms) above; a NULL
+         * tv (block forever) parks past any stop_time rather than
+         * wedging the simulator in the real syscall. "Empty" means NO
+         * bit set in any of the three sets, whatever nfds claims. */
+        if (active() && !fdset_any(nfds, rs) && !fdset_any(nfds, ws) &&
+            !fdset_any(nfds, es)) {
+            if (!tv) {
+                vsleep_ns((int64_t)1 << 62);
+                return 0;
+            }
+            if (tv->tv_sec > 0 || tv->tv_usec > 0) {
+                vsleep_ns((int64_t)tv->tv_sec * 1000000000LL +
+                          (int64_t)tv->tv_usec * 1000);
+                /* Linux select() writes back the remaining time; a
+                 * full elapse leaves zero (retry loops depend on it) */
+                tv->tv_sec = 0;
+                tv->tv_usec = 0;
+                return 0;
+            }
+        }
         return real_select(nfds, rs, ws, es, tv);
+    }
     int ms = tv ? (int)(tv->tv_sec * 1000 +
                         (tv->tv_usec + 999) / 1000) : -1;
     return vselect(nfds, rs, ws, es, ms);
@@ -647,8 +719,22 @@ int pselect(int nfds, fd_set *rs, fd_set *ws, fd_set *es,
     if (!real_ps) real_ps = dlsym(RTLD_NEXT, "pselect");
     if (!active() || (!fdset_has_vfd(nfds, rs) &&
                       !fdset_has_vfd(nfds, ws) &&
-                      !fdset_has_vfd(nfds, es)))
+                      !fdset_has_vfd(nfds, es))) {
+        /* pselect's timeout is const (Linux never modifies it) */
+        if (active() && !fdset_any(nfds, rs) && !fdset_any(nfds, ws) &&
+            !fdset_any(nfds, es)) {
+            if (!ts) {
+                vsleep_ns((int64_t)1 << 62);
+                return 0;
+            }
+            if (ts->tv_sec > 0 || ts->tv_nsec > 0) {
+                vsleep_ns((int64_t)ts->tv_sec * 1000000000LL +
+                          (int64_t)ts->tv_nsec);
+                return 0;
+            }
+        }
         return real_ps(nfds, rs, ws, es, ts, mask);
+    }
     int ms = ts ? (int)(ts->tv_sec * 1000 +
                         (ts->tv_nsec + 999999) / 1000000) : -1;
     return vselect(nfds, rs, ws, es, ms);

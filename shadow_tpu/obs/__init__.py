@@ -1,1 +1,3 @@
-"""obs subpackage."""
+"""obs subpackage: trace (span timeline), metrics (registry),
+tracker (heartbeats), pcap (capture), logger (text log) — see
+README.md in this directory for roles, usage and overhead notes."""

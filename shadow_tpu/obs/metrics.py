@@ -1,0 +1,217 @@
+"""Metrics registry: counters, gauges and histograms with JSON export.
+
+The reference's quantitative self-reporting is scattered — tracker
+heartbeats (shd-tracker.c:405-592), the slave getrusage summary
+(shd-slave.c:374-395), the ObjectCounter shutdown report
+(shd-slave.c:207-211). Here all of it funnels through ONE registry so
+the CLI, the tracker, bench.py and tests read the same numbers:
+
+- counters   monotonically increasing event counts (windows run, shim
+  ops served, tracker lines emitted, pcap records written);
+- gauges     last-value samples (current sim time, summary figures);
+- histograms value distributions with fixed bucket bounds (shim
+  per-op latency).
+
+Export surfaces:
+
+- ``Registry.chunk(**fields)`` appends one JSON line per window chunk
+  to ``<metrics>.chunks.jsonl`` (streamed, so a crashed run keeps its
+  lines) and retains it in memory for tests;
+- ``Registry.snapshot()`` is the final ``metrics.json`` document —
+  shaped to diff against the BENCH_*.json rounds: the ``sim`` section
+  carries SimReport.summary() figures (events/sec, wall per
+  sim-second, speedup) published via ``publish("sim", ...)``, and the
+  ``shim`` section aggregates per-op counts and latency histograms.
+
+Cheap when disabled: ``ENABLED`` is a module boolean; hot paths guard
+with ``if metrics.ENABLED:`` and pay one boolean check (the same
+contract as obs.trace). Metric objects expose plain attributes
+(`Counter.n`) so the enabled-path cost is one dict lookup + one add.
+"""
+
+from __future__ import annotations
+
+import json
+from bisect import bisect_left
+
+ENABLED = False
+REGISTRY = None
+
+# default histogram bounds: log-ish µs ladder wide enough for both a
+# ~2 µs clock op and a multi-second blocking wait
+DEFAULT_BOUNDS = (1, 2, 5, 10, 20, 50, 100, 200, 500,
+                  1_000, 2_000, 5_000, 10_000, 50_000, 100_000,
+                  1_000_000, 10_000_000)
+
+
+class Counter:
+    __slots__ = ("n",)
+
+    def __init__(self):
+        self.n = 0
+
+    def inc(self, k: int = 1):
+        self.n += k
+
+
+class Gauge:
+    __slots__ = ("v",)
+
+    def __init__(self):
+        self.v = 0.0
+
+    def set(self, v):
+        self.v = v
+
+
+class Histogram:
+    """Fixed-bound histogram: observe() bisects into len(bounds)+1
+    buckets (the last is the overflow bucket)."""
+
+    __slots__ = ("bounds", "buckets", "count", "total", "vmin", "vmax")
+
+    def __init__(self, bounds=DEFAULT_BOUNDS):
+        self.bounds = tuple(bounds)
+        self.buckets = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self.total = 0.0
+        self.vmin = None
+        self.vmax = None
+
+    def observe(self, v):
+        self.buckets[bisect_left(self.bounds, v)] += 1
+        self.count += 1
+        self.total += v
+        if self.vmin is None or v < self.vmin:
+            self.vmin = v
+        if self.vmax is None or v > self.vmax:
+            self.vmax = v
+
+    def snapshot(self) -> dict:
+        out = {"count": self.count, "sum": self.total,
+               "min": self.vmin, "max": self.vmax,
+               "mean": (self.total / self.count) if self.count else None,
+               "buckets": {}}
+        for le, n in zip(self.bounds, self.buckets):
+            if n:
+                out["buckets"][f"le_{le}"] = n
+        if self.buckets[-1]:
+            out["buckets"]["overflow"] = self.buckets[-1]
+        return out
+
+
+class Registry:
+    """Get-or-create metric store + export. `path` is the final
+    snapshot file, `jsonl_path` the per-chunk line stream; either may
+    be None (collect only — non-writer processes of a multi-process
+    mesh, or in-memory test use)."""
+
+    def __init__(self, path: str = None, jsonl_path: str = None):
+        self.path = path
+        self.jsonl_path = jsonl_path
+        self._jsonl = None           # opened on first chunk line
+        self.counters = {}
+        self.gauges = {}
+        self.histograms = {}
+        self.chunks = []             # retained per-chunk lines (tests)
+        # outer harnesses timing several runs into one registry (e.g.
+        # bench.py's config matrix) set this so interleaved chunk
+        # lines stay attributable to their run
+        self.label = None
+
+    # --- get-or-create accessors (hot path: one dict hit) ---
+    def counter(self, name: str) -> Counter:
+        c = self.counters.get(name)
+        if c is None:
+            c = self.counters[name] = Counter()
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self.gauges.get(name)
+        if g is None:
+            g = self.gauges[name] = Gauge()
+        return g
+
+    def histogram(self, name: str, bounds=DEFAULT_BOUNDS) -> Histogram:
+        h = self.histograms.get(name)
+        if h is None:
+            h = self.histograms[name] = Histogram(bounds)
+        return h
+
+    # --- export ---
+    def publish(self, prefix: str, mapping: dict):
+        """Expose every numeric value of `mapping` as a gauge named
+        ``<prefix>.<key>`` — how SimReport.summary() becomes the
+        registry's ``sim`` section (one source of truth for the CLI,
+        tracker and bench)."""
+        for k, v in mapping.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            self.gauge(f"{prefix}.{k}").set(v)
+
+    def chunk(self, **fields):
+        """One per-window-chunk JSON line (engine.sim's chunk loop)."""
+        if self.label is not None:
+            fields = {"run": self.label, **fields}
+        self.chunks.append(fields)
+        if self.jsonl_path is not None:
+            if self._jsonl is None:
+                self._jsonl = open(self.jsonl_path, "w")
+            self._jsonl.write(json.dumps(fields) + "\n")
+            self._jsonl.flush()
+
+    def snapshot(self) -> dict:
+        counters = {k: c.n for k, c in sorted(self.counters.items())}
+        gauges = {k: g.v for k, g in sorted(self.gauges.items())}
+        hists = {k: h.snapshot()
+                 for k, h in sorted(self.histograms.items())}
+        # convenience views shaped for diffing against BENCH_*.json:
+        # the published summary and the shim per-op aggregation
+        sim = {k[len("sim."):]: v for k, v in gauges.items()
+               if k.startswith("sim.")}
+        ops = {k[len("shim.op."):]: v for k, v in counters.items()
+               if k.startswith("shim.op.")}
+        lat = {k[len("shim.op_us."):]: v for k, v in hists.items()
+               if k.startswith("shim.op_us.")}
+        return {"sim": sim,
+                "shim": {"ops": ops, "op_latency_us": lat},
+                "counters": counters, "gauges": gauges,
+                "histograms": hists, "chunks": len(self.chunks)}
+
+    def close(self):
+        """Write the final snapshot (if a path was given) and release
+        the chunk stream."""
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None
+        if self.path is not None:
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.snapshot(), f, indent=1)
+            import os
+            os.replace(tmp, self.path)
+
+
+def install(path: str = None, jsonl_path: str = None) -> Registry:
+    """Enable metrics process-wide; the installer owns finish()."""
+    global ENABLED, REGISTRY
+    REGISTRY = Registry(path=path, jsonl_path=jsonl_path)
+    ENABLED = True
+    return REGISTRY
+
+
+def finish() -> Registry | None:
+    """Disable metrics, write the snapshot, return the registry."""
+    global ENABLED, REGISTRY
+    reg, REGISTRY, ENABLED = REGISTRY, None, False
+    if reg is not None:
+        reg.close()
+    return reg
+
+
+# shim protocol helper (hosting.shim._service): one counter + one
+# latency histogram per op name, behind the caller's ENABLED guard
+def shim_op(op_name: str, dt_ns: int):
+    r = REGISTRY
+    r.counter("shim.op." + op_name).inc()
+    r.histogram("shim.op_us." + op_name).observe(dt_ns / 1000.0)

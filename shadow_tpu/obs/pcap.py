@@ -22,6 +22,7 @@ import struct
 import numpy as np
 
 from ..net import packet as P
+from . import metrics as _MT
 
 _GLOBAL_HDR = struct.pack("<IHHiIII", 0xA1B2C3D4, 2, 4, 0, 0, 65535, 1)
 
@@ -94,10 +95,12 @@ class PcapWriter:
         tr_time = np.asarray(tr_time)
         tr_pkt = np.asarray(tr_pkt)
         tr_cnt = np.asarray(tr_cnt)
+        written = 0
         for hid, f in self.files.items():
             n = int(tr_cnt[hid])
             if not n:
                 continue
+            written += n
             order = np.argsort(tr_time[hid, :n], kind="stable")
             for i in order:
                 t = int(tr_time[hid, i])
@@ -106,6 +109,8 @@ class PcapWriter:
                                     (t % 10**9) // 1000,
                                     len(frame), orig_len))
                 f.write(frame)
+        if written and _MT.ENABLED:
+            _MT.REGISTRY.counter("pcap.records").inc(written)
 
     def close(self):
         for f in self.files.values():

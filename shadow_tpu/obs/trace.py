@@ -1,0 +1,171 @@
+"""Span tracing: a low-overhead recorder emitting Chrome trace-event
+JSON (viewable in Perfetto / chrome://tracing).
+
+The reference self-times its scheduler barriers and per-host exec
+seconds (shd-scheduler.c:250-252, shd-host.c:201-208) but only as
+end-of-run aggregates; tools/phase_profile.py and xplane_profile.py
+measure phases offline. This module is the ALWAYS-AVAILABLE in-run
+counterpart: named wall-clock spans recorded on the host side (the
+device reports through counters, not strings), serialized once at the
+end of the run as one JSON timeline. Each window-chunk span carries its
+sim-time range, windows advanced and events executed in `args`, so
+sim-time progress and wall-clock cost correlate in a single view —
+"where does the wall time go" answered per chunk, not per run.
+
+Design constraints:
+
+- Cheap when disabled. `ENABLED` is a module-level boolean; hot paths
+  (the per-chunk loop in engine.sim) guard every hook with a plain
+  ``if trace.ENABLED:`` so a run without ``--trace`` pays one boolean
+  check per chunk and allocates nothing. The ``span()`` context
+  manager is for cold paths only (setup, teardown, tools) — it
+  allocates a generator even when disabled.
+- Cheap when enabled. Recording a span is two perf_counter_ns reads
+  and one list append of a small dict; serialization happens once, at
+  flush. A hard cap (MAX_EVENTS) bounds memory on runaway loops; the
+  drop count is recorded in the trace metadata.
+- One global tracer. Spans originate from several modules (engine,
+  hosting, parallel, obs) on one thread of control; a process-global
+  instance keeps the call sites to one import and one boolean.
+
+Timeline format: complete events (``"ph": "X"``) with microsecond
+``ts``/``dur`` relative to tracer creation, wrapped as
+``{"traceEvents": [...]}`` — both Perfetto and chrome://tracing load
+this directly (catapult TraceEvent format).
+
+Usage:
+
+    from shadow_tpu.obs import trace
+    trace.install("out.json")
+    with trace.span("build"):             # cold path
+        ...
+    if trace.ENABLED:                     # hot path
+        t0 = trace.TRACER.now()
+        ...work...
+        trace.TRACER.complete("chunk", t0, args={"windows": 8})
+    trace.finish()                        # writes out.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from contextlib import contextmanager
+
+ENABLED = False
+TRACER = None
+
+# hard cap on retained events: a pathological span-per-event loop must
+# degrade to dropped spans, not to an OOM
+MAX_EVENTS = 1_000_000
+
+
+class Tracer:
+    """One trace session. `path=None` collects but discards at flush
+    (non-writer processes of a multi-process mesh still time their
+    collectives so the collective call pattern stays uniform)."""
+
+    __slots__ = ("path", "events", "dropped", "_pid", "_epoch")
+
+    def __init__(self, path: str | None):
+        self.path = path
+        self.events = []
+        self.dropped = 0
+        self._pid = os.getpid()
+        self._epoch = time.perf_counter_ns()
+
+    @staticmethod
+    def now() -> int:
+        """Span start stamp (perf_counter_ns) for complete()."""
+        return time.perf_counter_ns()
+
+    def complete(self, name: str, t0_ns: int, args: dict = None,
+                 tid: int = 0):
+        """Record a complete span [t0_ns, now) named `name`."""
+        if len(self.events) >= MAX_EVENTS:
+            self.dropped += 1
+            return
+        t1 = time.perf_counter_ns()
+        ev = {"name": name, "ph": "X", "pid": self._pid, "tid": tid,
+              "ts": (t0_ns - self._epoch) / 1000.0,
+              "dur": (t1 - t0_ns) / 1000.0}
+        if args:
+            ev["args"] = args
+        self.events.append(ev)
+
+    def instant(self, name: str, args: dict = None, tid: int = 0):
+        """A zero-duration marker (``"ph": "i"``)."""
+        if len(self.events) >= MAX_EVENTS:
+            self.dropped += 1
+            return
+        ev = {"name": name, "ph": "i", "s": "p", "pid": self._pid,
+              "tid": tid,
+              "ts": (time.perf_counter_ns() - self._epoch) / 1000.0}
+        if args:
+            ev["args"] = args
+        self.events.append(ev)
+
+    def counter(self, name: str, values: dict, tid: int = 0):
+        """A counter track sample (``"ph": "C"``): `values` maps
+        series name -> number; Perfetto renders them as stacked
+        area tracks."""
+        if len(self.events) >= MAX_EVENTS:
+            self.dropped += 1
+            return
+        self.events.append(
+            {"name": name, "ph": "C", "pid": self._pid, "tid": tid,
+             "ts": (time.perf_counter_ns() - self._epoch) / 1000.0,
+             "args": values})
+
+    def flush(self):
+        """Serialize the timeline. No-op with path=None."""
+        if self.path is None:
+            return
+        meta = [{"name": "process_name", "ph": "M", "pid": self._pid,
+                 "tid": 0, "args": {"name": "shadow_tpu"}}]
+        doc = {"traceEvents": meta + self.events,
+               "displayTimeUnit": "ms"}
+        if self.dropped:
+            doc["otherData"] = {"dropped_events": self.dropped}
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self.path)
+
+
+def install(path: str | None) -> Tracer:
+    """Enable tracing process-wide. Returns the tracer (also at
+    module attribute TRACER). Idempotent-hostile by design: the caller
+    that installs owns finish()."""
+    global ENABLED, TRACER
+    TRACER = Tracer(path)
+    ENABLED = True
+    return TRACER
+
+
+def finish() -> Tracer | None:
+    """Disable tracing and write the timeline (if a path was given).
+    Returns the retired tracer so tests can inspect it."""
+    global ENABLED, TRACER
+    tr, TRACER, ENABLED = TRACER, None, False
+    if tr is not None:
+        tr.flush()
+    return tr
+
+
+@contextmanager
+def span(name: str, **args):
+    """Cold-path span context manager. NOT for per-chunk/per-event hot
+    loops — the generator allocation is real even when disabled; hot
+    paths use the explicit ``if trace.ENABLED:`` + complete() pattern
+    (module docstring)."""
+    if not ENABLED:
+        yield
+        return
+    tr = TRACER
+    t0 = tr.now()
+    try:
+        yield
+    finally:
+        tr.complete(name, t0, args or None)

@@ -39,6 +39,7 @@ import resource
 import numpy as np
 
 from ..engine import defs
+from . import metrics as _MT
 
 
 HEADER = ("time,host,interval,events,pkts-sent,pkts-recv,bytes-sent,"
@@ -59,6 +60,8 @@ class Tracker:
 
     def _emit(self, line: str):
         self.lines.append(line)
+        if _MT.ENABLED:
+            _MT.REGISTRY.counter("tracker.lines").inc()
         if self.logger is not None:
             self.logger.message(self.next_ns, "tracker", line)
 
@@ -127,6 +130,14 @@ class Tracker:
             f"maxrss-gib={ru.ru_maxrss / (1 << 20):.3f},"
             f"utime-min={ru.ru_utime / 60:.3f},"
             f"stime-min={ru.ru_stime / 60:.3f}")
+        if _MT.ENABLED:
+            # heartbeats surface through the registry too: the metrics
+            # snapshot shows how many fired and the interval-delta
+            # totals without parsing the text lines
+            reg = _MT.REGISTRY
+            reg.counter("tracker.heartbeats").inc()
+            reg.counter("tracker.events").inc(int(tot[defs.ST_EVENTS]))
+            reg.gauge("tracker.last_sim_ns").set(int(self.next_ns))
         self.next_ns += self.interval
 
     def _heartbeat_sockets(self, t: int, span_s: str, socks: dict):

@@ -91,6 +91,12 @@ def gather_stats(stats) -> np.ndarray:
     The end-of-run equivalent of the reference's slave->master result
     handoff: per-host stats shards live on their owning processes;
     this all-gathers them so each process can build the full report.
+
+    Instrumented (obs.trace): the cross-process all-gather is this
+    backend's scheduler barrier — the direct analogue of the barrier
+    waits the reference self-times (shd-scheduler.c:250-252) — so each
+    call records a ``dist.allgather`` span when tracing is on. Every
+    process records its own span; only process 0 writes a file.
     """
     import jax
 
@@ -98,5 +104,11 @@ def gather_stats(stats) -> np.ndarray:
         return np.asarray(stats)
     from jax.experimental import multihost_utils
 
-    return np.asarray(
+    from ..obs import trace as TR
+    t0 = TR.TRACER.now() if TR.ENABLED else 0
+    out = np.asarray(
         multihost_utils.process_allgather(stats, tiled=True))
+    if TR.ENABLED:
+        TR.TRACER.complete("dist.allgather", t0,
+                           args={"bytes": int(out.nbytes)})
+    return out

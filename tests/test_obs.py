@@ -1,15 +1,30 @@
-"""Observability tests: heartbeat tracker, pcap capture, logger."""
+"""Observability tests: span tracing, metrics registry, heartbeat
+tracker, pcap capture, logger."""
 
+import json
 import struct
 
 import numpy as np
+import pytest
 
 from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario
 from shadow_tpu.engine.sim import Simulation
 from shadow_tpu.engine.state import EngineConfig
+from shadow_tpu.obs import metrics as M
+from shadow_tpu.obs import trace as T
 from shadow_tpu.obs.logger import SimLogger
 
 from test_phold import MESH_TOPO
+
+
+@pytest.fixture(autouse=True)
+def _obs_globals_reset():
+    """The trace/metrics recorders are process-global; a test that
+    fails mid-install must not leak an enabled recorder into the next
+    test."""
+    yield
+    T.finish()
+    M.finish()
 
 
 def scen(pcap=False, stop=4):
@@ -106,6 +121,171 @@ def test_pcap_capture(tmp_path):
     assert n == 6
     # udp: 14 eth + 20 ip + 8 udp + 100 payload
     assert all(l == 142 for l in lens)
+
+
+def test_trace_span_nesting(tmp_path):
+    """Nested spans flush as valid Chrome trace-event JSON: complete
+    ("X") events with µs ts/dur, children contained in parents, args
+    preserved."""
+    path = str(tmp_path / "t.json")
+    T.install(path)
+    with T.span("outer", kind="test"):
+        with T.span("inner"):
+            pass
+        t0 = T.TRACER.now()
+        T.TRACER.complete("hot", t0, args={"n": 3})
+    T.finish()
+    assert not T.ENABLED and T.TRACER is None
+
+    doc = json.load(open(path))
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    by_name = {e["name"]: e for e in evs}
+    assert set(by_name) == {"outer", "inner", "hot"}
+    outer, inner = by_name["outer"], by_name["inner"]
+    assert outer["ts"] <= inner["ts"]
+    assert (inner["ts"] + inner["dur"]
+            <= outer["ts"] + outer["dur"] + 1e-6)
+    assert outer["args"] == {"kind": "test"}
+    assert by_name["hot"]["args"] == {"n": 3}
+    # metadata names the process for Perfetto
+    assert any(e.get("ph") == "M" for e in doc["traceEvents"])
+
+
+def test_trace_disabled_span_is_noop(tmp_path):
+    """With nothing installed the module stays disabled and span() is
+    a pass-through — the contract the hot-loop boolean guards rely
+    on."""
+    assert not T.ENABLED
+    with T.span("never"):
+        pass
+    assert T.TRACER is None
+
+
+def test_metrics_registry_semantics():
+    """Counter/gauge/histogram semantics and the snapshot shape."""
+    reg = M.install()
+    try:
+        reg.counter("a").inc()
+        reg.counter("a").inc(4)
+        reg.gauge("g").set(2.5)
+        h = reg.histogram("h", bounds=(10, 100))
+        for v in (1, 9, 10, 11, 250):
+            h.observe(v)
+        M.shim_op("send", 5_000)     # 5 µs
+        M.shim_op("send", 7_000)
+        snap = reg.snapshot()
+    finally:
+        M.finish()
+    assert snap["counters"]["a"] == 5
+    assert snap["gauges"]["g"] == 2.5
+    hs = snap["histograms"]["h"]
+    assert hs["count"] == 5 and hs["min"] == 1 and hs["max"] == 250
+    assert hs["sum"] == 281
+    # bisect_left semantics: <=10 in the first bucket, 11 in the
+    # second, 250 overflows
+    assert hs["buckets"] == {"le_10": 3, "le_100": 1, "overflow": 1}
+    # the shim per-op aggregation view
+    assert snap["shim"]["ops"] == {"send": 2}
+    lat = snap["shim"]["op_latency_us"]["send"]
+    assert lat["count"] == 2 and 5 <= lat["mean"] <= 7
+
+
+def test_run_trace_metrics_smoke(tmp_path):
+    """A small ping run with trace+metrics produces (a) a loadable
+    trace with >= 4 distinct span names whose chunk spans carry
+    sim_ns_start/sim_ns_end/events args, and (b) a metrics snapshot
+    with events/sec, wall per sim-second and the shim section — the
+    PR's acceptance shape."""
+    tr_path = str(tmp_path / "trace.json")
+    mt_path = str(tmp_path / "metrics.json")
+    sim = Simulation(scen(stop=6),
+                     engine_cfg=EngineConfig(num_hosts=2, **CFG))
+    report = sim.run(heartbeat_s=1.0, trace=tr_path, metrics=mt_path)
+    # recorders are torn down with the run
+    assert not T.ENABLED and not M.ENABLED
+
+    doc = json.load(open(tr_path))
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    names = {e["name"] for e in evs}
+    assert {"chunk", "compile+first_chunk", "run.setup",
+            "report.finalize", "tracker.heartbeat"} <= names
+    assert len(names) >= 4, names
+    chunks = [e for e in evs if e["name"] == "chunk"]
+    assert chunks
+    for c in chunks:
+        a = c["args"]
+        assert {"sim_ns_start", "sim_ns_end", "windows",
+                "events"} <= set(a)
+        assert a["sim_ns_end"] >= a["sim_ns_start"]
+    # chunk events tally with the report
+    assert sum(c["args"]["events"] for c in chunks) == report.events
+    assert sum(c["args"]["windows"] for c in chunks) == report.windows
+
+    snap = json.load(open(mt_path))
+    assert snap["sim"]["events"] == report.events
+    assert snap["sim"]["events_per_sec"] > 0
+    assert "wall_per_sim_second" in snap["sim"]
+    assert "ops" in snap["shim"]            # present (empty: no shim)
+    assert snap["counters"]["engine.windows"] == report.windows
+    # tracker heartbeats surface through the registry
+    assert snap["counters"]["tracker.heartbeats"] >= 1
+    assert snap["counters"]["tracker.lines"] == len(report.heartbeats)
+
+    # per-chunk JSON lines parse and tile the run
+    lines = [json.loads(l) for l in
+             open(mt_path + ".chunks.jsonl").read().splitlines()]
+    assert len(lines) == snap["counters"]["engine.chunks"]
+    assert sum(l["events"] for l in lines) == report.events
+
+
+def test_trace_report_tool(tmp_path):
+    """tools/trace_report.py end-to-end on a real run's trace: the
+    headless CPU path the CI satellite asks for."""
+    import os
+    import subprocess
+    import sys
+    tr_path = str(tmp_path / "trace.json")
+    sim = Simulation(scen(stop=4),
+                     engine_cfg=EngineConfig(num_hosts=2, **CFG))
+    sim.run(trace=tr_path)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools/trace_report.py"),
+         tr_path],
+        capture_output=True, text=True, check=True).stdout
+    assert "top spans by self-time" in out
+    assert "chunk" in out
+    assert "wall per sim-second" in out
+    # --json mode round-trips
+    js = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools/trace_report.py"),
+         tr_path, "--json"],
+        capture_output=True, text=True, check=True).stdout
+    rep = json.loads(js)
+    assert rep["chunks"] and rep["spans"]
+    assert any(s["name"] == "chunk" for s in rep["spans"])
+
+
+def test_pyengine_trace_and_metrics(tmp_path):
+    """The differential oracle's event loop shows up on the same
+    timeline (pyengine.window spans) and in the registry."""
+    from shadow_tpu.engine.pyengine import PyEngine
+    path = str(tmp_path / "py.json")
+    T.install(path)
+    reg = M.install()
+    try:
+        sim = Simulation(scen(stop=4),
+                         engine_cfg=EngineConfig(num_hosts=2, **CFG))
+        stats = PyEngine(sim).run()
+    finally:
+        tr = T.finish()
+        M.finish()
+    names = [e["name"] for e in tr.events]
+    assert "pyengine.window" in names
+    from shadow_tpu.engine import defs
+    ev = int(stats[:, defs.ST_EVENTS].sum())
+    assert reg.counters["pyengine.events"].n == ev
+    assert reg.counters["pyengine.windows"].n > 0
 
 
 def test_logger_levels(capsys):

@@ -21,7 +21,7 @@ from shadow_tpu.engine import defs
 from shadow_tpu.engine.sim import Simulation
 from shadow_tpu.engine.state import EngineConfig
 
-from .test_shim import run_native_argv, TRANSFERS, NBYTES
+from test_shim import run_native_argv, TRANSFERS, NBYTES
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 POLLCLIENT_C = os.path.join(REPO, "examples/plugins/pollclient.c")
@@ -159,6 +159,45 @@ def test_entropy_determinism_dual_run(libcprobe_bin, tmp_path,
                          simple_topology_xml, seed=8)
     d3 = _parse(out3)
     assert d3["entropy.getrandom"] != d["entropy.getrandom"]
+
+
+def test_urandom_write_refused_and_poll_sleep(libcprobe_bin, tmp_path,
+                                              simple_topology_xml):
+    """Round-5 advisor fixes, driven through a real binary: (a)
+    write() to an entropy vfd fails cleanly with EBADF instead of
+    forwarding OP_SEND and crashing shim.py with a KeyError; (b) the
+    poll(NULL,0,ms) / select(0,...,&tv) sleep idioms advance
+    SIMULATED time via OP_SLEEP (a real poll would freeze the virtual
+    clock and wedge deadline loops)."""
+    out, _ = _run_probe(libcprobe_bin, str(tmp_path / "uw.out"),
+                        simple_topology_xml)
+    d = _parse(out)
+    assert int(d["urandomwrite.rc"]) == -1, out
+    assert int(d["urandomwrite.errno"]) == 9, out   # EBADF
+    # 150ms poll + 150ms select, measured on the simulated clock
+    assert 0.25 <= float(d["pollsleep.measured"]) <= 0.45, out
+
+
+def test_shim_op_metrics(libcprobe_bin, tmp_path, simple_topology_xml):
+    """The preload protocol is metered: with the metrics registry on,
+    a hosted run records per-op counts and latency histograms
+    (obs.metrics shim section)."""
+    from shadow_tpu.obs import metrics as M
+    reg = M.install()
+    try:
+        out, _ = _run_probe(libcprobe_bin, str(tmp_path / "mt.out"),
+                            simple_topology_xml)
+        snap = reg.snapshot()
+    finally:
+        M.finish()
+    assert "measured" in out
+    ops = snap["shim"]["ops"]
+    # the probe reads clocks, sleeps and draws entropy
+    assert ops.get("clock", 0) > 0, ops
+    assert ops.get("sleep", 0) > 0, ops
+    assert ops.get("random", 0) > 0, ops
+    lat = snap["shim"]["op_latency_us"]["clock"]
+    assert lat["count"] == ops["clock"] and lat["mean"] > 0
 
 
 def test_pthread_create_refused(libcprobe_bin, tmp_path,

@@ -1,0 +1,157 @@
+#!/usr/bin/env python3
+"""Summarize a shadow_tpu Chrome trace (the ``--trace FILE`` output of
+``python -m shadow_tpu`` / ``Simulation.run(trace=...)``).
+
+Two views, answering "where does the wall time go":
+
+1. top spans by SELF-time — per span name, total wall time minus the
+   time spent in nested child spans (so e.g. a ``chunk`` span does not
+   double-count the ``tracker.heartbeat`` it contains);
+2. per-chunk wall-per-sim-second — each ``chunk`` span carries its
+   sim-time range and events-executed in args (obs.trace), so the
+   report shows, chunk by chunk, how much wall a simulated second
+   cost and how throughput evolved over the run (the in-run
+   counterpart of SimReport.speedup, which only reports the mean).
+
+Pure stdlib, no jax: runs headless on any trace file in milliseconds.
+
+Usage:
+  python tools/trace_report.py trace.json [--top 15] [--json]
+"""
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def load_events(path):
+    """-> (complete events, dropped count). A nonzero dropped count
+    means the recorder hit its MAX_EVENTS cap (obs.trace) and the
+    timeline is TRUNCATED — totals under-report the run."""
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"] if isinstance(doc, dict) else doc
+    dropped = (doc.get("otherData", {}).get("dropped_events", 0)
+               if isinstance(doc, dict) else 0)
+    return [e for e in evs if e.get("ph") == "X"], dropped
+
+
+def self_times(events):
+    """Aggregate per span name: count, total µs, self µs (total minus
+    directly-nested children), max µs. Nesting is recovered per
+    (pid, tid) track with the standard sort-and-stack walk: order by
+    (ts, -dur) so an enclosing span precedes the spans it contains."""
+    agg = {}  # name -> [count, total_us, self_us, max_us]
+    tracks = defaultdict(list)
+    for e in events:
+        tracks[(e.get("pid", 0), e.get("tid", 0))].append(e)
+    for evs in tracks.values():
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack = []  # [end_ts, child_sum_us, name, dur_us]
+        def close(upto):
+            while stack and stack[-1][0] <= upto + 1e-9:
+                end, child, name, dur = stack.pop()
+                a = agg.setdefault(name, [0, 0.0, 0.0, 0.0])
+                a[0] += 1
+                a[1] += dur
+                a[2] += max(dur - child, 0.0)
+                a[3] = max(a[3], dur)
+                if stack:
+                    stack[-1][1] += dur
+        for e in evs:
+            close(e["ts"])
+            stack.append([e["ts"] + e["dur"], 0.0, e["name"], e["dur"]])
+        close(float("inf"))
+    return agg
+
+
+def chunk_rows(events):
+    """Per-chunk sim<->wall correlation off the ``chunk`` (compiled
+    engine) span args; pyengine.window spans aggregate the same way."""
+    rows = []
+    for e in events:
+        if e["name"] != "chunk":
+            continue
+        a = e.get("args", {})
+        if "sim_ns_start" not in a:
+            continue
+        sim_s = max(a.get("sim_ns_end", 0) - a["sim_ns_start"], 0) / 1e9
+        wall_s = e["dur"] / 1e6
+        rows.append({
+            "sim_start_s": a["sim_ns_start"] / 1e9,
+            "sim_s": sim_s,
+            "wall_s": wall_s,
+            "windows": a.get("windows", 0),
+            "events": a.get("events", 0),
+            "wall_per_sim_s": (wall_s / sim_s) if sim_s else None,
+            "events_per_sec": (a.get("events", 0) / wall_s)
+            if wall_s else None,
+        })
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace", help="Chrome trace-event JSON (obs.trace)")
+    ap.add_argument("--top", type=int, default=15,
+                    help="span names to show (by self-time)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the report as one JSON object")
+    args = ap.parse_args(argv)
+
+    events, dropped = load_events(args.trace)
+    agg = self_times(events)
+    chunks = chunk_rows(events)
+    if dropped:
+        print(f"WARNING: trace truncated — {dropped} spans dropped at "
+              "the recorder's cap (obs.trace.MAX_EVENTS); totals "
+              "under-report the run", file=sys.stderr)
+
+    spans = sorted(
+        ({"name": n, "count": c, "total_ms": t / 1000.0,
+          "self_ms": s / 1000.0, "mean_us": t / c if c else 0.0,
+          "max_us": m}
+         for n, (c, t, s, m) in agg.items()),
+        key=lambda r: -r["self_ms"])[:args.top]
+
+    if args.json:
+        print(json.dumps({"spans": spans, "chunks": chunks,
+                          "dropped_events": dropped}))
+        return 0
+
+    print("== top spans by self-time ==")
+    print(f"{'name':<24} {'count':>7} {'total_ms':>10} {'self_ms':>10} "
+          f"{'mean_us':>10} {'max_us':>10}")
+    for r in spans:
+        print(f"{r['name']:<24} {r['count']:>7} {r['total_ms']:>10.2f} "
+              f"{r['self_ms']:>10.2f} {r['mean_us']:>10.1f} "
+              f"{r['max_us']:>10.1f}")
+
+    if chunks:
+        print()
+        print("== chunks (wall per sim-second) ==")
+        print(f"{'#':>4} {'sim_start_s':>12} {'sim_s':>8} {'wall_ms':>10} "
+              f"{'windows':>8} {'events':>9} {'wall/sim_s':>11} "
+              f"{'events/s':>10}")
+        for i, r in enumerate(chunks):
+            wps = (f"{r['wall_per_sim_s']:.4f}"
+                   if r["wall_per_sim_s"] is not None else "-")
+            eps = (f"{r['events_per_sec']:.0f}"
+                   if r["events_per_sec"] is not None else "-")
+            print(f"{i:>4} {r['sim_start_s']:>12.3f} {r['sim_s']:>8.3f} "
+                  f"{r['wall_s'] * 1000:>10.2f} {r['windows']:>8} "
+                  f"{r['events']:>9} {wps:>11} {eps:>10}")
+        tot_wall = sum(r["wall_s"] for r in chunks)
+        tot_sim = sum(r["sim_s"] for r in chunks)
+        tot_ev = sum(r["events"] for r in chunks)
+        print(f"{'all':>4} {'':>12} {tot_sim:>8.3f} "
+              f"{tot_wall * 1000:>10.2f} "
+              f"{sum(r['windows'] for r in chunks):>8} {tot_ev:>9} "
+              f"{tot_wall / tot_sim if tot_sim else 0:>11.4f} "
+              f"{tot_ev / tot_wall if tot_wall else 0:>10.0f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
