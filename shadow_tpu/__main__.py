@@ -153,6 +153,15 @@ def main(argv=None):
     p.add_argument("--checkpoint-every", type=float, default=0,
                    metavar="SEC")
     p.add_argument("--resume", default=None, metavar="PATH")
+    p.add_argument("--fault", action="append", default=None,
+                   metavar="K=V,...",
+                   help="schedule one fault (repeatable), e.g. "
+                        "kind=host_down,at=10s,host=relay or "
+                        "kind=link_down,at=5s,until=8s,src=a,dst=b or "
+                        "kind=loss,at=5s,until=9s,rate=0.2,src=a,dst=b "
+                        "or kind=latency,at=5s,until=9s,extra=30ms,"
+                        "src=a,dst=b (engine.faults; deterministic, "
+                        "seed-stable)")
     p.add_argument("--engine-caps", default=None, metavar="K=V,...",
                    help="override engine array capacities, e.g. "
                         "qcap=16,scap=2,obcap=16,incap=32,chunk=256 "
@@ -181,6 +190,32 @@ def main(argv=None):
         scenario.stop_time = parse_time(args.stop_time, default_unit="s")
     if args.seed is not None:
         scenario.seed = args.seed
+    if args.fault:
+        from .core.config import FaultSpec
+        for spec in args.fault:
+            kv = {}
+            for part in spec.split(","):
+                k, eq, v = part.partition("=")
+                if not eq:
+                    p.error(f"--fault entry {part!r} is not k=v")
+                kv[k.strip()] = v.strip()
+            if "kind" not in kv or "at" not in kv:
+                p.error("--fault needs at least kind= and at=")
+            try:
+                scenario.faults.append(FaultSpec(
+                    kind=kv["kind"],
+                    at=parse_time(kv["at"], default_unit="s"),
+                    host=kv.get("host"),
+                    src=kv.get("src"),
+                    dst=kv.get("dst"),
+                    until=(parse_time(kv["until"], default_unit="s")
+                           if "until" in kv else None),
+                    rate=float(kv.get("rate", 0.0)),
+                    extra_ns=(parse_time(kv["extra"], default_unit="ms")
+                              if "extra" in kv else 0),
+                ))
+            except ValueError as e:
+                p.error(f"--fault {spec!r}: {e}")
     scenario.cpu_threshold_ns = (args.cpu_threshold * 1000
                                  if args.cpu_threshold >= 0 else -1)
     scenario.cpu_precision_ns = (args.cpu_precision * 1000
@@ -263,6 +298,17 @@ def main(argv=None):
                    f"done: {s['events']} events in {s['wall_seconds']:.2f}s "
                    f"wall ({s['events_per_sec']:.0f} ev/s, "
                    f"speedup x{s['speedup']:.2f})")
+    # robustness accounting: applied faults + hosted-process exits
+    for rec in report.faults:
+        logger.message(report.sim_time_ns, "main",
+                       f"fault applied: {rec}")
+    for hname, info in sorted(report.hosted.items()):
+        line = (f"hosted {hname}: exit_status="
+                f"{info.get('exit_status')} cause={info.get('cause')}")
+        if info.get("clean", False):
+            logger.message(report.sim_time_ns, "main", line)
+        else:
+            logger.warning(report.sim_time_ns, "main", line)
     # end-of-run capacity accounting (reference ObjectCounter report)
     for row in report.capacity_report():
         line = (f"capacity {row['array']}: peak {row['peak']}"

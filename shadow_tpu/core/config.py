@@ -65,12 +65,47 @@ class PluginSpec:
 
 
 @dataclass
+class FaultSpec:
+    """One scheduled fault (engine.faults): a deterministic, config-
+    driven robustness event executed at an exact simulated time, so
+    dual same-seed runs are bit-identical.
+
+    Kinds:
+      host_down   kill `host` at `at` (hosted child killed, modeled
+                  state cleared, open TCP connections RST toward peers)
+      host_up     restart `host` at `at` (process start events re-armed;
+                  a hosted process respawns fresh)
+      link_down   zero the path reliability between the attachment
+                  vertices of `src` and `dst` (both directions)
+      link_up     restore it
+      loss        multiply path reliability between `src` and `dst` by
+                  (1 - rate) for [at, until)
+      latency     add extra_ns to the path latency between `src` and
+                  `dst` for [at, until)
+
+    `host`/`src`/`dst` name hosts by their expanded scenario name
+    (e.g. ``relay`` or ``client3``) or a raw attachment vertex as
+    ``vertex:N``. `until`, when set on link_down/loss/latency, expands
+    to the matching restore event — an episode instead of two entries.
+    """
+    kind: str
+    at: int                      # ns
+    host: Optional[str] = None   # host_down / host_up
+    src: Optional[str] = None    # link/loss/latency endpoints
+    dst: Optional[str] = None
+    until: Optional[int] = None  # ns; episode end for link/loss/latency
+    rate: float = 0.0            # loss probability (kind == "loss")
+    extra_ns: int = 0            # added latency (kind == "latency")
+
+
+@dataclass
 class Scenario:
     stop_time: int                      # ns
     topology_graphml: Optional[str] = None   # inline graphml text
     topology_path: Optional[str] = None      # or a file path (.graphml[.xz])
     hosts: list = field(default_factory=list)
     plugins: list = field(default_factory=list)
+    faults: list = field(default_factory=list)   # FaultSpec schedule
     bootstrap_end: int = 0
     seed: int = 1
     # CPU delay model (reference shd-cpu.c; engaged per host by the
@@ -136,6 +171,22 @@ def load_xml(source: str) -> Scenario:
         elif el.tag == "plugin":
             scen.plugins.append(
                 PluginSpec(id=el.attrib["id"], path=el.attrib.get("path", "")))
+        elif el.tag == "fault":
+            a = el.attrib
+            if "kind" not in a or "at" not in a:
+                raise ValueError("<fault> requires kind= and at= attributes")
+            scen.faults.append(FaultSpec(
+                kind=a["kind"],
+                at=parse_time(a["at"], default_unit="s"),
+                host=a.get("host"),
+                src=a.get("src"),
+                dst=a.get("dst"),
+                until=(parse_time(a["until"], default_unit="s")
+                       if "until" in a else None),
+                rate=float(a.get("rate", 0.0)),
+                extra_ns=(parse_time(a["extra"], default_unit="ms")
+                          if "extra" in a else 0),
+            ))
         elif el.tag == "host" or el.tag == "node":
             a = el.attrib
             host = HostSpec(

@@ -68,4 +68,9 @@ ST_DEFER_A2A = 22      # packets deferred at the source because the
 #                        was full (parallel.shard; raise a2acap if this
 #                        grows — deferral is exact but delays delivery
 #                        processing by a window)
-N_STATS = 23
+ST_FAULTS = 23         # injected fault events applied to this host
+#                        (engine.faults: host kill/restart count at the
+#                        faulted host; the RSTs a kill sends toward
+#                        peers ride the normal EV_PKT path and are NOT
+#                        separately counted here)
+N_STATS = 24

@@ -1,0 +1,421 @@
+"""Deterministic fault injection: config -> schedule -> state surgery.
+
+The reference can only model failures statically (topology packetloss
+attributes); robustness scenarios — a relay dying mid-circuit, a link
+flapping, a loss episode — had to be approximated by editing the
+topology between runs. Here faults are first-class scheduled events
+(core.config.FaultSpec, ``<fault .../>`` / ``--fault``): the Simulation
+compiles them to a time-sorted schedule, and the run loop executes each
+batch at its exact simulated time by bounding the device window program
+at the next fault time (engine.sim passes ``stop_time = next_fault`` to
+run_windows, the same clamp the reference's master applies at endTime,
+shd-master.c:410-440). Everything the injector does is a pure function
+of (config, simulated time, device state), so dual same-seed runs stay
+bit-identical — the property the reference's determinism dual-run test
+checks (shd-test-determinism.c), extended to hostile schedules.
+
+Fault semantics:
+
+- ``host_down``: the host powers off. Its hosted child (if any) is
+  SIGKILLed through the supervision layer (hosting.runtime.kill_host),
+  its queues/outbox/NIC/app state are cleared, and every established
+  TCP connection it held sends one RST toward its peer (arriving after
+  the current path latency) — peers observe a reset, exactly what a
+  crashed kernel's peers see. The RSTs are injected directly into peer
+  event queues (the loopback-delivery path), NOT rolled against link
+  reliability: a reset radiating from a dead host is the modeling
+  convention here, not a routable packet. Packets later sent TO a dead
+  host still traverse the network and are discarded at its (empty)
+  socket table, like frames hitting a powered-off NIC's switch port.
+- ``host_up``: process start events are re-armed for every process
+  slot (app state zeroed first); a hosted process respawns a fresh
+  child via hosting.runtime.restart_host.
+- ``link_down`` / ``link_up``: the path reliability between the two
+  attachment vertices is zeroed/restored (both directions). Note the
+  oracle stores PATHS, not edges — on multi-hop graphs this severs the
+  named vertex pair only; topology.has_edge gates a compile warning.
+- ``loss``: path reliability is multiplied by (1 - rate) for the
+  episode [at, until); overlapping episodes compose multiplicatively.
+- ``latency``: extra_ns is ADDED to the path latency for the episode.
+  Only additions are allowed — the conservative lookahead window is
+  bounded by the minimum BASE latency, so increases keep every
+  cross-host arrival at or past the window end (causality preserved);
+  a reduction would need a window-bound recompute mid-run.
+
+Mechanics: host faults mutate the Hosts pytree on the CPU (numpy round
+trip — faults are rare, one transfer each is the cost); link faults
+recompute the Shared lat/rel tables from the pristine base plus the
+active episode set, so arbitrary overlap composes exactly.
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..core.simtime import SIMTIME_MAX
+from ..net import packet as P
+from ..net.socket import TCPS_CLOSED, TCPS_ESTABLISHED, TCPS_TIME_WAIT
+from . import defs
+from .defs import EV_APP, EV_NULL, EV_PKT, WAKE_START
+
+HOST_KINDS = ("host_down", "host_up")
+LINK_KINDS = ("link_down", "link_up", "loss", "latency")
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One compiled, fully-resolved fault occurrence."""
+    t: int            # ns
+    seq: int          # config order: the (t, seq) sort is total
+    kind: str         # host_down|host_up|link_down|link_up|
+    #                   loss_begin|loss_end|lat_begin|lat_end
+    host: int = -1    # host id (host kinds)
+    va: int = -1      # attachment vertices (link kinds)
+    vb: int = -1
+    eid: int = -1     # episode id pairing begin/end events
+    rate: float = 0.0
+    extra_ns: int = 0
+
+
+def _resolve_endpoint(name: str, name_to_idx: dict, vertex) -> int:
+    """A fault endpoint -> attachment vertex: a scenario host name, or
+    a raw ``vertex:N``."""
+    if name is None:
+        raise ValueError("link fault requires src= and dst=")
+    if name.startswith("vertex:"):
+        return int(name[len("vertex:"):])
+    if name not in name_to_idx:
+        raise ValueError(f"fault names unknown host {name!r}")
+    return int(vertex[name_to_idx[name]])
+
+
+def compile_faults(specs, name_to_idx: dict, vertex, topo=None,
+                   stop_time: int = None):
+    """FaultSpec list -> time-sorted FaultEvent schedule.
+
+    Validates kinds/targets at build (the reference's config errors are
+    build-time too, shd-configuration.c); warns on faults at/after the
+    stop time (they never fire) and on link faults between vertices
+    with no direct edge (the fault severs the PATH entry only).
+    """
+    events = []
+    eid = 0
+    for seq, f in enumerate(specs):
+        t = int(f.at)
+        if stop_time is not None and t >= stop_time:
+            sys.stderr.write(
+                f"shadow_tpu: warning: fault #{seq} ({f.kind}) at "
+                f"{t}ns is at/after the stop time and never fires\n")
+        if f.kind in ("host_down", "host_up"):
+            if f.host is None or f.host not in name_to_idx:
+                raise ValueError(
+                    f"fault #{seq} ({f.kind}) needs host=<scenario "
+                    f"host name>, got {f.host!r}")
+            events.append(FaultEvent(t=t, seq=seq, kind=f.kind,
+                                     host=name_to_idx[f.host]))
+            if f.kind == "host_down" and f.until is not None:
+                if int(f.until) <= t:
+                    # a misordered episode would fire the restart on
+                    # the still-live host and then kill it forever
+                    raise ValueError(
+                        f"fault #{seq}: host_down episode needs "
+                        "until > at")
+                events.append(FaultEvent(t=int(f.until), seq=seq,
+                                         kind="host_up",
+                                         host=name_to_idx[f.host]))
+            continue
+        if f.kind not in LINK_KINDS:
+            raise ValueError(
+                f"fault #{seq}: unknown kind {f.kind!r} "
+                f"(have: {HOST_KINDS + LINK_KINDS})")
+        va = _resolve_endpoint(f.src, name_to_idx, vertex)
+        vb = _resolve_endpoint(f.dst, name_to_idx, vertex)
+        if topo is not None and not topo.has_edge(va, vb):
+            sys.stderr.write(
+                f"shadow_tpu: warning: fault #{seq} ({f.kind}) names "
+                f"vertices {va}<->{vb} with no direct edge; it applies "
+                "to that PATH entry only, not to routes through it\n")
+        if f.kind == "link_down":
+            events.append(FaultEvent(t=t, seq=seq, kind="link_down",
+                                     va=va, vb=vb, eid=eid))
+            if f.until is not None:
+                if int(f.until) <= t:
+                    # the restore would sort before the cut and the
+                    # link would silently stay down forever
+                    raise ValueError(
+                        f"fault #{seq}: link_down episode needs "
+                        "until > at")
+                events.append(FaultEvent(t=int(f.until), seq=seq,
+                                         kind="link_up", va=va, vb=vb,
+                                         eid=eid))
+        elif f.kind == "link_up":
+            events.append(FaultEvent(t=t, seq=seq, kind="link_up",
+                                     va=va, vb=vb, eid=-1))
+        elif f.kind == "loss":
+            if not (0.0 < f.rate <= 1.0):
+                raise ValueError(
+                    f"fault #{seq}: loss needs 0 < rate <= 1, "
+                    f"got {f.rate}")
+            if f.until is None or int(f.until) <= t:
+                raise ValueError(
+                    f"fault #{seq}: loss episode needs until > at")
+            events.append(FaultEvent(t=t, seq=seq, kind="loss_begin",
+                                     va=va, vb=vb, eid=eid,
+                                     rate=float(f.rate)))
+            events.append(FaultEvent(t=int(f.until), seq=seq,
+                                     kind="loss_end", eid=eid))
+        elif f.kind == "latency":
+            if f.extra_ns <= 0:
+                raise ValueError(
+                    f"fault #{seq}: latency episode needs extra > 0 "
+                    "(only ADDED latency preserves the lookahead "
+                    "window's causality bound)")
+            if f.until is None or int(f.until) <= t:
+                raise ValueError(
+                    f"fault #{seq}: latency episode needs until > at")
+            events.append(FaultEvent(t=t, seq=seq, kind="lat_begin",
+                                     va=va, vb=vb, eid=eid,
+                                     extra_ns=int(f.extra_ns)))
+            events.append(FaultEvent(t=int(f.until), seq=seq,
+                                     kind="lat_end", eid=eid))
+        eid += 1
+    events.sort(key=lambda e: (e.t, e.seq, e.kind))
+    return events
+
+
+class _HostsEditor:
+    """Lazy numpy view over the Hosts pytree for host-fault surgery:
+    fields materialize (as mutable copies) on first touch and flush
+    back in ONE replace, so a batch of host faults pays one device
+    round trip however many fields it edits."""
+
+    def __init__(self, hosts):
+        self._hosts = hosts
+        self._arrs = {}
+
+    def __getitem__(self, field: str) -> np.ndarray:
+        a = self._arrs.get(field)
+        if a is None:
+            a = np.array(getattr(self._hosts, field))
+            self._arrs[field] = a
+        return a
+
+    def flush(self):
+        if not self._arrs:
+            return self._hosts
+        import jax.numpy as jnp
+        return self._hosts.replace(**{
+            f: jnp.asarray(a) for f, a in self._arrs.items()})
+
+
+class FaultInjector:
+    """Executes a compiled fault schedule against live simulation
+    state. Owned by the Simulation; engine.sim's run loop asks
+    next_time() to bound each device segment and calls apply_batch()
+    when the engine reaches a fault time."""
+
+    # socket columns scrubbed on host_down (the sock_free surface —
+    # sock_alloc fully reinitializes a row at claim time, so only the
+    # liveness/demux/timer columns need clearing here)
+    _SK_SCRUB = (("sk_used", False), ("sk_proto", 0),
+                 ("sk_state", TCPS_CLOSED), ("sk_ctl", 0),
+                 ("sk_timer_on", False), ("sk_rto_deadline", 0),
+                 ("sk_lport", 0), ("sk_rport", 0), ("sk_rhost", -1),
+                 ("sk_parent", -1), ("sk_close_after", False),
+                 ("sk_app_ref", -1))
+
+    def __init__(self, events, base_lat_ns, base_rel, vertex,
+                 procs_of_host: dict, host_names):
+        self.events = list(events)
+        self.i = 0
+        self.base_lat = np.array(base_lat_ns, dtype=np.int64)
+        self.base_rel = np.array(base_rel, dtype=np.float32)
+        self.vertex = np.asarray(vertex)
+        self.procs_of_host = procs_of_host  # hid -> [proc slots]
+        self.host_names = list(host_names)
+        self.hosting = None          # HostingRuntime (Simulation wires)
+        self.links_down = {}         # (va, vb) sorted pair -> down count
+        self.loss_eps = {}           # eid -> (va, vb, rate)
+        self.lat_eps = {}            # eid -> (va, vb, extra_ns)
+        self.log = []                # applied-fault records (SimReport)
+        # current effective latency table (base + active episodes):
+        # host_down uses it to time the RSTs it radiates
+        self._cur_lat = self.base_lat
+
+    def pending(self) -> bool:
+        return self.i < len(self.events)
+
+    def next_time(self):
+        """Earliest unapplied fault time, or None."""
+        return self.events[self.i].t if self.pending() else None
+
+    # --- application ---
+    def apply_batch(self, hosts, sh):
+        """Apply every event sharing the head time. Returns
+        (hosts, sh) with host state and/or shared tables updated."""
+        assert self.pending()
+        t = self.events[self.i].t
+        ed = _HostsEditor(hosts)
+        shared_dirty = False
+        while self.pending() and self.events[self.i].t == t:
+            ev = self.events[self.i]
+            self.i += 1
+            if ev.kind == "host_down":
+                self._host_down(ed, ev.host, t)
+            elif ev.kind == "host_up":
+                self._host_up(ed, ev.host, t)
+            else:
+                self._link_event(ev)
+                shared_dirty = True
+            self.log.append(self._record(ev))
+            from ..obs import metrics as MT
+            if MT.ENABLED:
+                MT.REGISTRY.counter(f"fault.{ev.kind}").inc()
+        hosts = ed.flush()
+        if shared_dirty:
+            sh = self._recompute_shared(sh)
+        return hosts, sh
+
+    def _record(self, ev: FaultEvent) -> dict:
+        r = {"t": ev.t, "kind": ev.kind}
+        if ev.host >= 0:
+            r["host"] = self.host_names[ev.host]
+        if ev.va >= 0:
+            r["link"] = (int(ev.va), int(ev.vb))
+        if ev.rate:
+            r["rate"] = ev.rate
+        if ev.extra_ns:
+            r["extra_ns"] = ev.extra_ns
+        return r
+
+    # --- host faults ---
+    def _host_down(self, ed: _HostsEditor, hid: int, t: int):
+        """Power the host off: RST every established TCP connection
+        toward its peer, then clear all volatile state."""
+        sk_used = ed["sk_used"]
+        sk_proto = ed["sk_proto"]
+        sk_state = ed["sk_state"]
+        sk_rhost = ed["sk_rhost"]
+        # 1) radiate RSTs (deterministic slot order) BEFORE scrubbing
+        for s in range(sk_used.shape[1]):
+            if not sk_used[hid, s] or sk_proto[hid, s] != P.PROTO_TCP:
+                continue
+            st = int(sk_state[hid, s])
+            if st < TCPS_ESTABLISHED or st == TCPS_TIME_WAIT:
+                continue
+            peer = int(sk_rhost[hid, s])
+            if peer < 0 or peer == hid:
+                continue          # loopback peer dies with the host
+            lat = int(self._cur_lat[self.vertex[hid],
+                                    self.vertex[peer]])
+            pkt = np.zeros(P.PKT_WORDS, np.int32)
+            pkt[P.SRC] = hid
+            pkt[P.DST] = peer
+            pkt[P.SPORT] = ed["sk_lport"][hid, s]
+            pkt[P.DPORT] = ed["sk_rport"][hid, s]
+            pkt[P.FLAGS] = P.PROTO_TCP | P.F_RST
+            self._push_event(ed, peer, t + lat, EV_PKT, pkt)
+        # 2) hosted child: SIGKILL through the supervisor
+        if self.hosting is not None:
+            self.hosting.kill_host(
+                hid, cause=f"fault: host_down at t={t}ns", sim_ns=t)
+        # 3) scrub the host row
+        for f in ("eq_time", "eq_next"):
+            ed[f][hid] = SIMTIME_MAX
+        ed["eq_kind"][hid] = EV_NULL
+        ed["ob_cnt"][hid] = 0
+        ed["ob_next"][hid] = SIMTIME_MAX
+        ed["txq_cnt"][hid] = 0
+        ed["txq_head"][hid] = 0
+        ed["nic_sched"][hid] = False
+        ed["hw_cnt"][hid] = 0
+        ed["app_node"][hid] = 0
+        ed["app_r"][hid] = 0
+        for f, val in self._SK_SCRUB:
+            ed[f][hid] = val
+        # bump every generation: timer/close events already emitted
+        # toward these slots (none survive the queue clear, but peers'
+        # in-flight segments demux by port, and generation-stamped
+        # wakes must never match a post-restart incarnation)
+        ed["sk_timer_gen"][hid] += 1
+        ed["stats"][hid, defs.ST_FAULTS] += 1
+
+    def _host_up(self, ed: _HostsEditor, hid: int, t: int):
+        """Re-arm process start events (the boot sequence the
+        Simulation schedules at build, engine.sim initial events)."""
+        if self.hosting is not None:
+            self.hosting.restart_host(hid)
+        ed["app_node"][hid] = 0
+        ed["app_r"][hid] = 0
+        for p in self.procs_of_host.get(hid, ()):
+            pkt = np.zeros(P.PKT_WORDS, np.int32)
+            pkt[P.ACK] = WAKE_START
+            pkt[P.SEQ] = -1
+            pkt[P.SRC] = p        # slotless wake: process slot
+            self._push_event(ed, hid, t, EV_APP, pkt)
+        ed["stats"][hid, defs.ST_FAULTS] += 1
+
+    def _push_event(self, ed: _HostsEditor, hid: int, when: int,
+                    kind: int, pkt: np.ndarray):
+        """equeue.q_push mirrored in numpy (eq_next cache maintained)."""
+        eq_time = ed["eq_time"]
+        free = np.flatnonzero(eq_time[hid] == SIMTIME_MAX)
+        if free.size == 0:
+            ed["stats"][hid, defs.ST_EQ_FULL_LOCAL] += 1
+            return
+        q = int(free[0])
+        eq_time[hid, q] = when
+        ed["eq_kind"][hid, q] = kind
+        ed["eq_seq"][hid, q] = ed["eq_ctr"][hid]
+        ed["eq_ctr"][hid] += 1
+        ed["eq_pkt"][hid, q] = pkt
+        ed["eq_next"][hid] = min(int(ed["eq_next"][hid]), when)
+
+    # --- link faults ---
+    def _link_event(self, ev: FaultEvent):
+        if ev.kind == "link_down":
+            key = (min(ev.va, ev.vb), max(ev.va, ev.vb))
+            self.links_down[key] = self.links_down.get(key, 0) + 1
+        elif ev.kind == "link_up":
+            key = (min(ev.va, ev.vb), max(ev.va, ev.vb))
+            n = self.links_down.get(key, 0) - 1
+            if n > 0:
+                self.links_down[key] = n
+            else:
+                self.links_down.pop(key, None)
+        elif ev.kind == "loss_begin":
+            self.loss_eps[ev.eid] = (ev.va, ev.vb, ev.rate)
+        elif ev.kind == "loss_end":
+            self.loss_eps.pop(ev.eid, None)
+        elif ev.kind == "lat_begin":
+            self.lat_eps[ev.eid] = (ev.va, ev.vb, ev.extra_ns)
+        elif ev.kind == "lat_end":
+            self.lat_eps.pop(ev.eid, None)
+
+    def _recompute_shared(self, sh):
+        """Rebuild the effective lat/rel tables from the pristine base
+        plus the active episode set — overlap composes exactly and
+        restores are exact (no drift from repeated in-place edits)."""
+        import jax.numpy as jnp
+        lat = self.base_lat.copy()
+        rel = self.base_rel.copy()
+        for eid in sorted(self.lat_eps):
+            va, vb, extra = self.lat_eps[eid]
+            lat[va, vb] += extra
+            if va != vb:
+                lat[vb, va] += extra
+        for eid in sorted(self.loss_eps):
+            va, vb, rate = self.loss_eps[eid]
+            rel[va, vb] *= (1.0 - rate)
+            if va != vb:
+                rel[vb, va] *= (1.0 - rate)
+        for va, vb in sorted(self.links_down):
+            rel[va, vb] = 0.0
+            if va != vb:
+                rel[vb, va] = 0.0
+        self._cur_lat = lat
+        return sh.replace(lat_ns=jnp.asarray(lat, jnp.int64),
+                          rel=jnp.asarray(rel, jnp.float32))

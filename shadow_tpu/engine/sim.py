@@ -44,6 +44,13 @@ class SimReport:
     capacity: dict = field(default_factory=dict)
     cost: dict = field(default_factory=dict)  # cost_model() inputs:
     #   pass mix per compaction rung, per-row state bytes, warm wall
+    hosted: dict = field(default_factory=dict)  # hosted-process exit
+    #   report: host name -> {"exit_status", "cause", "sim_ns"} from
+    #   the shim supervisor (hosting.runtime.exit_info) — the per-host
+    #   exit status + cause the robustness layer guarantees even when
+    #   a child crashes/hangs mid-run
+    faults: list = field(default_factory=list)  # applied fault events
+    #   in execution order (engine.faults.FaultInjector.log)
 
     def total(self, stat: int) -> int:
         return int(self.stats[:, stat].sum())
@@ -188,6 +195,15 @@ class SimReport:
             "transfers_aborted": self.total(defs.ST_TGEN_ABORT),
             "mean_rtt_us": mean_rtt_us,
         }
+        # robustness figures appear only when the features were used —
+        # keeps the BENCH-diffable section stable for plain runs
+        if self.faults:
+            s["faults_applied"] = len(self.faults)
+        if self.hosted:
+            s["hosted_exits"] = len(self.hosted)
+            s["hosted_failures"] = sum(
+                1 for v in self.hosted.values()
+                if not v.get("clean", False))
         from ..obs import metrics as M
         if M.ENABLED:
             M.REGISTRY.publish("sim", s)
@@ -405,8 +421,14 @@ class Simulation:
                     for idx, _, _, app_name, args in hosted_specs}
             hnames = {idx: hname for idx, _, hname, _, _ in hosted_specs}
             procs = {idx: p for idx, p, _, _, _ in hosted_specs}
+            # zero-arg factories so a fault-injection restart
+            # (engine.faults host_up) can respawn a FRESH instance
+            factories = {
+                idx: (lambda an=app_name, ar=args: lookup(an)(ar))
+                for idx, _, _, app_name, args in hosted_specs}
             self.hosting = HostingRuntime(apps, hnames, self.dns, seed,
-                                          procs=procs)
+                                          procs=procs,
+                                          factories=factories)
             if self.cfg.scap > 256:
                 # hosting packs socket slots into 8-bit handle fields
                 # (hosting/bridge.py op_pipe_open) — larger tables
@@ -420,6 +442,25 @@ class Simulation:
                 # accepts) must all fit the ring or callbacks are lost
                 import dataclasses as _dc
                 self.cfg = _dc.replace(self.cfg, hostedcap=32)
+
+        # --- fault schedule (engine.faults): compiled at build so bad
+        # configs fail here, executed by the run loop at exact sim
+        # times (deterministic; dual same-seed runs bit-identical) ---
+        self.injector = None
+        if scenario.faults:
+            from .faults import FaultInjector, compile_faults
+            name_to_idx = {name: idx
+                           for idx, name, _ in scenario.expand_hosts()}
+            events = compile_faults(scenario.faults, name_to_idx, vertex,
+                                    topo=self.topo,
+                                    stop_time=scenario.stop_time)
+            procs_of_host = {
+                int(h): [int(p) for p in np.flatnonzero(has_app[h])]
+                for h in range(H) if has_app[h].any()}
+            self.injector = FaultInjector(
+                events, self.topo.latency_ns, self.topo.reliability,
+                vertex, procs_of_host, names)
+            self.injector.hosting = self.hosting
 
         self.hp = HostParams(
             hid=jnp.arange(H, dtype=jnp.int32),
@@ -626,6 +667,16 @@ class Simulation:
             if self.hosting:
                 raise NotImplementedError(
                     "hosted apps + multi-process mesh not supported")
+            if self.injector is not None:
+                raise NotImplementedError(
+                    "fault injection + multi-process mesh not "
+                    "supported (host-fault surgery needs addressable "
+                    "state)")
+        if self.injector is not None and resume_from:
+            raise NotImplementedError(
+                "resume with a fault schedule is not supported: the "
+                "snapshot holds device state only, not the injector's "
+                "episode bookkeeping")
             # checkpoint/resume and pcap ARE supported on a
             # multi-process mesh: both allgather the relevant state
             # and process 0 writes the files (pcap rings are a debug
@@ -659,8 +710,8 @@ class Simulation:
             chunk = 1 if self.hosting else cfg.chunk_windows
             per_chip_h = cfg.num_hosts
 
-            def step(hosts, ws, we):
-                return run_windows(hosts, hp, sh, ws, we, cfg, chunk)
+            def step(hosts, sh_seg, ws, we):
+                return run_windows(hosts, hp, sh_seg, ws, we, cfg, chunk)
         else:
             from ..parallel.shard import (AXIS, device_put_sharded,
                                           run_windows_sharded)
@@ -677,9 +728,16 @@ class Simulation:
             # CPU between every window.
             chunk = 1 if self.hosting else cfg.chunk_windows
 
-            def step(hosts, ws, we):
-                return run_windows_sharded(hosts, hp, sh, ws, we, cfg,
-                                           chunk, mesh)
+            def step(hosts, sh_seg, ws, we):
+                return run_windows_sharded(hosts, hp, sh_seg, ws, we,
+                                           cfg, chunk, mesh)
+
+        # the REAL stop time, a loop constant: with a fault schedule
+        # the per-segment device stop_time is clamped to the next
+        # fault (sh_seg below), so every host-side comparison must use
+        # this, not the segment scalar
+        stop_ns = int(sh.stop_time)
+        inj = self.injector
 
         # cost-model bookkeeping (SimReport.cost_model): pass mix per
         # compaction rung + per-row state bytes
@@ -747,10 +805,25 @@ class Simulation:
         prev_events = (int(_ev_sum(hosts.stats))
                        if obs_on and resume_from else 0)
         while True:
+            # fault segmentation (engine.faults): bound this device
+            # segment at the next scheduled fault so the engine
+            # executes every event strictly before it, stops, and the
+            # injector applies the fault at its exact sim time — the
+            # stop_time clamp the window program already honors
+            # (window.win_body's we_eff), reused as the fault barrier
+            sh_seg = sh
+            if inj is not None:
+                nf = inj.next_time()
+                if nf is not None and nf < stop_ns:
+                    sh_seg = sh.replace(stop_time=jnp.int64(nf))
+                    if mesh is not None:
+                        from ..parallel.shard import put_shared
+                        sh_seg = put_shared(sh_seg, mesh)
             if obs_on:
                 _ws0 = int(wstart)
                 _c0 = _time.perf_counter_ns()
-            hosts, wstart, wend, n, pc = step(hosts, wstart, wend)
+            hosts, wstart, wend, n, pc = step(hosts, sh_seg, wstart,
+                                              wend)
             total_windows += int(n)
             pass_acc += np.asarray(pc)
             if first_chunk_wall is None:
@@ -765,7 +838,7 @@ class Simulation:
             if self.hosting is not None:
                 if TR.ENABLED:
                     _h0 = TR.TRACER.now()
-                now = min(ws, int(sh.stop_time))
+                now = min(ws, stop_ns)
                 hosts = self.hosting.step(hosts, hp, sh, now)
                 if mesh is not None:
                     # the op-replay program may hand back differently-
@@ -809,8 +882,7 @@ class Simulation:
                         tr_cnt=jnp.zeros_like(hosts.tr_cnt))
                 if TR.ENABLED:
                     TR.TRACER.complete("pcap.drain", _p0)
-            if tracker is not None and tracker.due(min(ws,
-                                                       int(sh.stop_time))):
+            if tracker is not None and tracker.due(min(ws, stop_ns)):
                 if TR.ENABLED:
                     _t0 = TR.TRACER.now()
                 from ..obs.tracker import socket_columns
@@ -818,9 +890,11 @@ class Simulation:
                 # multi-process mesh only the stats all-gather exists,
                 # so those families are single-process only
                 tracker.maybe_heartbeat(
-                    min(ws, int(sh.stop_time)),
+                    min(ws, stop_ns),
                     dist.gather_stats(hosts.stats)[:H],
-                    socks=None if multiproc else socket_columns(hosts))
+                    socks=None if multiproc else socket_columns(hosts),
+                    hosted_rss=(self.hosting.child_rss()
+                                if self.hosting is not None else None))
                 if TR.ENABLED:
                     TR.TRACER.complete("tracker.heartbeat", _t0)
             if checkpoint_path and ckpt_at is not None and ws >= ckpt_at:
@@ -846,7 +920,7 @@ class Simulation:
                 # under a multi-process mesh — must run uniformly; see
                 # run() docstring) buys the events-executed annotation
                 # on every chunk record
-                sim_end = min(ws, int(sh.stop_time))
+                sim_end = min(ws, stop_ns)
                 ev_total = int(_ev_sum(hosts.stats))
                 ev = ev_total - prev_events
                 prev_events = ev_total
@@ -877,7 +951,37 @@ class Simulation:
             if verbose:
                 print(f"  t={ws / SIMTIME_ONE_SECOND:.3f}s "
                       f"windows={total_windows}")
-            if ws >= int(sh.stop_time) or ws >= SIMTIME_MAX:
+            # fault application: the engine drained every event below
+            # the segment bound — apply the head fault batch at its
+            # own time, then re-derive the window (a kill's RSTs and a
+            # restart's start events may open one before the old ws)
+            if inj is not None:
+                nf = inj.next_time()
+                if nf is not None and nf < stop_ns and ws >= nf:
+                    if TR.ENABLED:
+                        _fi0 = TR.TRACER.now()
+                    hosts, sh = inj.apply_batch(hosts, sh)
+                    if mesh is not None:
+                        from ..parallel.shard import (put_hosts,
+                                                      put_shared)
+                        hosts = put_hosts(hosts, mesh)
+                        sh = put_shared(sh, mesh)
+                    nt = jnp.minimum(jnp.min(hosts.eq_next),
+                                     jnp.min(hosts.ob_next))
+                    wstart = nt
+                    wend = jnp.where(nt == SIMTIME_MAX, nt,
+                                     nt + sh.min_jump)
+                    ws = int(wstart)
+                    if TR.ENABLED:
+                        TR.TRACER.complete("faults.apply", _fi0)
+            # a pending fault must keep the loop alive even when the
+            # engine has nothing left to do (ws hits SIMTIME_MAX once
+            # the queues drain, yet a host_up restart re-populates
+            # them; one fault batch is consumed per iteration, so this
+            # terminates)
+            more_faults = (inj is not None and inj.next_time() is not None
+                           and inj.next_time() < stop_ns)
+            if (ws >= stop_ns or ws >= SIMTIME_MAX) and not more_faults:
                 break
         if pcap is not None:
             pcap.close()
@@ -895,7 +999,7 @@ class Simulation:
             ("outbox", cfg.obcap, int(peaks[2])),
             ("nic_txq", cfg.txqcap, int(peaks[3])),
         ]}
-        sim_ns = min(int(sh.stop_time), ws) if ws < SIMTIME_MAX else int(sh.stop_time)
+        sim_ns = min(stop_ns, ws) if ws < SIMTIME_MAX else stop_ns
         import os as _os
         warm = (wall - first_chunk_wall
                 if first_chunk_wall is not None and
@@ -916,7 +1020,10 @@ class Simulation:
                            sim_time_ns=sim_ns, wall_seconds=wall,
                            windows=total_windows,
                            heartbeats=(tracker.lines if tracker else []),
-                           capacity=capacity, cost=cost)
+                           capacity=capacity, cost=cost,
+                           hosted=(self.hosting.exit_info()
+                                   if self.hosting is not None else {}),
+                           faults=(inj.log if inj is not None else []))
         if TR.ENABLED:
             TR.TRACER.complete("report.finalize", _f0)
         if MT.ENABLED:

@@ -120,6 +120,17 @@ class HostOS:
         if isinstance(sock, Sock) and sock.slot is not None:
             self._socks.pop((sock.slot, sock.gen), None)
 
+    def abort(self, sock):
+        """Abortive close (net.tcp.tcp_abort_call): an established TCP
+        connection sends RST toward the peer instead of draining a FIN;
+        anything else frees immediately. The teardown a supervisor
+        issues for a dead hosted process's leftover sockets — the peer
+        sees a reset, as it would from a real kernel reaping a killed
+        process."""
+        self._push(_PendingOp(9, a=self._slot(sock)))
+        if isinstance(sock, Sock) and sock.slot is not None:
+            self._socks.pop((sock.slot, sock.gen), None)
+
     def timer(self, delay_ns: int, tag: int = 0):
         self._push(_PendingOp(7, a=self.now() + int(delay_ns),
                               b=int(tag)))

@@ -55,6 +55,7 @@ OP_UDP_SENDTO = 5    # a=slot, b=dst host, c=(port<<32)|aux, d=nbytes
 OP_CLOSE = 6         # a=slot (tcp/udp or pipe half; proto-dispatched)
 OP_TIMER = 7         # a=deadline ns (absolute), b=tag
 OP_PIPE_OPEN = 8     # -> packed pair (see _pipe_result)
+OP_ABORT = 9         # a=slot: abortive close (RST when established)
 
 
 def hosted_wake(row, hp, sh, now, pkt):
@@ -103,7 +104,7 @@ def _apply_one(hosts, hp, sh, op, results):
     # app-chosen negative timer tag). Slot operands by opcode: word 2
     # for WRITE/SENDTO/CLOSE — opens return slots, they never take them.
     slot_op = (code == OP_TCP_WRITE) | (code == OP_UDP_SENDTO) | \
-              (code == OP_CLOSE)
+              (code == OP_CLOSE) | (code == OP_ABORT)
     # NOTE: pipe handles resolve host-side (pipe opens bind both
     # halves from one packed result), so OP_PIPE_OPEN takes no slot
     # operands and pipe writes/closes arrive as ordinary slot ints
@@ -195,10 +196,23 @@ def _apply_one(hosts, hp, sh, op, results):
                   (gen_b << 8) | (b & 0xFF))
         return r, jnp.where(ok, packed, -1).astype(_I32)
 
+    def op_abort(r):
+        # abortive teardown (supervisor path): pipes just close; TCP
+        # resets an established peer, frees anything else
+        from ..net.channel import PROTO_PIPE, pipe_close
+        from ..net.tcp import tcp_abort_call
+        slot = op[2].astype(_I32)
+        is_pipe = rget(r.sk_proto, slot) == PROTO_PIPE
+        r = jax.lax.cond(
+            is_pipe,
+            lambda r2: pipe_close(r2, now, slot),
+            lambda r2: tcp_abort_call(r2, now, slot), r)
+        return r, _I32(0)
+
     row, result = jax.lax.switch(
-        jnp.clip(code, 0, 8),
+        jnp.clip(code, 0, 9),
         [op_nop, op_udp_open, op_listen, op_connect, op_write, op_sendto,
-         op_close, op_timer, op_pipe_open], row)
+         op_close, op_timer, op_pipe_open, op_abort], row)
     # restore the between-dispatches invariant (app_proc == 0)
     row = row.replace(app_proc=_I32(0))
     hosts = jax.tree.map(lambda a, v: a.at[h].set(v), hosts, row)

@@ -22,15 +22,24 @@ class HostingRuntime:
     """Owns the hosted app instances and the window-boundary exchange."""
 
     def __init__(self, apps: dict, names: dict, dns, seed: int,
-                 batch_cap: int = 256, procs: dict = None):
+                 batch_cap: int = 256, procs: dict = None,
+                 factories: dict = None):
         # apps: host_id -> HostedApp; names: host_id -> hostname;
         # procs: host_id -> the hosted process's slot on its host
         # (0 when the hosted app is the only process — the op replay
-        # stamps it so sockets wake the hosted slot, not process 0)
+        # stamps it so sockets wake the hosted slot, not process 0);
+        # factories: host_id -> zero-arg callable producing a FRESH
+        # app instance (fault-injection restarts respawn through it)
         self.apps = apps
         self.procs = procs or {}
+        self.factories = factories or {}
+        self.names = names
         self.batch_cap = batch_cap
         self._now = 0
+        self._dead = set()      # generic apps killed by a fault (shim
+        #   apps self-guard; these need their wakes suppressed here)
+        self._exit_log = {}     # host_id -> exit record of the LAST
+        #   death (a restarted-and-surviving child leaves no record)
         # one per-simulation payload broker (api.PayloadBroker): hosted
         # apps that move REAL bytes (the LD_PRELOAD shim) share it so
         # hosted<->hosted TCP connections deliver actual payloads
@@ -59,6 +68,81 @@ class HostingRuntime:
     def has_hosts(self) -> bool:
         return bool(self.apps)
 
+    # --- supervision / fault-injection surface (engine.faults) ---
+    def kill_host(self, hid: int, cause: str, sim_ns: int):
+        """host_down: SIGKILL a shim child (ShimApp.fault_kill records
+        the cause); a pure-Python hosted app just stops receiving
+        wakes. The injector scrubs the device state itself."""
+        app = self.apps.get(hid)
+        if app is None:
+            return
+        fk = getattr(app, "fault_kill", None)
+        if fk is not None:
+            fk(cause, sim_ns)
+        else:
+            self._dead.add(hid)
+            self._exit_log[hid] = {"exit_status": None, "cause": cause,
+                                   "sim_ns": sim_ns, "clean": False,
+                                   "violations": []}
+
+    def restart_host(self, hid: int):
+        """host_up: archive the dead instance's exit record and swap
+        in a FRESH app from its factory (a shim app respawns its child
+        on the WAKE_START the injector re-arms). The HostOS — and with
+        it the per-host RNG stream — carries over: the restarted
+        process continues the host's deterministic entropy sequence."""
+        old = self.apps.get(hid)
+        if old is not None:
+            # a host_up with no preceding host_down replaces a LIVE
+            # instance: reap its child/channel first or the orphan
+            # process outlives the simulation (end-of-run shutdown
+            # only walks the current apps)
+            fk = getattr(old, "fault_kill", None)
+            if fk is not None:
+                fk("fault: host_up replaced the live instance", None)
+            info = getattr(old, "exit_info", None)
+            rec = info() if info is not None else None
+            if rec is not None:
+                self._exit_log[hid] = rec
+        factory = self.factories.get(hid)
+        if factory is None:
+            self._dead.discard(hid)
+            return
+        app = factory()
+        attach = getattr(app, "attach_payload_broker", None)
+        if attach is not None:
+            attach(self.payloads)
+        self.apps[hid] = app
+        self._dead.discard(hid)
+
+    def exit_info(self) -> dict:
+        """Per-host exit report, keyed by hostname (SimReport.hosted):
+        the latest death of each hosted process, including children
+        reaped at end-of-run shutdown."""
+        out = {}
+        for hid, app in sorted(self.apps.items()):
+            rec = None
+            info = getattr(app, "exit_info", None)
+            if info is not None:
+                rec = info()
+            if rec is None:
+                rec = self._exit_log.get(hid)
+            if rec is not None:
+                out[self.names.get(hid, f"host{hid}")] = rec
+        return out
+
+    def child_rss(self) -> dict:
+        """host_id -> resident-set bytes of live hosted children (the
+        [ram] tracker heartbeat column; obs.tracker)."""
+        out = {}
+        for hid, app in self.apps.items():
+            rss = getattr(app, "rss_bytes", None)
+            if rss is not None:
+                v = rss()
+                if v is not None:
+                    out[hid] = v
+        return out
+
     def step(self, hosts, hp, sh, now_ns: int):
         """Drain wake rings, dispatch app callbacks, apply the op batch.
         Returns updated hosts."""
@@ -77,7 +161,7 @@ class HostingRuntime:
 
         for t, hid, i in recs:
             app = self.apps.get(hid)
-            if app is None:
+            if app is None or hid in self._dead:
                 continue
             os = self.os[hid]
             self._now = t

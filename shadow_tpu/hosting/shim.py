@@ -93,6 +93,8 @@ OP_SLEEP = 17
 OP_POLL = 18
 OP_RANDOM = 19
 OP_GETNAME = 20
+OP_VIOLATION = 21   # child attempted a refused operation (fork/exec):
+#                     name carries what; diagnostic only, answer is 0
 
 # op code -> metric name (obs.metrics shim.op.* counters and
 # shim.op_us.* latency histograms, recorded per served request)
@@ -104,7 +106,7 @@ OP_NAMES = {
     OP_RESOLVE: "resolve", OP_BIND: "bind", OP_LISTEN: "listen",
     OP_ACCEPT: "accept", OP_SENDTO: "sendto", OP_RECVFROM: "recvfrom",
     OP_SLEEP: "sleep", OP_POLL: "poll", OP_RANDOM: "random",
-    OP_GETNAME: "getname",
+    OP_GETNAME: "getname", OP_VIOLATION: "violation",
 }
 
 EPOLLIN = 0x001
@@ -144,6 +146,36 @@ SHIM_C = _os.path.join(_SRC, "shim_preload.c")
 # and closes within its accept window (banner-then-close) must not lose
 # its bytes (round-4 advisor, shim OP_CLOSE)
 GRACE_NS = 30 * 10**9
+
+# hung-child watchdog: WALL-clock ceiling on one channel read. The
+# protocol is lockstep, so between our reads the child is either
+# computing (bounded by its own work) or about to issue its next
+# request; a child stuck in a busy loop or wedged in real libc makes
+# no RPC progress and would otherwise freeze the whole simulator
+# inside _read_req. SHADOW_SHIM_WATCHDOG_S overrides; 0 disables.
+WATCHDOG_S_DEFAULT = 30.0
+
+
+class ShimHang(Exception):
+    """Watchdog: the child made no RPC progress within the deadline."""
+
+
+class ShimProtocolError(Exception):
+    """The channel carried something the protocol forbids (short read
+    mid-frame, oversized trailing payload, ...) — unrecoverable
+    framing; the supervisor kills the channel, not the simulator."""
+
+
+def _status_cause(status):
+    """OS exit status -> (cause string, clean?) for the exit report."""
+    if status is not None and status < 0:
+        import signal as _signal
+        try:
+            signame = _signal.Signals(-status).name
+        except ValueError:
+            signame = f"signal {-status}"
+        return f"killed by {signame}", False
+    return f"exited status={status}", status == 0
 
 
 def build_shim(out_dir: str = None) -> str:
@@ -235,6 +267,15 @@ class ShimApp(HostedApp):
         self.parked = None
         self.park_seq = 0         # increments per park: stale-timeout guard
         self.exited = False
+        # --- supervision (per-host exit report; SimReport.hosted) ---
+        self.exit_status = None   # OS exit status (negative = -signal)
+        self.exit_cause = None    # human diagnosis ("hung: ...", ...)
+        self.exit_sim_ns = None   # sim time the death was observed
+        self.exit_clean = False   # True: status-0 exit / end-of-run
+        self.violations = []      # refused ops the child attempted
+        self.watchdog_s = float(
+            _os.environ.get("SHADOW_SHIM_WATCHDOG_S",
+                            str(WATCHDOG_S_DEFAULT)) or 0)
         self._payloads = None     # api.PayloadBroker (runtime attaches)
         self._opened = set()      # broker keys this app opened
         self._mysubs = set()      # the subset I subscribed (I read)
@@ -305,12 +346,33 @@ class ShimApp(HostedApp):
             stdout.close()
         theirs.close()
         self.chan = ours
+        # wall-clock RPC deadline (module doc above WATCHDOG_S_DEFAULT):
+        # applies to every channel read AND write, so a child that
+        # stops draining its end cannot wedge _rsp either
+        if self.watchdog_s > 0:
+            self.chan.settimeout(self.watchdog_s)
+
+    def _recv(self, n):
+        """One watchdog-supervised channel read."""
+        import socket as pysock
+        try:
+            return self.chan.recv(n)
+        except pysock.timeout:
+            raise ShimHang(
+                f"no RPC progress within {self.watchdog_s:g}s wall"
+                f" (pid {self.proc.pid if self.proc else '?'})")
 
     def _read_req(self):
         buf = b""
         while len(buf) < REQ.size:
-            chunk = self.chan.recv(REQ.size - len(buf))
+            chunk = self._recv(REQ.size - len(buf))
             if not chunk:
+                if buf:
+                    # EOF inside a frame: the child died (or wrote
+                    # garbage) mid-request — diagnose, don't desync
+                    raise ShimProtocolError(
+                        f"channel EOF mid-request "
+                        f"({len(buf)}/{REQ.size} header bytes)")
                 return None
             buf += chunk
         return REQ.unpack(buf)
@@ -319,10 +381,14 @@ class ShimApp(HostedApp):
         """n trailing payload bytes of an OP_SEND/OP_POLL request."""
         buf = bytearray()
         n = int(n)
+        if n < 0 or n > (64 << 20):
+            raise ShimProtocolError(
+                f"request claims {n} trailing payload bytes")
         while len(buf) < n:
-            chunk = self.chan.recv(min(n - len(buf), 1 << 20))
+            chunk = self._recv(min(n - len(buf), 1 << 20))
             if not chunk:
-                return None
+                raise ShimProtocolError(
+                    f"channel EOF mid-payload ({len(buf)}/{n} bytes)")
             buf += chunk
         return bytes(buf)
 
@@ -398,8 +464,9 @@ class ShimApp(HostedApp):
         which must fail loud, not corrupt state."""
         vfd = int(vfd)
         if vfd in self.vfds or vfd in self.epolls:
-            raise RuntimeError(
-                f"shim protocol error: vfd {vfd} re-reserved while live")
+            raise ShimProtocolError(
+                f"vfd {vfd} re-reserved while live (close-tracking "
+                "desync)")
         return vfd
 
     def _rsp_accept(self, vs, cfd):
@@ -526,26 +593,163 @@ class ShimApp(HostedApp):
 
     # --- the service loop: run the child until it blocks ---
     def _service(self, os):
+        """Run the child until it blocks — SUPERVISED: a hung child
+        (watchdog), a malformed frame (protocol validation) or a
+        channel failure becomes a diagnosed child death and the
+        simulation continues; only the hosted process dies."""
         if self.exited:
             return
-        self._maybe_unpark()
-        while self.parked is None and not self.exited:
-            req = self._read_req()
-            if req is None:
-                self.exited = True
-                if self.proc is not None:
-                    self.proc.wait()
-                break
-            # per-op protocol metrics: count + HANDLER latency (a call
-            # that parks is counted when it arrives; the sim-time it
-            # stays parked is not wall cost)
-            _t0 = _time.perf_counter_ns() if _MT.ENABLED else None
-            self._handle(os, *req)
-            if _t0 is not None:
-                _MT.shim_op(OP_NAMES.get(req[0], str(req[0])),
-                            _time.perf_counter_ns() - _t0)
+        try:
+            self._maybe_unpark()
+            while self.parked is None and not self.exited:
+                req = self._read_req()
+                if req is None:
+                    self._child_gone(os)       # clean channel EOF
+                    break
+                # per-op protocol metrics: count + HANDLER latency (a
+                # call that parks is counted when it arrives; the
+                # sim-time it stays parked is not wall cost)
+                _t0 = _time.perf_counter_ns() if _MT.ENABLED else None
+                self._handle(os, *req)
+                if _t0 is not None:
+                    _MT.shim_op(OP_NAMES.get(req[0], str(req[0])),
+                                _time.perf_counter_ns() - _t0)
+        except ShimHang as e:
+            self._supervise_kill(os, f"hung: {e}")
+        except ShimProtocolError as e:
+            self._supervise_kill(os, f"protocol error: {e}")
+        except (KeyError, IndexError, struct.error) as e:
+            # a malformed opcode/operand must not surface as a
+            # traceback that takes the simulator down (tentpole
+            # contract): diagnose and kill the channel instead
+            self._supervise_kill(
+                os, f"protocol error: malformed request "
+                    f"({type(e).__name__}: {e})")
+        except OSError as e:
+            self._supervise_kill(os, f"channel failure: {e}")
         if self.exited:
             self._sweep_streams()
+
+    def _supervise_kill(self, os, cause):
+        """Supervisor verdict: the child is unusable — SIGKILL it,
+        record the diagnosis, tear its sockets down abortively."""
+        import sys as _sys
+        _sys.stderr.write(
+            f"shadow_tpu: shim[{_os.path.basename(self.argv[0])}]: "
+            f"{cause} — killing hosted child; simulation continues\n")
+        if _MT.ENABLED:
+            _MT.REGISTRY.counter("shim.supervisor_kills").inc()
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+        self._child_gone(os, cause=cause)
+
+    def _child_gone(self, os, cause=None):
+        """The child is dead (clean exit, crash, or supervisor kill):
+        record per-host exit status + cause, release the channel, and
+        convert the sockets it left open into RST/EOF toward peers —
+        the simulation keeps running (tentpole contract; the reference
+        analogue is process teardown, shd-process.c:3195-3234)."""
+        self.exited = True
+        if self.proc is not None:
+            try:
+                self.proc.wait(timeout=5)
+            except Exception:
+                pass
+            self.exit_status = self.proc.returncode
+        if self.exit_cause is None:
+            if cause is not None:
+                self.exit_cause = cause
+                self.exit_clean = False
+            else:
+                self.exit_cause, self.exit_clean = _status_cause(
+                    self.exit_status)
+        if os is not None and self.exit_sim_ns is None:
+            self.exit_sim_ns = os.now()
+        if _MT.ENABLED:
+            _MT.REGISTRY.counter("shim.child_exits").inc()
+        if self.chan is not None:
+            try:
+                self.chan.close()
+            except OSError:
+                pass
+            self.chan = None
+        self.parked = None
+        if os is None:
+            return
+        # leftover socket teardown, deterministic vfd order. A clean
+        # exit closes gracefully (the kernel FINs a closed fd) except
+        # where delivered-but-unread bytes sit (a real stack RSTs
+        # then); any diagnosed death resets everything.
+        graceful = self.exit_clean
+        for vfd in sorted(self.vfds):
+            vs = self.vfds[vfd]
+            for child, _, _, _ in vs.accept_q:
+                os.abort(child)        # never-accepted server children
+            vs.accept_q = []
+            if vs.sock is not None and not vs.closed:
+                if graceful and vs.avail == 0 and vs.kind != "listen":
+                    os.close(vs.sock)
+                else:
+                    os.abort(vs.sock)
+                vs.closed = True
+
+    def exit_info(self) -> dict:
+        """Per-host exit record for SimReport.hosted (None while the
+        child is alive and unsupervised)."""
+        if not self.exited and self.exit_cause is None:
+            return None
+        return {"exit_status": self.exit_status,
+                "cause": self.exit_cause,
+                "sim_ns": self.exit_sim_ns,
+                "clean": bool(self.exit_clean),
+                "violations": list(self.violations)}
+
+    def rss_bytes(self):
+        """Hosted child resident set (bytes) off /proc statm — the
+        [ram] tracker column (reference shd-tracker.c:266 reports real
+        process RSS; modeled hosts have none). None once dead."""
+        if self.proc is None or self.proc.poll() is not None:
+            return None
+        try:
+            with open(f"/proc/{self.proc.pid}/statm") as f:
+                pages = int(f.read().split()[1])
+            return pages * (_os.sysconf("SC_PAGE_SIZE"))
+        except (OSError, ValueError, IndexError):
+            return None
+
+    def fault_kill(self, cause, sim_ns):
+        """engine.faults host_down: SIGKILL the child and record the
+        cause. No socket ops are issued — the injector scrubs the dead
+        host's device state itself and radiates the RSTs."""
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+            try:
+                self.proc.wait(timeout=5)
+            except Exception:
+                pass
+        if not self.exited:
+            self.exit_status = (self.proc.returncode
+                                if self.proc is not None else None)
+            self.exit_cause = cause
+            self.exit_sim_ns = sim_ns
+            self.exit_clean = False
+        self.exited = True
+        self.parked = None
+        if self.chan is not None:
+            try:
+                self.chan.close()
+            except OSError:
+                pass
+            self.chan = None
+        # the host is GONE: pending grace timers died with its event
+        # queue, so perform their deferred reader-less drops now
+        if self._payloads is not None:
+            for key in self._grace.values():
+                if not self._payloads.subscribed(key):
+                    self._payloads.drop(key)
+                    self._opened.discard(key)
+        self._grace = {}
+        self._sweep_streams()
 
     def _park_timer(self, os, ns, kind, operand=0):
         """Arm a sim-time timer tagged to the CURRENT park (park_seq
@@ -561,17 +765,12 @@ class ShimApp(HostedApp):
             # are not materialized) — the C side stamps the flag from
             # its own per-fd state, so framing never depends on
             # mirrored tables
-            payload = self._read_n(b)
-            if payload is None:
-                self.exited = True
-                return
+            payload = self._read_n(b)   # raises ShimProtocolError on
+            #                             EOF mid-frame (supervised)
         else:
             payload = b""
         if op == OP_POLL:
             raw = self._read_n(b)
-            if raw is None:
-                self.exited = True
-                return
             interest = {}
             for i in range(int(a)):
                 fd, events = EVPAIR.unpack_from(raw, i * EVPAIR.size)
@@ -781,6 +980,20 @@ class ShimApp(HostedApp):
                 self._rsp(-1, ENOTCONN)
             else:
                 self._rsp(*self._name_of(os, vs, which=int(b)))
+        elif op == OP_VIOLATION:
+            # the child attempted a refused operation (fork/vfork/
+            # exec*: shim_preload.c returned ENOSYS); record the
+            # diagnostic so the refusal is visible in the exit report
+            # and metrics, not only on the child's stderr
+            what = name.rstrip(b"\0").decode(errors="replace") or "?"
+            self.violations.append(what)
+            import sys as _sys
+            _sys.stderr.write(
+                f"shadow_tpu: shim[{_os.path.basename(self.argv[0])}]:"
+                f" child attempted {what} — refused (ENOSYS)\n")
+            if _MT.ENABLED:
+                _MT.REGISTRY.counter("shim.violations").inc()
+            self._rsp(0)
         elif op == OP_CLOCK:
             self._rsp(os.now())
         elif op == OP_RESOLVE:
@@ -790,7 +1003,10 @@ class ShimApp(HostedApp):
                 hid = -1
             self._rsp(hid)
         else:
-            self._rsp(-1)
+            # an opcode this side does not speak is framing poison:
+            # its (unknown) trailing payload would desync every later
+            # frame — diagnosed channel kill, not a guessed answer
+            raise ShimProtocolError(f"unknown opcode {int(op)}")
 
     def _name_of(self, os, vs, which):
         """getsockname (which=0) / getpeername (which=1) answer:
@@ -934,12 +1150,33 @@ class ShimApp(HostedApp):
             except OSError:
                 pass
             self.chan = None
-        if self.proc is not None and self.proc.poll() is None:
-            self.proc.terminate()
-            try:
-                self.proc.wait(timeout=5)
-            except Exception:
-                self.proc.kill()
+        if self.proc is not None:
+            was_alive = self.proc.poll() is None
+            if was_alive:
+                self.proc.terminate()
+                try:
+                    self.proc.wait(timeout=5)
+                except Exception:
+                    self.proc.kill()
+                    try:
+                        self.proc.wait(timeout=5)
+                    except Exception:
+                        pass
+            if self.exit_status is None:
+                self.exit_status = self.proc.returncode
+            if self.exit_cause is None:
+                if was_alive:
+                    # truncated by the stop time while healthy — a
+                    # normal end for a long-running hosted process
+                    self.exit_cause = "terminated at end of run"
+                    self.exit_clean = True
+                else:
+                    # the child had already died on its own but the
+                    # death was never serviced (e.g. crashed while
+                    # parked): report the REAL status, not a healthy
+                    # truncation
+                    self.exit_cause, self.exit_clean = _status_cause(
+                        self.exit_status)
         self.exited = True
         self._sweep_streams()
 

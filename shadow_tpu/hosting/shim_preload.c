@@ -104,7 +104,7 @@ enum {
     OP_SOCKET = 1, OP_CONNECT, OP_SEND, OP_RECV, OP_CLOSE, OP_SHUTDOWN,
     OP_EPOLL_CREATE, OP_EPOLL_CTL, OP_EPOLL_WAIT, OP_CLOCK, OP_RESOLVE,
     OP_BIND, OP_LISTEN, OP_ACCEPT, OP_SENDTO, OP_RECVFROM,
-    OP_SLEEP, OP_POLL, OP_RANDOM, OP_GETNAME,
+    OP_SLEEP, OP_POLL, OP_RANDOM, OP_GETNAME, OP_VIOLATION,
 };
 
 struct req { int32_t op; int32_t a; int64_t b; int64_t c; char name[64]; };
@@ -916,6 +916,196 @@ int openat(int dirfd, const char *path, int flags, ...) {
     static int (*real_openat)(int, const char *, int, ...);
     if (!real_openat) real_openat = dlsym(RTLD_NEXT, "openat");
     return real_openat(dirfd, path, flags, mode);
+}
+
+/* --- fopen entropy (ADVICE r5): glibc's fopen calls an INTERNAL open,
+ * so the open/open64/openat interposition above never sees
+ * fopen("/dev/urandom") and the stream would read real kernel entropy
+ * — breaking the determinism guarantee for stdio-based readers. Back
+ * the stream with fopencookie over random_fill instead (the reference
+ * interposes fopen/fopen64 for the same reason, shd-interposer.c). */
+
+static ssize_t random_cookie_read(void *cookie, char *buf, size_t n) {
+    (void)cookie;
+    return random_fill(buf, n);
+}
+
+static FILE *random_stream(void) {
+    cookie_io_functions_t io = {0};
+    io.read = random_cookie_read;
+    FILE *f = fopencookie(NULL, "r", io);
+    /* unbuffered: stdio readahead would pull KBs per small fread,
+     * consuming a different amount of the host PRNG stream than the
+     * getrandom/open paths do for the same app behavior */
+    if (f) setvbuf(f, NULL, _IONBF, 0);
+    return f;
+}
+
+FILE *fopen(const char *path, const char *mode) {
+    shim_init();
+    static FILE *(*real_fopen)(const char *, const char *);
+    if (!real_fopen) real_fopen = dlsym(RTLD_NEXT, "fopen");
+    if (active() && is_random_path(path)) return random_stream();
+    return real_fopen(path, mode);
+}
+
+FILE *fopen64(const char *path, const char *mode) {
+    shim_init();
+    static FILE *(*real_fopen64)(const char *, const char *);
+    if (!real_fopen64) real_fopen64 = dlsym(RTLD_NEXT, "fopen64");
+    if (active() && is_random_path(path)) return random_stream();
+    return real_fopen64(path, mode);
+}
+
+/* --- process creation: REFUSED (reference shd-process.c:3195-3234).
+ * A forked/exec'd child would share the control channel fd with no
+ * protocol identity of its own, make raw libc calls outside the sim,
+ * and escape the clock/entropy/network virtualization entirely — the
+ * classic sandbox escape. Refuse LOUDLY: errno = ENOSYS, a stderr
+ * diagnostic, and an OP_VIOLATION record so the simulator's exit
+ * report names the attempt (hosting.shim). Only PLT calls interpose —
+ * a static binary or an internal glibc clone bypasses this, like
+ * every LD_PRELOAD scheme. */
+
+static int refuse(const char *what) {
+    shim_init();
+    fprintf(stderr, "shadow-shim: %s refused — hosted processes "
+            "cannot fork/exec inside the simulation\n", what);
+    if (active()) call(OP_VIOLATION, 0, 0, 0, what);
+    errno = ENOSYS;
+    return -1;
+}
+
+pid_t fork(void) {
+    if (!active()) {
+        static pid_t (*real_fork)(void);
+        if (!real_fork) real_fork = dlsym(RTLD_NEXT, "fork");
+        return real_fork();
+    }
+    return (pid_t)refuse("fork");
+}
+
+pid_t vfork(void) {
+    if (!active()) {
+        static pid_t (*real_vfork)(void);
+        if (!real_vfork) real_vfork = dlsym(RTLD_NEXT, "vfork");
+        return real_vfork();
+    }
+    return (pid_t)refuse("vfork");
+}
+
+int execve(const char *p, char *const a[], char *const e[]) {
+    if (!active()) {
+        static int (*real_ev)(const char *, char *const[],
+                              char *const[]);
+        if (!real_ev) real_ev = dlsym(RTLD_NEXT, "execve");
+        return real_ev(p, a, e);
+    }
+    return refuse("execve");
+}
+
+int execv(const char *p, char *const a[]) {
+    if (!active()) {
+        static int (*real_v)(const char *, char *const[]);
+        if (!real_v) real_v = dlsym(RTLD_NEXT, "execv");
+        return real_v(p, a);
+    }
+    return refuse("execv");
+}
+
+int execvp(const char *p, char *const a[]) {
+    if (!active()) {
+        static int (*real_vp)(const char *, char *const[]);
+        if (!real_vp) real_vp = dlsym(RTLD_NEXT, "execvp");
+        return real_vp(p, a);
+    }
+    return refuse("execvp");
+}
+
+int execvpe(const char *p, char *const a[], char *const e[]) {
+    if (!active()) {
+        static int (*real_vpe)(const char *, char *const[],
+                               char *const[]);
+        if (!real_vpe) real_vpe = dlsym(RTLD_NEXT, "execvpe");
+        return real_vpe(p, a, e);
+    }
+    return refuse("execvpe");
+}
+
+int fexecve(int fd, char *const a[], char *const e[]) {
+    if (!active()) {
+        static int (*real_fe)(int, char *const[], char *const[]);
+        if (!real_fe) real_fe = dlsym(RTLD_NEXT, "fexecve");
+        return real_fe(fd, a, e);
+    }
+    return refuse("fexecve");
+}
+
+/* variadic execl family: a faithful passthrough would need to rebuild
+ * the argv — refuse unconditionally under the sim, and rebuild is
+ * unnecessary outside it because the shim only loads via the
+ * simulator's LD_PRELOAD (active() is the only supported state). */
+int execl(const char *p, const char *arg, ...) {
+    (void)p; (void)arg;
+    return refuse("execl");
+}
+
+int execlp(const char *p, const char *arg, ...) {
+    (void)p; (void)arg;
+    return refuse("execlp");
+}
+
+int execle(const char *p, const char *arg, ...) {
+    (void)p; (void)arg;
+    return refuse("execle");
+}
+
+int posix_spawn(pid_t *pid, const char *path, const void *fa,
+                const void *attr, char *const argv[],
+                char *const envp[]) {
+    if (!active()) {
+        static int (*real_ps)(pid_t *, const char *, const void *,
+                              const void *, char *const[],
+                              char *const[]);
+        if (!real_ps) real_ps = dlsym(RTLD_NEXT, "posix_spawn");
+        return real_ps(pid, path, fa, attr, argv, envp);
+    }
+    refuse("posix_spawn");
+    return ENOSYS;   /* posix_spawn returns the errno, not -1 */
+}
+
+int posix_spawnp(pid_t *pid, const char *file, const void *fa,
+                 const void *attr, char *const argv[],
+                 char *const envp[]) {
+    if (!active()) {
+        static int (*real_psp)(pid_t *, const char *, const void *,
+                               const void *, char *const[],
+                               char *const[]);
+        if (!real_psp) real_psp = dlsym(RTLD_NEXT, "posix_spawnp");
+        return real_psp(pid, file, fa, attr, argv, envp);
+    }
+    refuse("posix_spawnp");
+    return ENOSYS;
+}
+
+int system(const char *cmd) {
+    if (!active()) {
+        static int (*real_system)(const char *);
+        if (!real_system) real_system = dlsym(RTLD_NEXT, "system");
+        return real_system(cmd);
+    }
+    if (!cmd) return 0;   /* POSIX: NULL asks "is a shell available" */
+    return refuse("system");
+}
+
+FILE *popen(const char *cmd, const char *mode) {
+    if (!active()) {
+        static FILE *(*real_popen)(const char *, const char *);
+        if (!real_popen) real_popen = dlsym(RTLD_NEXT, "popen");
+        return real_popen(cmd, mode);
+    }
+    refuse("popen");
+    return NULL;
 }
 
 /* --- threads: fail LOUDLY until multi-threaded hosting exists ---------- */

@@ -178,6 +178,35 @@ def tcp_close_call(row, now, slot):
     return jax.lax.cond(instant, now_free, deferred, row)
 
 
+def tcp_abort_call(row, now, slot):
+    """Abortive close (the SO_LINGER-0 shape): RST toward an
+    established peer instead of the FIN drain, immediate free
+    otherwise. The supervisor path for a crashed/killed hosted process
+    (hosting.shim child death) — the peer must observe a reset, not a
+    clean shutdown, mirroring what the kernel does to a SIGKILLed
+    process's connections (reference: process teardown closes
+    descriptors abortively, shd-process.c:3195-3234 vicinity)."""
+    used = rget(row.sk_used, slot)
+    state = rget(row.sk_state, slot)
+    connected = (used & (rget(row.sk_proto, slot) == P.PROTO_TCP) &
+                 (state >= TCPS_ESTABLISHED) & (state != TCPS_TIME_WAIT) &
+                 (rget(row.sk_rhost, slot) >= 0))
+
+    def rst(r):
+        # CTL_RST outranks everything in tcp_pull; the emit frees the
+        # socket (RST teardown after emit). Clear close_after so a
+        # pending graceful FIN cannot race the reset.
+        r = _set(r, slot, sk_ctl=rget(r.sk_ctl, slot) | CTL_RST,
+                 sk_close_after=jnp.bool_(False))
+        return nic.kick(r, now)
+
+    def free(r):
+        return jax.lax.cond(used, lambda rr: sock_free(rr, slot),
+                            lambda rr: rr, r)
+
+    return jax.lax.cond(connected, rst, free, row)
+
+
 # --- Transmit path (NIC pull) ----------------------------------------------
 
 def _win_bytes(row, slot):

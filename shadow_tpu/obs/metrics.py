@@ -173,10 +173,24 @@ class Registry:
                if k.startswith("shim.op.")}
         lat = {k[len("shim.op_us."):]: v for k, v in hists.items()
                if k.startswith("shim.op_us.")}
-        return {"sim": sim,
-                "shim": {"ops": ops, "op_latency_us": lat},
-                "counters": counters, "gauges": gauges,
-                "histograms": hists, "chunks": len(self.chunks)}
+        # robustness views: the supervision layer's child-exit /
+        # violation counters (hosting.shim) and the applied-fault
+        # counts per kind (engine.faults) — shaped for diffing like
+        # the shim section, present only when nonzero
+        superv = {k[len("shim."):]: v for k, v in counters.items()
+                  if k in ("shim.child_exits", "shim.supervisor_kills",
+                           "shim.violations")}
+        faults = {k[len("fault."):]: v for k, v in counters.items()
+                  if k.startswith("fault.")}
+        out = {"sim": sim,
+               "shim": {"ops": ops, "op_latency_us": lat},
+               "counters": counters, "gauges": gauges,
+               "histograms": hists, "chunks": len(self.chunks)}
+        if superv:
+            out["shim"]["supervision"] = superv
+        if faults:
+            out["faults"] = faults
+        return out
 
     def close(self):
         """Write the final snapshot (if a path was given) and release

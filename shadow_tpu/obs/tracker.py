@@ -72,7 +72,8 @@ class Tracker:
         return self.interval > 0 and sim_ns >= self.next_ns
 
     def maybe_heartbeat(self, sim_ns: int, stats: np.ndarray,
-                        socks: dict | None = None):
+                        socks: dict | None = None,
+                        hosted_rss: dict | None = None):
         """Called after each window chunk with current cumulative stats;
         emits one heartbeat covering all interval boundaries elapsed
         since the last call (see module docstring on sampling).
@@ -81,6 +82,12 @@ class Tracker:
         sk_proto, sk_rhost, sk_rport, sk_snd_una, sk_snd_end,
         sk_sndbuf, sk_rcv_nxt, sk_rcvbuf, ooo_held) enabling the
         [socket] and [ram] line families.
+
+        hosted_rss: optional host_id -> resident-set bytes of the
+        host's live hosted child (hosting.runtime.child_rss). Rides
+        the [ram] line as a trailing ``rss=`` column — real process
+        memory next to the modeled buffer bytes, the reference's
+        tracker-RSS role (shd-tracker.c:266).
         """
         if self.interval <= 0 or sim_ns < self.next_ns:
             return
@@ -117,7 +124,7 @@ class Tracker:
                     f"{d[i, defs.ST_PKTS_DROP_BUF]},"
                     f"{d[i, defs.ST_XFER_DONE]}")
         if socks is not None:
-            self._heartbeat_sockets(t, span_s, socks)
+            self._heartbeat_sockets(t, span_s, socks, hosted_rss)
 
         ru = resource.getrusage(resource.RUSAGE_SELF)
         tot = d.sum(axis=0)
@@ -140,7 +147,8 @@ class Tracker:
             reg.gauge("tracker.last_sim_ns").set(int(self.next_ns))
         self.next_ns += self.interval
 
-    def _heartbeat_sockets(self, t: int, span_s: str, socks: dict):
+    def _heartbeat_sockets(self, t: int, span_s: str, socks: dict,
+                           hosted_rss: dict | None = None):
         used = socks["sk_used"]
         proto = socks["sk_proto"]
         is_tcp = proto == 6
@@ -181,13 +189,18 @@ class Tracker:
                         f"{int(sent_bytes[i, s])}")
                 self._emit(f"[shadow-heartbeat] [socket] {t},{name},"
                            + "|".join(segs))
-            if ram_total[i] or ram_delta[i]:
+            rss = (hosted_rss or {}).get(i)
+            if ram_total[i] or ram_delta[i] or rss is not None:
                 alloc = max(int(ram_delta[i]), 0)
                 dealloc = max(-int(ram_delta[i]), 0)
+                # trailing rss= column: the hosted child's REAL
+                # resident set beside the modeled buffer bytes (only
+                # hosts running a live hosted process carry it)
+                suffix = f",rss={int(rss)}" if rss is not None else ""
                 self._emit(
                     f"[shadow-heartbeat] [ram] {t},{name},"
                     f"{alloc},{dealloc},{int(ram_total[i])},"
-                    f"{int(used[i].sum())}")
+                    f"{int(used[i].sum())}{suffix}")
 
 
 def socket_columns(hosts) -> dict:

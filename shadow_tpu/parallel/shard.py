@@ -371,6 +371,13 @@ def put_hosts(hosts, mesh: Mesh):
     return _put_tree(hosts, mesh, PS(AXIS))
 
 
+def put_shared(sh, mesh: Mesh):
+    """Replicate just the Shared pytree (e.g. after fault injection
+    rewrote the lat/rel tables or a segment stop_time; hosts/params
+    are already placed)."""
+    return _put_tree(sh, mesh, PS())
+
+
 def device_put_sharded(hosts, hp, sh, mesh: Mesh):
     """Place the simulation state for a sharded run: Hosts/HostParams
     block-sharded over the hosts axis, Shared replicated."""

@@ -52,6 +52,20 @@ class Topology:
     def num_vertices(self) -> int:
         return self.graph.num_vertices
 
+    def has_edge(self, v1: int, v2: int) -> bool:
+        """True when a direct edge joins the two vertices (either
+        direction on undirected graphs). Fault injection uses this to
+        warn when a link_down/loss/latency fault names a pair that is
+        only connected through intermediate hops — the fault still
+        applies, but to the precomputed PATH entry [v1, v2], not to
+        every path crossing a physical link (the oracle stores paths,
+        not edges; see engine.faults)."""
+        g = self.graph
+        m = (g.e_src == v1) & (g.e_dst == v2)
+        if not g.directed:
+            m |= (g.e_src == v2) & (g.e_dst == v1)
+        return bool(m.any())
+
 
 def _build_adjacency(g: Graph):
     """Dense-ish CSR of min edge latency between distinct vertices, plus

@@ -1,0 +1,190 @@
+"""Deterministic fault injection (engine.faults).
+
+The robustness tier's modeled half: host kill/restart, link flaps and
+loss/latency episodes compile from config to a seed-stable schedule
+executed at exact sim times — a scenario with faults must complete
+without simulator crash, report what it did (SimReport.faults /
+ST_FAULTS / hosted causes), and be bit-identical across same-seed dual
+runs (the reference's determinism contract, shd-test-determinism.c,
+extended to hostile schedules).
+"""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.core.config import (FaultSpec, HostSpec, ProcessSpec,
+                                    Scenario, load_xml)
+from shadow_tpu.engine import defs
+from shadow_tpu.engine.sim import Simulation
+from shadow_tpu.engine.state import EngineConfig
+
+
+def ping_scenario(faults=(), stop_s=10):
+    return Scenario(
+        stop_time=stop_s * 10**9,
+        topology_graphml=PING_TOPOLOGY,
+        hosts=[
+            HostSpec(id="server", processes=[
+                ProcessSpec(plugin="pingserver", start_time=10**9,
+                            arguments="port=8000")]),
+            HostSpec(id="client", processes=[
+                ProcessSpec(plugin="ping", start_time=2 * 10**9,
+                            arguments="peer=server port=8000 "
+                                      "interval=1s size=64 count=5")]),
+        ],
+        faults=list(faults),
+    )
+
+
+PING_TOPOLOGY = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d9" />
+  <key attr.name="latency" attr.type="double" for="edge" id="d7" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d4" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d3" />
+  <key attr.name="packetloss" attr.type="double" for="node" id="d0" />
+  <graph edgedefault="undirected">
+    <node id="poi-1"><data key="d0">0.0</data>
+      <data key="d3">17038</data><data key="d4">2251</data></node>
+    <edge source="poi-1" target="poi-1">
+      <data key="d7">25.0</data><data key="d9">0.0</data></edge>
+  </graph>
+</graphml>"""
+
+
+# --- schedule compilation: bad configs fail at build, loudly ------------
+
+def test_compile_validation():
+    from shadow_tpu.engine.faults import compile_faults
+
+    name_to_idx = {"a": 0, "b": 1}
+    vertex = np.zeros(2, np.int32)
+    with pytest.raises(ValueError, match="unknown kind"):
+        compile_faults([FaultSpec(kind="meteor", at=1)],
+                       name_to_idx, vertex)
+    with pytest.raises(ValueError, match="unknown host"):
+        compile_faults([FaultSpec(kind="link_down", at=1,
+                                  src="a", dst="nope")],
+                       name_to_idx, vertex)
+    with pytest.raises(ValueError, match="host="):
+        compile_faults([FaultSpec(kind="host_down", at=1, host="nope")],
+                       name_to_idx, vertex)
+    with pytest.raises(ValueError, match="until > at"):
+        compile_faults([FaultSpec(kind="loss", at=5, rate=0.5,
+                                  src="a", dst="b")],
+                       name_to_idx, vertex)
+    with pytest.raises(ValueError, match="rate"):
+        compile_faults([FaultSpec(kind="loss", at=1, until=2, rate=1.5,
+                                  src="a", dst="b")],
+                       name_to_idx, vertex)
+    with pytest.raises(ValueError, match="extra > 0"):
+        compile_faults([FaultSpec(kind="latency", at=1, until=2,
+                                  src="a", dst="b")],
+                       name_to_idx, vertex)
+    # a valid episode expands into a begin/end pair, time-sorted
+    evs = compile_faults(
+        [FaultSpec(kind="loss", at=5, until=9, rate=0.5,
+                   src="a", dst="b"),
+         FaultSpec(kind="host_down", at=3, host="a", until=7)],
+        name_to_idx, vertex)
+    assert [(e.t, e.kind) for e in evs] == [
+        (3, "host_down"), (5, "loss_begin"), (7, "host_up"),
+        (9, "loss_end")]
+
+
+def test_xml_fault_parsing():
+    scen = load_xml("""<shadow stoptime="10">
+      <topology path="unused.graphml"/>
+      <host id="a"><process plugin="ping" arguments=""/></host>
+      <fault kind="host_down" at="3s" host="a" until="7s"/>
+      <fault kind="loss" at="5" until="9" rate="0.25" src="a" dst="a"/>
+      <fault kind="latency" at="2" until="4" extra="30ms" src="a" dst="a"/>
+    </shadow>""")
+    assert len(scen.faults) == 3
+    assert scen.faults[0].kind == "host_down"
+    assert scen.faults[0].at == 3 * 10**9
+    assert scen.faults[0].until == 7 * 10**9
+    assert scen.faults[1].rate == 0.25
+    assert scen.faults[2].extra_ns == 30 * 10**6
+
+
+# --- executed schedules -------------------------------------------------
+
+def test_churn_and_flap_deterministic():
+    """The acceptance schedule's modeled core: one host kill/restart
+    plus one link-down episode completes without a crash, records the
+    applied faults, and dual same-seed runs are bit-identical."""
+    faults = [
+        FaultSpec(kind="link_down", at=4 * 10**9, until=6 * 10**9,
+                  src="server", dst="client"),
+        FaultSpec(kind="host_down", at=7 * 10**9, host="server",
+                  until=8 * 10**9),
+    ]
+    r1 = Simulation(ping_scenario(faults)).run()
+    r2 = Simulation(ping_scenario(faults)).run()
+    assert np.array_equal(r1.stats, r2.stats)
+    assert [f["kind"] for f in r1.faults] == [
+        "link_down", "link_up", "host_down", "host_up"]
+    # kill + restart both landed on the server
+    assert r1.stats[0, defs.ST_FAULTS] == 2
+    assert r1.sim_time_ns == 10 * 10**9
+    # pings during the dead link window were dropped on the floor
+    assert r1.total(defs.ST_PKTS_DROP_NET) > 0
+    assert r1.stats[1, defs.ST_RTT_COUNT] < 5
+
+
+def test_loss_episode_drops_and_restores():
+    """A rate-1.0 loss episode blacks the path out for its window and
+    composes back to the base reliability after ``until``."""
+    faults = [FaultSpec(kind="loss", at=3500 * 10**6, until=5500 * 10**6,
+                        rate=1.0, src="server", dst="client")]
+    r = Simulation(ping_scenario(faults)).run()
+    base = Simulation(ping_scenario()).run()
+    assert base.total(defs.ST_PKTS_DROP_NET) == 0
+    assert base.stats[1, defs.ST_RTT_COUNT] == 5
+    assert r.total(defs.ST_PKTS_DROP_NET) > 0
+    # echoes outside the episode still complete
+    assert 0 < r.stats[1, defs.ST_RTT_COUNT] < 5
+
+
+def test_latency_episode_raises_rtt():
+    """Added path latency during the episode shows up in the measured
+    RTTs; the restore returns later pings to the base RTT (the mean
+    sits strictly between base and base+2*extra)."""
+    extra_ms = 40
+    faults = [FaultSpec(kind="latency", at=3500 * 10**6,
+                        until=6500 * 10**6, extra_ns=extra_ms * 10**6,
+                        src="server", dst="client")]
+    r = Simulation(ping_scenario(faults)).run()
+    mean_us = r.summary()["mean_rtt_us"]
+    assert 50_000 < mean_us < 50_000 + 2 * extra_ms * 1000
+    assert r.stats[1, defs.ST_RTT_COUNT] == 5   # nothing lost
+
+
+def test_host_kill_rst_frees_tcp_peer():
+    """Killing a host mid-TCP-transfer converts its connections to
+    RSTs toward the peer: the peer's socket frees instead of
+    retransmitting into the void, and the sim completes."""
+    scen = Scenario(
+        stop_time=40 * 10**9,
+        topology_graphml=PING_TOPOLOGY,
+        hosts=[
+            HostSpec(id="server", processes=[
+                ProcessSpec(plugin="bulkserver", start_time=10**9,
+                            arguments="port=80")]),
+            HostSpec(id="client", processes=[
+                ProcessSpec(plugin="bulk", start_time=2 * 10**9,
+                            arguments="peer=server port=80 "
+                                      "size=5000000 count=1")]),
+        ],
+        faults=[FaultSpec(kind="host_down", at=4 * 10**9,
+                          host="server")],
+    )
+    sim = Simulation(scen)
+    r = sim.run()
+    assert r.sim_time_ns == 40 * 10**9       # no crash, ran to stop
+    assert r.stats[0, defs.ST_FAULTS] == 1
+    # the transfer was cut short...
+    assert 0 < r.total(defs.ST_BYTES_RECV) < 5_000_000
+    # ...and the RST freed the client's socket (no zombie retransmit
+    # loop: its whole table is empty at end of run)
+    assert not np.asarray(sim.final_hosts.sk_used)[1].any()

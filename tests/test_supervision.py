@@ -1,0 +1,226 @@
+"""Hosted-process supervision (hosting.shim + hosting.runtime).
+
+The robustness tier's hosted half: a real child that crashes, hangs,
+is SIGKILLed mid-transfer, or tries to fork must become a diagnosed,
+per-host-reported simulated event — never a wedged or crashed
+simulator. The reference gets the equivalent guarantees from owning
+the process teardown path (shd-process.c:3195-3234); here the
+LD_PRELOAD seam needs an explicit supervisor.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from shadow_tpu.core.config import (FaultSpec, HostSpec, ProcessSpec,
+                                    Scenario)
+from shadow_tpu.engine import defs
+from shadow_tpu.engine.sim import Simulation
+from shadow_tpu.engine.state import EngineConfig
+
+ENGINE_CFG = dict(num_hosts=2, qcap=32, scap=8, obcap=16, incap=32,
+                  txqcap=16, hostedcap=16, chunk_windows=8)
+
+# a paced uploader: sim-time sleeps spread the transfer over ~20 sim
+# seconds so a mid-run fault reliably lands mid-transfer
+SLOW_UPLOADER_SRC = """\
+import socket, time
+s = socket.create_connection(("server", 8080))
+for i in range(100):
+    s.send(b"x" * 10000)
+    time.sleep(0.2)
+s.close()
+print("done")
+"""
+
+BUSY_LOOP_SRC = """\
+import socket
+s = socket.create_connection(("server", 8080))
+s.send(b"x" * 1000)
+while True:      # no syscalls ever again: wall-clock watchdog bait
+    pass
+"""
+
+FORKER_SRC = """\
+import os, sys
+try:
+    os.fork()
+    print("fork-succeeded")
+except OSError as e:
+    print("fork-refused errno=%d" % e.errno)
+sys.stdout.flush()
+"""
+
+FOPEN_ENTROPY_SRC = """\
+f = open("/dev/urandom", "rb", buffering=0)
+data = f.read(16)
+f.close()
+print("entropy=" + data.hex())
+"""
+
+
+def hosted_scenario(script_path, out_path, faults=(), stop_s=30):
+    return Scenario(
+        stop_time=stop_s * 10**9,
+        topology_graphml=TOPOLOGY,
+        hosts=[
+            HostSpec(id="server", processes=[
+                ProcessSpec(plugin="bulkserver", start_time=10**9,
+                            arguments="port=8080")]),
+            HostSpec(id="client", processes=[
+                ProcessSpec(plugin="hosted:shim", start_time=2 * 10**9,
+                            arguments=f"out={out_path} "
+                                      f"cmd={sys.executable} "
+                                      f"{script_path}")]),
+        ],
+        faults=list(faults),
+    )
+
+
+TOPOLOGY = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d9" />
+  <key attr.name="latency" attr.type="double" for="edge" id="d7" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d4" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d3" />
+  <key attr.name="packetloss" attr.type="double" for="node" id="d0" />
+  <graph edgedefault="undirected">
+    <node id="poi-1"><data key="d0">0.0</data>
+      <data key="d3">17038</data><data key="d4">2251</data></node>
+    <edge source="poi-1" target="poi-1">
+      <data key="d7">20.0</data><data key="d9">0.0</data></edge>
+  </graph>
+</graphml>"""
+
+
+def _write(tmp_path, name, src):
+    p = str(tmp_path / name)
+    with open(p, "w") as f:
+        f.write(src)
+    return p
+
+
+def test_child_sigkill_mid_transfer(tmp_path):
+    """Acceptance scenario's hosted leg: the child is SIGKILLed mid-
+    transfer (host_down fault at a sim time while bytes are moving).
+    The sim completes, the exit report names the cause, and the peer's
+    accepted connection is reset (only its listener row survives)."""
+    script = _write(tmp_path, "slow.py", SLOW_UPLOADER_SRC)
+    out = str(tmp_path / "slow.out")
+    scen = hosted_scenario(script, out, faults=[
+        FaultSpec(kind="host_down", at=8 * 10**9, host="client")])
+    sim = Simulation(scen, engine_cfg=EngineConfig(**ENGINE_CFG))
+    r = sim.run()
+    assert r.sim_time_ns == 30 * 10**9      # simulator survived
+    info = r.hosted["client"]
+    assert info["exit_status"] == -9        # SIGKILL
+    assert "host_down" in info["cause"]
+    assert info["clean"] is False
+    assert r.stats[1, defs.ST_FAULTS] == 1
+    # the server's accepted child connection was torn down by the
+    # radiated RST; only the listener remains in its table
+    assert np.asarray(sim.final_hosts.sk_used)[0].sum() == 1
+    # the child never printed its completion line
+    assert "done" not in open(out).read()
+
+
+def test_hung_child_watchdog(tmp_path, monkeypatch):
+    """A child that stops making RPC progress (busy loop in real code)
+    is detected by the wall-clock watchdog, SIGKILLed, and diagnosed —
+    instead of wedging the window loop inside _read_req forever."""
+    monkeypatch.setenv("SHADOW_SHIM_WATCHDOG_S", "3")
+    script = _write(tmp_path, "hang.py", BUSY_LOOP_SRC)
+    out = str(tmp_path / "hang.out")
+    scen = hosted_scenario(script, out, stop_s=20)
+    r = Simulation(scen, engine_cfg=EngineConfig(**ENGINE_CFG)).run()
+    assert r.sim_time_ns == 20 * 10**9
+    info = r.hosted["client"]
+    assert info["exit_status"] == -9
+    assert info["cause"].startswith("hung:")
+    assert info["clean"] is False
+
+
+def test_fork_refused_with_diagnostic(tmp_path):
+    """A forking binary cannot escape the sandbox: fork() returns
+    ENOSYS in the child AND the refusal is recorded host-side in the
+    exit report (the OP_VIOLATION diagnostic), so the escape attempt
+    is visible without reading the child's stderr."""
+    script = _write(tmp_path, "forker.py", FORKER_SRC)
+    out = str(tmp_path / "fork.out")
+    scen = hosted_scenario(script, out, stop_s=20)
+    r = Simulation(scen, engine_cfg=EngineConfig(**ENGINE_CFG)).run()
+    info = r.hosted["client"]
+    assert "fork" in info["violations"]
+    assert info["clean"] is True            # refusal is survivable
+    text = open(out).read()
+    import errno
+    assert f"fork-refused errno={errno.ENOSYS}" in text
+
+
+def test_hosted_restart_respawns_child(tmp_path):
+    """host_down + host_up on a hosted host respawns a FRESH child:
+    the final exit record shows a healthy end-of-run termination, not
+    the fault kill (which a dead-only host would report)."""
+    script = _write(tmp_path, "slow.py", SLOW_UPLOADER_SRC)
+    out = str(tmp_path / "slow.out")
+    scen = hosted_scenario(script, out, faults=[
+        FaultSpec(kind="host_down", at=6 * 10**9, host="client",
+                  until=10 * 10**9)])
+    r = Simulation(scen, engine_cfg=EngineConfig(**ENGINE_CFG)).run()
+    assert r.sim_time_ns == 30 * 10**9
+    assert [f["kind"] for f in r.faults] == ["host_down", "host_up"]
+    info = r.hosted["client"]
+    # the LIVE (restarted) instance was reaped at end of run — proof
+    # the respawn happened and ran past the kill
+    assert info["cause"] == "terminated at end of run"
+    assert info["clean"] is True
+
+
+def test_acceptance_robustness_scenario(tmp_path):
+    """The issue's acceptance schedule, verbatim: a mid-run hosted-
+    child SIGKILL, one host kill/restart, and one link-down episode —
+    completes without simulator crash, reports per-host exit causes in
+    SimReport, and is bit-identical across two same-seed runs."""
+    script = _write(tmp_path, "slow.py", SLOW_UPLOADER_SRC)
+    faults = [
+        FaultSpec(kind="link_down", at=4 * 10**9, until=5 * 10**9,
+                  src="server", dst="client"),
+        FaultSpec(kind="host_down", at=8 * 10**9, host="client"),
+        FaultSpec(kind="host_down", at=12 * 10**9, host="server",
+                  until=14 * 10**9),
+    ]
+
+    def run(i):
+        out = str(tmp_path / f"acc{i}.out")
+        scen = hosted_scenario(script, out, faults=faults, stop_s=20)
+        sim = Simulation(scen, engine_cfg=EngineConfig(**ENGINE_CFG))
+        return sim.run()
+
+    r1, r2 = run(1), run(2)
+    assert r1.sim_time_ns == 20 * 10**9           # no crash, full run
+    assert np.array_equal(r1.stats, r2.stats)     # bit-identical
+    assert [f["kind"] for f in r1.faults] == [
+        "link_down", "link_up", "host_down", "host_down", "host_up"]
+    for r in (r1, r2):
+        info = r.hosted["client"]                 # per-host exit cause
+        assert info["exit_status"] == -9
+        assert "host_down" in info["cause"]
+    # both hosts took fault events (client kill; server kill+restart)
+    assert r1.stats[1, defs.ST_FAULTS] == 1
+    assert r1.stats[0, defs.ST_FAULTS] == 2
+
+
+def test_fopen_urandom_deterministic(tmp_path):
+    """fopen("/dev/urandom") serves host-PRNG bytes (glibc fopen
+    bypasses the open() interposition — ADVICE r5): same seed, same
+    bytes, across two full simulator runs."""
+    script = _write(tmp_path, "fop.py", FOPEN_ENTROPY_SRC)
+    outs = []
+    for i in range(2):
+        out = str(tmp_path / f"fop{i}.out")
+        scen = hosted_scenario(script, out, stop_s=10)
+        Simulation(scen, engine_cfg=EngineConfig(**ENGINE_CFG)).run()
+        outs.append(open(out).read().strip())
+    assert outs[0].startswith("entropy=") and len(outs[0]) > 10
+    assert outs[0] == outs[1]
