@@ -116,6 +116,13 @@ def _run_compiled(scen, cfg, warm_stop_ns=int(1.2 * 10**9), reps=1,
         report = build(scen).run()
         s = report.summary()
         s["cost"] = report.cost_model()
+        # cold/warm split (VERDICT weak #5: single warm-median numbers
+        # make cross-round deltas uninterpretable): cold_wall is the
+        # compile + first chunk, warm_wall the rest of the run (None
+        # on single-chunk runs, where the split does not exist)
+        warm = report.cost.get("warm_wall")
+        s["warm_wall"] = round(warm, 3) if warm else None
+        s["cold_wall"] = round(report.wall_seconds - (warm or 0), 3)
         outs.append(s)
     outs.sort(key=lambda s: s["events_per_sec"])
     med = outs[len(outs) // 2]
@@ -190,6 +197,8 @@ def _run_minides(n, stop_s, mean_ms=500.0, lat_ms=25.0):
 
 
 def _emit(metric, summary, baseline, baseline_cfg, baseline_c=None):
+    import jax
+
     vs = (summary["events_per_sec"] / baseline["events_per_sec"]
           if baseline and baseline["events_per_sec"] else None)
     cost = summary.get("cost") or {}
@@ -197,9 +206,14 @@ def _emit(metric, summary, baseline, baseline_cfg, baseline_c=None):
         "metric": metric,
         "value": round(summary["events_per_sec"], 1),
         "unit": "events/s",
+        # the platform stamp keeps CPU-container numbers from ever
+        # being compared against accelerator rounds
+        "platform": jax.default_backend(),
         "vs_baseline": round(vs, 2) if vs else None,
         "realtime_x": round(summary["speedup"], 3),
         "events": summary["events"],
+        "cold_wall": summary.get("cold_wall"),
+        "warm_wall": summary.get("warm_wall"),
         # cost-model digest (SimReport.cost_model): where the wall
         # goes, auditable per line
         "passes_per_window": round(cost.get("passes_per_window", 0), 2),

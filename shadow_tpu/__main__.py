@@ -149,6 +149,16 @@ def main(argv=None):
     p.add_argument("--metrics", default=None, metavar="FILE",
                    help="write a metrics snapshot to FILE and "
                         "per-chunk JSON lines to FILE.chunks.jsonl")
+    p.add_argument("--digest", default=None, metavar="FILE",
+                   help="append a determinism digest chain to FILE "
+                        "(one JSON line of per-section state hashes "
+                        "per cadence, plus FILE.manifest.json; diff "
+                        "two chains with tools/divergence.py)")
+    p.add_argument("--digest-every", type=int, default=0,
+                   metavar="WINDOWS",
+                   help="digest cadence in windows (default 64; "
+                        "records also land at every fault boundary "
+                        "and at the end of the run)")
     p.add_argument("--checkpoint", default=None, metavar="PATH")
     p.add_argument("--checkpoint-every", type=float, default=0,
                    metavar="SEC")
@@ -287,12 +297,21 @@ def main(argv=None):
         from .parallel.shard import make_mesh
         mesh = make_mesh(args.workers)
 
+    # the digest context records the CLI invocation in the manifest —
+    # the replay context tools/divergence.py --bisect needs
+    dg_ctx = ({"argv": list(argv) if argv is not None else sys.argv[1:],
+               "config_path": args.config}
+              if args.digest else None)
     report = sim.run(verbose=args.verbose, mesh=mesh,
-                     heartbeat_s=args.heartbeat_frequency, logger=logger,
+                     heartbeat_s=args.heartbeat_frequency,
+                     logger=logger,
                      checkpoint_path=args.checkpoint,
                      checkpoint_every_s=args.checkpoint_every,
                      resume_from=args.resume, pcap_dir=args.pcap_dir,
-                     trace=args.trace, metrics=args.metrics)
+                     trace=args.trace, metrics=args.metrics,
+                     digest=args.digest,
+                     digest_every=args.digest_every,
+                     digest_context=dg_ctx)
     s = report.summary()
     logger.message(report.sim_time_ns, "main",
                    f"done: {s['events']} events in {s['wall_seconds']:.2f}s "

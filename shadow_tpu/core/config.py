@@ -118,6 +118,9 @@ class Scenario:
     # would round the cost to exactly 0 and silently disable the model.
     cpu_precision_ns: int = 1_000
     cpu_threshold_ns: int = -1               # reference default: no block
+    source_path: Optional[str] = None        # the XML file this scenario
+    #   was loaded from (load_xml) — recorded in digest-run manifests
+    #   so tools/divergence.py --bisect can rebuild the run
 
     def total_hosts(self) -> int:
         return sum(h.quantity for h in self.hosts)
@@ -150,7 +153,9 @@ def _kib_to_bytes(v) -> int:
 
 def load_xml(source: str) -> Scenario:
     """Parse a shadow.config.xml string or file path into a Scenario."""
+    src_path = None
     if os.path.exists(source):
+        src_path = source
         with open(source) as f:
             text = f.read()
     else:
@@ -159,7 +164,8 @@ def load_xml(source: str) -> Scenario:
     if root.tag != "shadow":
         raise ValueError(f"expected <shadow> root element, got <{root.tag}>")
 
-    scen = Scenario(stop_time=_get_time(root.attrib, "stoptime"))
+    scen = Scenario(stop_time=_get_time(root.attrib, "stoptime"),
+                    source_path=src_path)
     scen.bootstrap_end = _get_time(root.attrib, "bootstraptime")
 
     for el in root:

@@ -17,6 +17,19 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def named_leaves(hosts) -> list:
+    """[(field_name, leaf array)] in declaration order — the leaf
+    enumeration the digest recorder (obs.digest) hashes. save() below
+    serializes via jax.tree.flatten, whose order DIFFERS (chex does
+    not flatten in declaration order) but whose leaf set is identical
+    — asserted in save(), so a field the digest hashes can never be
+    silently absent from checkpoints or vice versa. Each consumer is
+    internally order-consistent; nothing exchanges ordered leaves."""
+    import dataclasses
+    return [(f.name, getattr(hosts, f.name))
+            for f in dataclasses.fields(hosts)]
+
+
 def scenario_fingerprint(scenario, cfg, seed: int) -> str:
     """Stable hash binding a checkpoint to its scenario + engine shape."""
     text = json.dumps({
@@ -29,6 +42,13 @@ def scenario_fingerprint(scenario, cfg, seed: int) -> str:
 
 def save(path: str, hosts, wstart, wend, windows: int, fingerprint: str):
     leaves, treedef = jax.tree.flatten(hosts)
+    # checkpoints and digests must cover the same leaf SET (orders
+    # legitimately differ — see named_leaves): a pytree leaf that is
+    # not a dataclass field would be digested but not checkpointed,
+    # or vice versa
+    named = named_leaves(hosts)
+    assert (len(named) == len(leaves)
+            and {id(a) for _, a in named} == {id(b) for b in leaves})
     np.savez_compressed(
         path,
         __fingerprint__=np.frombuffer(
@@ -40,16 +60,27 @@ def save(path: str, hosts, wstart, wend, windows: int, fingerprint: str):
     )
 
 
-def load(path: str, hosts_template, fingerprint: str):
+def load(path: str, hosts_template, fingerprint: str,
+         strict: bool = True):
     """-> (hosts, wstart, wend, windows). `hosts_template` supplies the
-    pytree structure (a freshly built Hosts)."""
+    pytree structure (a freshly built Hosts). `strict=False` downgrades
+    a fingerprint mismatch to a stderr warning (the shape check below
+    still applies) — for tooling that deliberately resumes under a
+    changed stop time or chunk size, e.g. tools/divergence.py --bisect
+    replaying from the nearest checkpoint at digest cadence 1."""
     z = np.load(path)
     got = bytes(z["__fingerprint__"]).decode()
     if got != fingerprint:
-        raise ValueError(
-            f"checkpoint fingerprint {got} does not match scenario "
-            f"{fingerprint}: refusing to resume into a different "
-            "simulation")
+        if strict:
+            raise ValueError(
+                f"checkpoint fingerprint {got} does not match scenario "
+                f"{fingerprint}: refusing to resume into a different "
+                "simulation")
+        import sys
+        sys.stderr.write(
+            f"shadow_tpu: warning: resuming past a checkpoint "
+            f"fingerprint mismatch ({got} vs {fingerprint}) — caller "
+            "vouches the scenario only differs in run parameters\n")
     leaves, treedef = jax.tree.flatten(hosts_template)
     n = len(leaves)
     new_leaves = [jnp.asarray(z[f"leaf{i}"]) for i in range(n)]

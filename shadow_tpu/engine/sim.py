@@ -589,7 +589,10 @@ class Simulation:
             logger=None, checkpoint_path: str = None,
             checkpoint_every_s: float = 0,
             resume_from: str = None, pcap_dir: str = None,
-            trace: str = None, metrics: str = None) -> SimReport:
+            trace: str = None, metrics: str = None,
+            digest: str = None, digest_every: int = 0,
+            digest_context: dict = None,
+            resume_unchecked: bool = False) -> SimReport:
         """Run to the stop time. With `mesh` (a 1-D jax Mesh over a
         "hosts" axis) the window program runs under shard_map with the
         host dimension block-sharded — same results, N chips.
@@ -601,9 +604,24 @@ class Simulation:
         per-chunk spans with sim-time args, compile/hosting/tracker/
         pcap/checkpoint spans). `metrics` writes a final metrics.json
         snapshot (obs.metrics) plus per-chunk JSON lines at
-        ``<metrics>.chunks.jsonl``. Both install the process-global
-        recorders for the duration of this run only; with both unset
-        the chunk loop pays a single boolean check per chunk. If a
+        ``<metrics>.chunks.jsonl``.
+
+        `digest` appends a determinism digest chain (obs.digest: one
+        JSON line of per-section state hashes every `digest_every`
+        windows — default obs.digest.DEFAULT_EVERY — plus at every
+        fault boundary and at the end of the run) and writes a
+        companion ``<digest>.manifest.json``; diff two chains with
+        ``tools/divergence.py``. `digest_context` folds caller context
+        (CLI argv, config path) into the manifest. Cadences below the
+        chunk size shrink
+        the effective chunk so records land on exact window
+        boundaries. `resume_unchecked` downgrades the checkpoint
+        fingerprint check on `resume_from` to a warning (divergence
+        bisection replays under a clamped stop time).
+
+        Trace, metrics and digest install their process-global
+        recorders for the duration of this run only; with all unset
+        the chunk loop pays a few boolean checks per chunk. If a
         recorder is ALREADY installed process-wide (an outer harness
         like bench.py holding one timeline open across runs), the
         path argument is ignored — this run's records flow into the
@@ -614,10 +632,24 @@ class Simulation:
         """
         assert not self._ran, "Simulation objects are single-use"
         self._ran = True
+        from ..obs import digest as DG
         from ..obs import metrics as MT
         from ..obs import trace as TR
         from ..parallel import dist
-        own_tr = own_mt = False
+        own_tr = own_mt = own_dg = False
+        if digest is not None:
+            if not DG.ENABLED:
+                DG.install(digest,
+                           every=digest_every or DG.DEFAULT_EVERY,
+                           context=digest_context)
+                own_dg = True
+            else:
+                import sys as _sys
+                _sys.stderr.write(
+                    "shadow_tpu: warning: a digest recorder is already "
+                    "installed process-wide; the path passed to run() "
+                    "is ignored and this run's records extend the "
+                    "existing chain\n")
         if trace is not None or metrics is not None:
             writer = (not dist.is_multiprocess()
                       or jax.process_index() == 0)
@@ -642,21 +674,26 @@ class Simulation:
                 verbose=verbose, mesh=mesh, heartbeat_s=heartbeat_s,
                 logger=logger, checkpoint_path=checkpoint_path,
                 checkpoint_every_s=checkpoint_every_s,
-                resume_from=resume_from, pcap_dir=pcap_dir)
+                resume_from=resume_from, pcap_dir=pcap_dir,
+                resume_unchecked=resume_unchecked)
         finally:
             if own_tr:
                 TR.finish()
             if own_mt:
                 MT.finish()
+            if own_dg:
+                DG.finish()
 
     def _run_impl(self, verbose, mesh, heartbeat_s, logger,
                   checkpoint_path, checkpoint_every_s, resume_from,
-                  pcap_dir) -> SimReport:
+                  pcap_dir, resume_unchecked=False) -> SimReport:
+        from ..obs import digest as DG
         from ..obs import metrics as MT
         from ..obs import trace as TR
         # hot-loop observability guard: with --trace/--metrics off the
         # per-chunk cost of the whole obs layer is this one boolean
         obs_on = TR.ENABLED or MT.ENABLED
+        dg = DG.RECORDER if DG.ENABLED else None
         if TR.ENABLED:
             _s0 = TR.TRACER.now()
         H = self.cfg.num_hosts
@@ -672,6 +709,11 @@ class Simulation:
                     "fault injection + multi-process mesh not "
                     "supported (host-fault surgery needs addressable "
                     "state)")
+            if dg is not None:
+                raise NotImplementedError(
+                    "digest recording + multi-process mesh not "
+                    "supported (the state pull would need a per-record "
+                    "allgather)")
         if self.injector is not None and resume_from:
             raise NotImplementedError(
                 "resume with a fault schedule is not supported: the "
@@ -704,11 +746,30 @@ class Simulation:
         fingerprint = ckpt.scenario_fingerprint(self.scenario, self.cfg,
                                                 self.seed)
 
+        if dg is not None:
+            # run manifest (seed, fingerprint, engine shape, versions,
+            # platform, git rev): what makes two chains comparable and
+            # a divergence bisect replayable (tools/divergence.py)
+            dg.write_manifest(DG.build_manifest(
+                self.scenario, self.cfg, self.seed, self.sh,
+                self.host_names, dg,
+                checkpoint_path=checkpoint_path,
+                shards=(1 if mesh is None else mesh.size),
+                pcap=pcap_dir is not None,
+                faults=self.injector is not None,
+                hosted=self.hosting is not None))
+
         if mesh is None:
             hosts, cfg, hp, sh = self.hosts, self.cfg, self.hp, self.sh
             # hosted apps need the CPU between every window
             chunk = 1 if self.hosting else cfg.chunk_windows
             per_chip_h = cfg.num_hosts
+            if dg is not None:
+                # sub-chunk cadence: shrink the chunk so records land
+                # on exact digest boundaries (engine.window compiles
+                # one program per (cfg, chunk) — a digest run is its
+                # own AOT entry, plain runs are untouched)
+                chunk = min(chunk, dg.every)
 
             def step(hosts, sh_seg, ws, we):
                 return run_windows(hosts, hp, sh_seg, ws, we, cfg, chunk)
@@ -727,6 +788,8 @@ class Simulation:
             # gate above still applies). chunk=1: hosted apps need the
             # CPU between every window.
             chunk = 1 if self.hosting else cfg.chunk_windows
+            if dg is not None:
+                chunk = min(chunk, dg.every)  # exact digest boundaries
 
             def step(hosts, sh_seg, ws, we):
                 return run_windows_sharded(hosts, hp, sh_seg, ws, we,
@@ -738,6 +801,23 @@ class Simulation:
         # this, not the segment scalar
         stop_ns = int(sh.stop_time)
         inj = self.injector
+
+        def dg_record(kind, window, sim_ns):
+            # one digest-chain sample (obs.digest): the state pull is
+            # the whole cadence cost, accounted as a span + metrics
+            _d0 = TR.TRACER.now() if TR.ENABLED else None
+            hosted = (self.hosting.digest_state()
+                      if self.hosting is not None else None)
+            dg.record(hosts, H, window, sim_ns, kind, hosted=hosted)
+            if TR.ENABLED:
+                TR.TRACER.complete("digest.record", _d0,
+                                   args={"window": window,
+                                         "kind": kind})
+            if MT.ENABLED:
+                reg = MT.REGISTRY
+                reg.counter("digest.records").inc()
+                reg.gauge("digest.last_window").set(window)
+                reg.gauge("digest.bytes_hashed").set(dg.bytes_hashed)
 
         # cost-model bookkeeping (SimReport.cost_model): pass mix per
         # compaction rung + per-row state bytes
@@ -768,7 +848,8 @@ class Simulation:
                     "snapshot holds device state only, not the hosted "
                     "processes' Python state")
             hosts, ws0, we0, total_windows = ckpt.load(
-                resume_from, hosts, fingerprint)
+                resume_from, hosts, fingerprint,
+                strict=not resume_unchecked)
             wstart = jnp.int64(ws0)
             wend = jnp.int64(we0)
             if mesh is not None:
@@ -776,6 +857,12 @@ class Simulation:
                 # arrays need (re-)sharding
                 from ..parallel.shard import put_hosts
                 hosts = put_hosts(hosts, mesh)
+
+        if dg is not None:
+            # the cadence clock is per-run: a recorder spanning
+            # several runs (outer harness) or a resume jump must not
+            # inherit the previous run's next_due
+            dg.begin_run(total_windows)
 
         if checkpoint_path and not checkpoint_every_s:
             raise ValueError(
@@ -948,6 +1035,8 @@ class Simulation:
                             round(chunk_wall / chunk_sim, 6)
                             if chunk_sim else None))
                 chunk_i += 1
+            if dg is not None and dg.due(total_windows):
+                dg_record("cadence", total_windows, min(ws, stop_ns))
             if verbose:
                 print(f"  t={ws / SIMTIME_ONE_SECOND:.3f}s "
                       f"windows={total_windows}")
@@ -974,6 +1063,11 @@ class Simulation:
                     ws = int(wstart)
                     if TR.ENABLED:
                         TR.TRACER.complete("faults.apply", _fi0)
+                    if dg is not None:
+                        # fault boundary: sample at the fault's own
+                        # sim time — where a broken-determinism hunt
+                        # wants the tightest bracketing
+                        dg_record("fault", total_windows, int(nf))
             # a pending fault must keep the loop alive even when the
             # engine has nothing left to do (ws hits SIMTIME_MAX once
             # the queues drain, yet a host_up restart re-populates
@@ -983,6 +1077,9 @@ class Simulation:
                            and inj.next_time() < stop_ns)
             if (ws >= stop_ns or ws >= SIMTIME_MAX) and not more_faults:
                 break
+        if dg is not None:
+            dg_record("final", total_windows,
+                      min(stop_ns, ws) if ws < SIMTIME_MAX else stop_ns)
         if pcap is not None:
             pcap.close()
         if TR.ENABLED:

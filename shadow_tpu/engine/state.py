@@ -124,6 +124,37 @@ class EngineConfig:
     #   passes always drain exactly one event per ready host.
 
 
+# Digest sections (obs.digest): Hosts field prefix -> the named state
+# section a divergence report attributes to. Declared next to the
+# arrays so a new field group gets a section in the same edit; fields
+# matching no prefix digest under "other" (visible, never silently
+# skipped).
+STATE_SECTIONS = (
+    ("eq_", "event_queue"),
+    ("rng_ctr", "rng"),
+    ("cpu_avail", "cpu"),
+    ("nic_", "nic"),
+    ("txq_", "nic"),
+    ("pkt_ctr", "nic"),
+    ("next_eport", "nic"),
+    ("sk_", "tcp"),
+    ("app_", "app"),
+    ("tgen_sync", "app"),
+    ("ob_", "outbox"),
+    ("hw_", "hosted_wakes"),
+    ("tr_", "trace_ring"),
+    ("stats", "stats"),
+    ("cap_peaks", "stats"),
+)
+
+
+def section_of(field: str) -> str:
+    for prefix, section in STATE_SECTIONS:
+        if field.startswith(prefix):
+            return section
+    return "other"
+
+
 @chex.dataclass
 class Hosts:
     """All mutable per-host state. Every leaf has leading dim H."""

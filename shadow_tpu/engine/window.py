@@ -1003,3 +1003,73 @@ def _run_windows_impl(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
     return jax.lax.while_loop(
         win_cond, win_body,
         (hosts, wstart, wend, jnp.int32(0), jnp.zeros((NR,), jnp.int64)))
+
+
+# --- Determinism-digest canonicalization (obs.digest) ---------------------
+# Host-side, numpy-only: this module owns the slot conventions (free
+# event-queue slots, outbox compaction tails, NIC/trace/wake ring
+# bounds), so the rules zeroing DEAD slots before hashing live here —
+# next to the device code whose conventions they restate.
+
+def canonicalize_state(arrs: dict) -> dict:
+    """Zero dead slots in a host-side copy of the Hosts arrays so
+    semantically identical states hash identically.
+
+    Dead slots legitimately retain stale bytes that may differ between
+    equal runs (the sharded exchange compacts outboxes differently
+    than the single-chip one; q_clear_slot frees a slot without
+    scrubbing its payload; closed socket rows keep their last values).
+    The digest chain is a statement about LIVE state only:
+
+    - event queue: slots with eq_time == SIMTIME_MAX are free — their
+      seq/kind/payload words are scrubbed (equeue.q_clear_slot only
+      resets time and kind);
+    - outbox: slots at index >= ob_cnt are exchange-compaction tail;
+    - NIC tx ring: positions outside [txq_head, txq_head + txq_cnt);
+    - hosted-wake / packet-trace rings: slots >= hw_cnt / tr_cnt
+      (append-with-drop, never wrapped — _trace_append, bridge.py);
+    - socket table: rows with sk_used False are scrubbed wholesale.
+
+    `arrs` maps Hosts field name -> numpy array (leading dim H); a new
+    dict of (copied where modified) arrays is returned. Device state
+    is never touched.
+    """
+    import numpy as np
+
+    a = dict(arrs)
+
+    def scrub(key, dead):
+        v = a[key]
+        m = dead
+        while m.ndim < v.ndim:
+            m = m[..., None]
+        a[key] = np.where(m, np.zeros((), v.dtype), v)
+
+    free = a["eq_time"] == SIMTIME_MAX
+    for k in ("eq_seq", "eq_kind", "eq_pkt"):
+        scrub(k, free)
+
+    O = a["ob_time"].shape[1]
+    dead_ob = np.arange(O)[None, :] >= a["ob_cnt"][:, None]
+    for k in ("ob_time", "ob_pkt"):
+        scrub(k, dead_ob)
+
+    T = a["txq_pkt"].shape[1]
+    pos = (np.arange(T)[None, :] - a["txq_head"][:, None]) % T
+    scrub("txq_pkt", pos >= a["txq_cnt"][:, None])
+
+    HW = a["hw_time"].shape[1]
+    dead_hw = np.arange(HW)[None, :] >= a["hw_cnt"][:, None]
+    for k in ("hw_time", "hw_pkt"):
+        scrub(k, dead_hw)
+
+    TC = a["tr_time"].shape[1]
+    dead_tr = np.arange(TC)[None, :] >= a["tr_cnt"][:, None]
+    for k in ("tr_time", "tr_pkt", "tr_dir"):
+        scrub(k, dead_tr)
+
+    unused = ~a["sk_used"]
+    for k in arrs:
+        if k.startswith("sk_") and k != "sk_used":
+            scrub(k, unused)
+    return a

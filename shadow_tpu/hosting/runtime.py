@@ -9,11 +9,14 @@ plugins (SURVEY §4 determinism tests).
 
 from __future__ import annotations
 
+import hashlib
+
 import numpy as np
 
 from ..engine.defs import (WAKE_START, WAKE_TIMER, WAKE_SOCKET,
                            WAKE_CONNECTED, WAKE_EOF, WAKE_ACCEPT, WAKE_SENT)
 from ..net import packet as P
+from ..obs import digest as _DG
 from .api import HostOS
 from .bridge import OP_WORDS, apply_ops_jit
 
@@ -36,6 +39,11 @@ class HostingRuntime:
         self.names = names
         self.batch_cap = batch_cap
         self._now = 0
+        # hosted-channel op-stream digest (obs.digest): running hash
+        # over every applied op batch — with the per-app shim request
+        # digests it attributes a determinism divergence to the hosted
+        # tier. Updated only while a digest recorder is installed.
+        self._op_hash = hashlib.blake2b(digest_size=8)
         self._dead = set()      # generic apps killed by a fault (shim
         #   apps self-guard; these need their wakes suppressed here)
         self._exit_log = {}     # host_id -> exit record of the LAST
@@ -129,6 +137,20 @@ class HostingRuntime:
                 rec = self._exit_log.get(hid)
             if rec is not None:
                 out[self.names.get(hid, f"host{hid}")] = rec
+        return out
+
+    def digest_state(self) -> dict:
+        """Hosted-tier digests for one obs.digest record: the running
+        op-batch stream hash plus each shim app's protocol-request
+        stream hash (hostname-keyed — stable across runs)."""
+        out = {"ops": self._op_hash.hexdigest()}
+        shim = {}
+        for hid, app in sorted(self.apps.items()):
+            f = getattr(app, "op_stream_digest", None)
+            if f is not None:
+                shim[self.names.get(hid, f"host{hid}")] = f()
+        if shim:
+            out["shim"] = shim
         return out
 
     def child_rss(self) -> dict:
@@ -240,6 +262,10 @@ class HostingRuntime:
 
             ops[k] = (hid, op.code, enc(op.a), enc(op.b), enc(op.c),
                       enc(op.d), op.t, self.procs.get(hid, 0))
+        if _DG.ENABLED:
+            # the un-padded batch IS the hosted-channel op stream the
+            # device replays — hash it in flush order
+            self._op_hash.update(ops[:len(pending)].tobytes())
         hosts, results = apply_ops_jit(hosts, hp, sh, jnp.asarray(ops))
         res = np.asarray(results)
         for k, (hid, os, op) in enumerate(pending):

@@ -61,12 +61,14 @@ single-threaded between waits):
 
 from __future__ import annotations
 
+import hashlib as _hashlib
 import os as _os
 import struct
 import subprocess
 import time as _time
 
 from .api import HostedApp, register
+from ..obs import digest as _DG
 from ..obs import metrics as _MT
 
 REQ = struct.Struct("<iiqq64s")
@@ -276,6 +278,11 @@ class ShimApp(HostedApp):
         self.watchdog_s = float(
             _os.environ.get("SHADOW_SHIM_WATCHDOG_S",
                             str(WATCHDOG_S_DEFAULT)) or 0)
+        # protocol-request stream digest (obs.digest): every frame the
+        # child issued, in service order — pins a determinism
+        # divergence to "the child behaved differently" vs "the engine
+        # diverged". Updated only while a digest recorder is installed.
+        self._op_hash = _hashlib.blake2b(digest_size=8)
         self._payloads = None     # api.PayloadBroker (runtime attaches)
         self._opened = set()      # broker keys this app opened
         self._mysubs = set()      # the subset I subscribed (I read)
@@ -606,6 +613,8 @@ class ShimApp(HostedApp):
                 if req is None:
                     self._child_gone(os)       # clean channel EOF
                     break
+                if _DG.ENABLED:
+                    self._op_hash.update(REQ.pack(*req))
                 # per-op protocol metrics: count + HANDLER latency (a
                 # call that parks is counted when it arrives; the
                 # sim-time it stays parked is not wall cost)
@@ -692,6 +701,11 @@ class ShimApp(HostedApp):
                 else:
                     os.abort(vs.sock)
                 vs.closed = True
+
+    def op_stream_digest(self) -> str:
+        """Running hash of every protocol request served so far
+        (hosting.runtime.digest_state -> obs.digest records)."""
+        return self._op_hash.hexdigest()
 
     def exit_info(self) -> dict:
         """Per-host exit record for SimReport.hosted (None while the

@@ -324,18 +324,25 @@ def run_windows_sharded(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
     fn = _RWS_INSTANCES.get(key)
     if fn is None:
         lcfg = dataclasses.replace(cfg, num_hosts=cfg.num_hosts // n)
-        smapped = jax.shard_map(
-            partial(_windows_body, cfg=cfg, lcfg=lcfg,
-                    max_windows=max_windows),
-            mesh=mesh,
-            in_specs=(PS(AXIS), PS(AXIS), PS(), PS(), PS()),
-            out_specs=(PS(AXIS), PS(), PS(), PS(), PS()),
+        body = partial(_windows_body, cfg=cfg, lcfg=lcfg,
+                       max_windows=max_windows)
+        in_specs = (PS(AXIS), PS(AXIS), PS(), PS(), PS())
+        out_specs = (PS(AXIS), PS(), PS(), PS(), PS())
+        try:
             # the row-level engine mixes unvarying constants into
             # sharded state everywhere (e.g. `.at[slot].set(True)`),
             # which trips the strict varying-axes typecheck; the
             # collectives here are hand-placed, so skip it
-            check_vma=False,
-        )
+            smapped = jax.shard_map(
+                body, mesh=mesh, in_specs=in_specs,
+                out_specs=out_specs, check_vma=False)
+        except (AttributeError, TypeError):
+            # jax < 0.5 (e.g. the 0.4.37 CPU dev container): the API
+            # lives in jax.experimental and the skip-typecheck knob is
+            # named check_rep
+            from jax.experimental.shard_map import shard_map as _sm
+            smapped = _sm(body, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
 
         def impl(hosts, hp, sh, wstart, wend):
             return smapped(hosts, hp, sh, wstart, wend)

@@ -34,6 +34,13 @@ jax.config.update("jax_platforms", _platform)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 run (-m 'not slow'); "
+        "compile-heavy or long-wall tests")
+
+
 @pytest.fixture(scope="session")
 def simple_topology_xml():
     """A 2-PoI topology equivalent to resource/topology.simple.graphml:

@@ -1,0 +1,328 @@
+"""Determinism flight-recorder tests (obs.digest + tools/divergence).
+
+The contract under test: same-seed dual runs produce byte-identical
+digest chains (faults included); a genuinely divergent pair of runs is
+reported with window / section / host attribution; and --bisect pins
+the exact window by cadence-1 replay from the manifests.
+
+Engine shapes mirror tests/test_obs.py (2-host ping, chunk 8) so the
+compiled window program is shared across both files.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario, load_xml
+from shadow_tpu.engine.sim import Simulation
+from shadow_tpu.engine.state import EngineConfig
+from shadow_tpu.obs import digest as D
+
+from test_phold import MESH_TOPO
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DIVERGENCE = os.path.join(REPO, "tools", "divergence.py")
+
+CFG = dict(qcap=16, scap=4, obcap=8, incap=16, chunk_windows=8)
+
+# MESH_TOPO with loss on every edge: the drop rolls come from the
+# counter PRNG keyed by the scenario seed, so different seeds make the
+# ping runs genuinely diverge (a lossless ping pair is seed-INsensitive
+# — deterministic apps, placement hints, no RNG draws — and its digest
+# chains are legitimately identical across seeds)
+LOSSY_TOPO = MESH_TOPO.replace(
+    '<data key="d9">0.0</data>', '<data key="d9">0.4</data>')
+
+
+@pytest.fixture(autouse=True)
+def _digest_global_reset():
+    """The digest recorder is process-global; a test failing
+    mid-install must not leak an enabled recorder into the next test
+    (the obs.trace/metrics fixture contract)."""
+    yield
+    D.finish()
+
+
+def ping_scen(stop=6, seed=1, topo=MESH_TOPO, count=3):
+    s = Scenario(
+        stop_time=stop * 10**9,
+        topology_graphml=topo,
+        hosts=[
+            HostSpec(id="srv", processes=[
+                ProcessSpec(plugin="pingserver", start_time=10**9,
+                            arguments="port=8000")]),
+            HostSpec(id="cli", processes=[
+                ProcessSpec(plugin="ping", start_time=2 * 10**9,
+                            arguments="peer=srv port=8000 "
+                                      "interval=500ms "
+                                      f"size=100 count={count}")]),
+        ])
+    s.seed = seed
+    return s
+
+
+def run_digest(path, scen, every=8):
+    sim = Simulation(scen, engine_cfg=EngineConfig(num_hosts=2, **CFG))
+    sim.run(digest=str(path), digest_every=every)
+    assert not D.ENABLED  # run() owns the recorder it installed
+    return str(path)
+
+
+def test_dual_run_chain_identical(tmp_path):
+    a = run_digest(tmp_path / "a.jsonl", ping_scen(seed=7))
+    b = run_digest(tmp_path / "b.jsonl", ping_scen(seed=7))
+    assert open(a, "rb").read() == open(b, "rb").read()
+
+    recs = [json.loads(l) for l in open(a).read().splitlines()]
+    assert recs
+    assert recs[-1]["kind"] == "final"
+    windows = [r["window"] for r in recs]
+    assert windows == sorted(windows)
+    for r in recs:
+        assert set(r) >= {"window", "sim_ns", "kind", "sections",
+                          "chain"}
+        # every state section present, none bucketed as "other"
+        assert {"event_queue", "tcp", "nic", "outbox", "rng", "app",
+                "stats"} <= set(r["sections"])
+        assert "other" not in r["sections"]
+        assert len(r["hosts"]) == 2      # per-host detail at tiny H
+
+    mf = json.load(open(a + ".manifest.json"))
+    assert mf["seed"] == 7
+    assert mf["hosts"] == 2 and mf["host_names"] == ["srv", "cli"]
+    assert mf["digest_every"] == 8
+    assert mf["engine_config"]["qcap"] == CFG["qcap"]
+    assert mf["versions"]["jax"] and mf["platform"]
+    # run-mode stamps: pcap changes digested state (trace-ring
+    # draining), faults/hosted gate --use-checkpoint replay — a pair
+    # differing here must show a manifest delta, not a mystery
+    assert (mf["pcap"], mf["faults"], mf["hosted"]) == (False,) * 3
+
+
+def test_faults_demo_dual_run_identical(tmp_path):
+    """The acceptance scenario: same-seed dual runs of
+    examples/faults-demo.xml produce byte-identical chains, with
+    records at every fault boundary."""
+    def go(name):
+        scen = load_xml(os.path.join(REPO, "examples/faults-demo.xml"))
+        scen.seed = 3
+        path = tmp_path / name
+        sim = Simulation(scen,
+                         engine_cfg=EngineConfig(num_hosts=2, **CFG))
+        sim.run(digest=str(path), digest_every=8)
+        return str(path)
+
+    a, b = go("fa.jsonl"), go("fb.jsonl")
+    assert open(a, "rb").read() == open(b, "rb").read()
+    assert json.load(open(a + ".manifest.json"))["faults"] is True
+    kinds = [json.loads(l)["kind"] for l in open(a).read().splitlines()]
+    # the demo schedules a link flap and a host kill/restart: each
+    # applied fault batch lands one record
+    assert kinds.count("fault") >= 3
+    assert kinds[-1] == "final"
+
+
+def test_divergence_tool_reports_window_section_host(tmp_path):
+    """Different-seed lossy runs: tools/divergence.py (headless, no
+    jax) reports the first divergent window with per-section and
+    per-host attribution and exits 1; identical chains exit 0."""
+    a = run_digest(tmp_path / "a.jsonl",
+                   ping_scen(seed=101, topo=LOSSY_TOPO, count=8))
+    b = run_digest(tmp_path / "b.jsonl",
+                   ping_scen(seed=202, topo=LOSSY_TOPO, count=8))
+    assert open(a, "rb").read() != open(b, "rb").read()
+
+    out = subprocess.run(
+        [sys.executable, DIVERGENCE, a, b, "--json"],
+        capture_output=True, text=True)
+    assert out.returncode == 1, out.stderr
+    rep = json.loads(out.stdout)
+    div = rep["first_divergence"]
+    assert isinstance(div["window"], int)
+    assert div["sections"]                  # section attribution
+    names = {h["name"] for h in div["hosts"]}
+    assert names & {"srv", "cli"}           # host attribution
+    assert rep["manifest_deltas"]["seed"] == {"a": 101, "b": 202}
+
+    # human rendering: one readable line-oriented report
+    txt = subprocess.run(
+        [sys.executable, DIVERGENCE, a, b],
+        capture_output=True, text=True)
+    assert txt.returncode == 1
+    assert "first divergence" in txt.stdout
+    assert "divergent sections" in txt.stdout
+
+    same = subprocess.run(
+        [sys.executable, DIVERGENCE, a, a, "--json"],
+        capture_output=True, text=True)
+    assert same.returncode == 0
+    assert json.loads(same.stdout)["identical"] is True
+
+
+def test_divergence_tool_bad_input(tmp_path):
+    """Missing / empty / truncated chains: one-line diagnosis, exit 2,
+    no traceback."""
+    missing = str(tmp_path / "nope.jsonl")
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    trunc = tmp_path / "trunc.jsonl"
+    trunc.write_text('{"window": 0, "sections": {"a": "b"}, "chain')
+    for bad in (missing, str(empty), str(trunc)):
+        out = subprocess.run(
+            [sys.executable, DIVERGENCE, bad, bad],
+            capture_output=True, text=True)
+        assert out.returncode == 2, (bad, out.stderr)
+        assert "Traceback" not in out.stderr
+        assert out.stderr.strip().startswith("divergence:")
+
+
+def test_hosted_op_stream_in_chain(tmp_path):
+    """Hosted apps: records carry the hosted-channel op-stream digest
+    (hosting.runtime op batches) as its own section, and same-seed
+    dual runs stay byte-identical THROUGH the hosted tier — the
+    'bit-identical, hosted children included' contract."""
+    from test_hosting import CFG as HCFG  # registers test-pinger
+
+    def go(name):
+        scen = Scenario(
+            stop_time=6 * 10**9,
+            topology_graphml=MESH_TOPO,
+            hosts=[
+                HostSpec(id="srv", processes=[
+                    ProcessSpec(plugin="pingserver", start_time=10**9,
+                                arguments="port=8000")]),
+                HostSpec(id="cli", processes=[
+                    ProcessSpec(plugin="hosted:test-pinger",
+                                start_time=2 * 10**9,
+                                arguments="peer=srv port=8000 count=3 "
+                                          "interval_s=1 size=64")]),
+            ])
+        scen.seed = 5
+        path = tmp_path / name
+        sim = Simulation(scen,
+                         engine_cfg=EngineConfig(num_hosts=2, **HCFG))
+        sim.run(digest=str(path), digest_every=4)
+        return str(path)
+
+    a, b = go("ha.jsonl"), go("hb.jsonl")
+    assert open(a, "rb").read() == open(b, "rb").read()
+    recs = [json.loads(l) for l in open(a).read().splitlines()]
+    assert len(recs) >= 3     # cadence records across the op activity
+    assert all("hosted" in r["sections"] for r in recs)
+    assert all("ops" in r["hosted"] for r in recs)
+    # the op stream actually advanced (the pinger issued socket ops)
+    assert recs[0]["hosted"]["ops"] != recs[-1]["hosted"]["ops"]
+
+
+def test_recorder_cadence_is_per_run():
+    """One recorder may span several runs (an outer harness extending
+    one chain), but each run's window counter restarts at 0 — or
+    jumps, on resume. begin_run() must re-arm next_due, else the clock
+    left by run 1's last record suppresses every cadence sample of
+    run 2."""
+    r = D.DigestRecorder(None, every=8)
+    r.next_due = 104          # as left by a previous run's last record
+    r.begin_run(0)
+    assert not r.due(7) and r.due(8)
+    r.begin_run(500)          # resumed run: the counter jumps forward
+    assert not r.due(507) and r.due(508)
+
+
+def test_canonicalize_state_masks_dead_slots():
+    """Unit: two host-side states that differ ONLY in dead-slot
+    garbage (freed queue slots, outbox tail, ring tail, closed socket
+    rows) canonicalize to identical arrays; live differences
+    survive."""
+    from shadow_tpu.core.simtime import SIMTIME_MAX
+    from shadow_tpu.engine.state import alloc_hosts
+    from shadow_tpu.engine.checkpoint import named_leaves
+    from shadow_tpu.engine.window import canonicalize_state
+
+    cfg = EngineConfig(num_hosts=2, **CFG)
+
+    def arrs():
+        return {k: np.array(v) for k, v in
+                named_leaves(alloc_hosts(cfg))}
+
+    a, b = arrs(), arrs()
+    # dead garbage: a freed queue slot's payload, the outbox tail, a
+    # NIC-ring slot outside [head, head+cnt), an unused socket row
+    b["eq_pkt"][0, 3] = 77            # eq_time stays SIMTIME_MAX: free
+    b["ob_pkt"][1, 5] = 9             # ob_cnt is 0: tail garbage
+    b["txq_pkt"][0, 2] = 5            # txq_cnt is 0: dead ring slot
+    b["sk_rcv_nxt"][1, 2] = 123       # sk_used false: closed row
+    b["tr_time"][0, 0] = 42           # tr_cnt is 0: dead trace slot
+    ca, cb = canonicalize_state(a), canonicalize_state(b)
+    for k in ca:
+        assert np.array_equal(ca[k], cb[k]), k
+
+    # a LIVE difference is preserved: occupy the slot, then differ
+    c = arrs()
+    c["eq_time"][0, 3] = 5            # slot live now
+    c["eq_pkt"][0, 3] = 77
+    d = {k: v.copy() for k, v in c.items()}
+    d["eq_pkt"][0, 3] = 78
+    cc, cd = canonicalize_state(c), canonicalize_state(d)
+    assert not np.array_equal(cc["eq_pkt"], cd["eq_pkt"])
+    assert np.array_equal(cc["eq_time"], cd["eq_time"])
+
+
+@pytest.mark.slow
+def test_bisect_pins_exact_window(tmp_path):
+    """--bisect replays both runs from their manifests at cadence 1
+    (XML config + recorded engine config) and pins the exact divergent
+    window. Slow: the cadence-1 replay compiles a chunk-1 window
+    program."""
+    xml = tmp_path / "lossy-ping.xml"
+    xml.write_text(f"""<shadow stoptime="6">
+  <topology><![CDATA[{LOSSY_TOPO}]]></topology>
+  <host id="srv">
+    <process plugin="pingserver" starttime="1" arguments="port=8000"/>
+  </host>
+  <host id="cli">
+    <process plugin="ping" starttime="2"
+      arguments="peer=srv port=8000 interval=500ms size=100 count=8"/>
+  </host>
+</shadow>
+""")
+
+    def go(name, seed):
+        scen = load_xml(str(xml))          # source_path -> manifest
+        scen.seed = seed
+        path = tmp_path / name
+        sim = Simulation(scen,
+                         engine_cfg=EngineConfig(num_hosts=2, **CFG))
+        sim.run(digest=str(path), digest_every=8)
+        return str(path)
+
+    a, b = go("a.jsonl", 101), go("b.jsonl", 202)
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import divergence
+    finally:
+        sys.path.pop(0)
+    import contextlib
+    import io
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = divergence.main([a, b, "--bisect", "--json",
+                              "--keep-replays",
+                              str(tmp_path / "replays")])
+    assert rc == 1
+    rep = json.loads(buf.getvalue())
+    coarse = rep["first_divergence"]
+    fine = rep["bisect"]
+    # cadence-1 pins a window at or before the coarse record, and
+    # after the last matching coarse record
+    assert isinstance(fine["window"], int)
+    assert fine["window"] <= coarse["window"]
+    if coarse["prev_window"] is not None:
+        assert fine["window"] > coarse["prev_window"]
+    assert fine["sections"]
+    # the replay chains were kept where we asked
+    assert (tmp_path / "replays" / "replay-a.jsonl").exists()

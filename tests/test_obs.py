@@ -266,6 +266,33 @@ def test_trace_report_tool(tmp_path):
     assert any(s["name"] == "chunk" for s in rep["spans"])
 
 
+def test_trace_report_bad_input(tmp_path):
+    """Missing / empty / truncated / non-trace input: a one-line
+    diagnosis on stderr and a nonzero exit — never a traceback
+    (headless tool robustness satellite)."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tool = os.path.join(repo, "tools/trace_report.py")
+    empty = tmp_path / "empty.json"
+    empty.write_text("")
+    trunc = tmp_path / "trunc.json"
+    trunc.write_text('{"traceEvents": [{"name": "chunk", "ph": "X"')
+    nontrace = tmp_path / "nontrace.json"
+    nontrace.write_text('{"foo": 1}')
+    noevents = tmp_path / "noevents.json"
+    noevents.write_text('{"traceEvents": []}')
+    for bad in (str(tmp_path / "missing.json"), str(empty),
+                str(trunc), str(nontrace), str(noevents)):
+        out = subprocess.run([sys.executable, tool, bad],
+                             capture_output=True, text=True)
+        assert out.returncode != 0, bad
+        assert "Traceback" not in out.stderr, (bad, out.stderr)
+        msg = out.stderr.strip()
+        assert msg.startswith("trace_report:") and "\n" not in msg, bad
+
+
 def test_pyengine_trace_and_metrics(tmp_path):
     """The differential oracle's event loop shows up on the same
     timeline (pyengine.window spans) and in the registry."""

@@ -46,6 +46,19 @@ def test_tgen_sharded_matches_single(mesh8, simple_topology_xml):
     assert np.array_equal(single.stats, sharded.stats)
 
 
+def test_digest_sharded_matches_single(mesh8, tmp_path):
+    """The determinism digest chain (obs.digest) extends the v1≡v2 /
+    sharded≡single claim from stats to the WHOLE live state: a mesh
+    run (including inert padding rows, sliced off before hashing) must
+    produce a byte-identical chain to the single-chip run."""
+    single = str(tmp_path / "single.jsonl")
+    mesh = str(tmp_path / "mesh.jsonl")
+    Simulation(phold_scenario(n=13, stop=3)).run(digest=single)
+    Simulation(phold_scenario(n=13, stop=3)).run(mesh=mesh8,
+                                                 digest=mesh)
+    assert (open(single, "rb").read() == open(mesh, "rb").read())
+
+
 def test_exchange_v1_matches_v2(mesh8):
     """The v1 all-gather and v2 bucketed all-to-all wire protocols are
     bit-identical (and both equal the single-chip run — covered by the

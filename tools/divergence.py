@@ -1,0 +1,388 @@
+#!/usr/bin/env python3
+"""Diff two determinism digest chains (the ``--digest FILE`` output of
+``python -m shadow_tpu`` / ``Simulation.run(digest=...)``) and report
+WHERE two runs first diverge.
+
+Without ``--bisect`` this is pure stdlib and runs headless in
+milliseconds: it walks the two chains record by record, finds the
+first record whose running chain hash differs, and attributes the
+divergence — which state *sections* differ (event_queue / tcp / nic /
+outbox / rng / app / stats / hosted, see engine.state.STATE_SECTIONS),
+which *hosts* differ (when the chains carry per-host digests), and
+whether the hosted-channel op stream already diverged (the hosted
+child behaved differently) or only engine state did.
+
+With ``--bisect`` the tool replays both runs from their manifests at
+digest cadence 1 — from the nearest usable checkpoint when the
+manifest records one, else from the start — with the stop time clamped
+just past the first divergent record, and pins the EXACT window where
+the chains split. The replay imports shadow_tpu (jax required) and
+recompiles the window program at chunk 1; everything needed is read
+from the ``<chain>.manifest.json`` companions (config path, seed,
+engine config, runahead, TCP scalars).
+
+Usage:
+  python tools/divergence.py a.digests.jsonl b.digests.jsonl
+      [--json] [--bisect] [--use-checkpoint] [--keep-replays DIR]
+
+Exit status: 0 = chains identical, 1 = divergence found (reported),
+2 = usage/input error.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def _die(msg):
+    print(f"divergence: {msg}", file=sys.stderr)
+    raise SystemExit(2)
+
+
+def load_chain(path):
+    """-> (records, manifest or None). One-line diagnosis on bad
+    input (missing / empty / truncated chain), never a traceback."""
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        _die(f"cannot read {path}: {e.strerror or e}")
+    recs = []
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            recs.append(json.loads(line))
+        except json.JSONDecodeError:
+            _die(f"{path}: line {i + 1} is not valid JSON — chain "
+                 "truncated mid-record?")
+    if not recs:
+        _die(f"{path}: empty digest chain (no records)")
+    for r in recs:
+        if "chain" not in r or "sections" not in r:
+            _die(f"{path}: records lack chain/sections fields — not a "
+                 "shadow_tpu digest chain")
+    manifest = None
+    mp = path + ".manifest.json"
+    if os.path.exists(mp):
+        try:
+            with open(mp) as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            manifest = None
+    return recs, manifest
+
+
+def manifest_deltas(ma, mb):
+    """Fields two comparable manifests are allowed to differ in vs the
+    ones that explain a divergence (seed, config, versions...)."""
+    if not ma or not mb:
+        return None
+    skip = {"argv", "checkpoint_path"}
+    out = {}
+    for k in sorted(set(ma) | set(mb)):
+        if k in skip:
+            continue
+        if ma.get(k) != mb.get(k):
+            out[k] = {"a": ma.get(k), "b": mb.get(k)}
+    return out
+
+
+def _attribute(ra, rb, ma=None, mb=None):
+    """Per-record attribution: divergent sections, hosts, hosted tier."""
+    sa, sb = ra.get("sections", {}), rb.get("sections", {})
+    sections = sorted(k for k in set(sa) | set(sb)
+                      if sa.get(k) != sb.get(k))
+    hosts = None
+    ha, hb = ra.get("hosts"), rb.get("hosts")
+    if ha is not None and hb is not None:
+        names = (ma or {}).get("host_names") or (mb or {}).get(
+            "host_names")
+        hosts = []
+        for i in range(min(len(ha), len(hb))):
+            if ha[i] != hb[i]:
+                hosts.append({"host": i,
+                              "name": (names[i] if names and
+                                       i < len(names) else None)})
+        if len(ha) != len(hb):
+            hosts.append({"host": min(len(ha), len(hb)),
+                          "name": "(host counts differ)"})
+    hosted = None
+    if ra.get("hosted") != rb.get("hosted"):
+        da, db = ra.get("hosted") or {}, rb.get("hosted") or {}
+        hosted = {"ops_diverged": da.get("ops") != db.get("ops"),
+                  "shim_hosts": sorted(
+                      k for k in set(da.get("shim", {})) |
+                      set(db.get("shim", {}))
+                      if da.get("shim", {}).get(k) !=
+                      db.get("shim", {}).get(k))}
+    return {"window": ra.get("window"), "window_b": rb.get("window"),
+            "sim_ns": ra.get("sim_ns"), "kind": ra.get("kind"),
+            "sections": sections, "hosts": hosts, "hosted": hosted}
+
+
+def first_divergence(a_recs, b_recs, ma=None, mb=None):
+    """-> report dict, or None when the chains are identical."""
+    n = min(len(a_recs), len(b_recs))
+    for i in range(n):
+        ra, rb = a_recs[i], b_recs[i]
+        if ra.get("chain") == rb.get("chain"):
+            continue
+        rep = {"record": i,
+               "prev_window": (a_recs[i - 1]["window"] if i else None),
+               "prev_sim_ns": (a_recs[i - 1]["sim_ns"] if i else None)}
+        rep.update(_attribute(ra, rb, ma, mb))
+        return rep
+    if len(a_recs) != len(b_recs):
+        longer = a_recs if len(a_recs) > len(b_recs) else b_recs
+        return {"record": n, "truncated": True,
+                "window": longer[n]["window"],
+                "sim_ns": longer[n]["sim_ns"], "kind": longer[n]["kind"],
+                "sections": [], "hosts": None, "hosted": None,
+                "prev_window": a_recs[n - 1]["window"],
+                "prev_sim_ns": a_recs[n - 1]["sim_ns"],
+                "note": ("one chain ends early — the runs took "
+                         "different window counts after this point")}
+    return None
+
+
+# --- bisection: cadence-1 replay from the manifests ----------------------
+
+def _pick_checkpoint(manifest, bound_ns):
+    """-> (path, wstart_ns) for a usable checkpoint, else None:
+    recorded in the manifest, still on disk, and saved at or before
+    the last MATCHING record (`bound_ns`) — a checkpoint inside the
+    divergence bracket already embodies the divergence, and resuming
+    from it would pin the wrong window. Manifests that record faults
+    or hosted apps never resume (the engine refuses; replay from the
+    start instead)."""
+    ck = manifest.get("checkpoint_path")
+    if (not ck or not os.path.exists(ck) or bound_ns is None
+            or manifest.get("faults") or manifest.get("hosted")):
+        return None
+    try:
+        import numpy as np
+        z = np.load(ck)
+        ws = int(z["__wstart__"])
+        if ws <= int(bound_ns):
+            return ck, ws
+    except Exception:
+        return None
+    return None
+
+
+def replay_digest(manifest, stop_ns, out_path, resume=None):
+    """Re-run one manifest's scenario with per-window digests (cadence
+    1) up to just past `stop_ns`, writing a fresh chain to `out_path`.
+    Reproduces what the manifest records: config XML + seed + engine
+    config + runahead window + TCP scalars. CLI flags that mutate the
+    scenario elsewhere (per-host buffer defaults, --engine-caps beyond
+    the recorded config) are already baked into engine_config; other
+    mutations are not replayed — compare manifests first."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from shadow_tpu.core.config import load_xml
+    from shadow_tpu.engine.sim import Simulation
+    from shadow_tpu.engine.state import EngineConfig
+
+    cfg_path = manifest.get("config_path")
+    if not cfg_path:
+        _die("--bisect needs manifests with a config_path (runs "
+             "recorded via the CLI, or load_xml from a file)")
+    if not os.path.exists(cfg_path):
+        _die(f"--bisect: recorded config {cfg_path} no longer exists")
+    scen = load_xml(cfg_path)
+    scen.seed = int(manifest["seed"])
+    # stop just past the divergent record so its window replays whole
+    scen.stop_time = min(int(manifest["stop_time_ns"]),
+                         int(stop_ns) + int(manifest["min_jump_ns"]))
+    cfgd = dict(manifest["engine_config"])
+    if cfgd.get("app_kinds") is not None:
+        cfgd["app_kinds"] = tuple(cfgd["app_kinds"])
+    cfg = EngineConfig(**cfgd)
+    sim = Simulation(scen, engine_cfg=cfg)
+    tcp = manifest.get("tcp", {})
+    sim.sh = sim.sh.replace(
+        min_jump=jnp.int64(int(manifest["min_jump_ns"])),
+        cc_kind=jnp.int32(int(tcp.get("cc_kind", int(sim.sh.cc_kind)))),
+        tcp_init_wnd=jnp.float32(tcp.get("init_wnd",
+                                         float(sim.sh.tcp_init_wnd))),
+        tcp_ssthresh0=jnp.float32(tcp.get(
+            "ssthresh0", float(sim.sh.tcp_ssthresh0))))
+    if cfg.cc_kind != int(tcp.get("cc_kind", cfg.cc_kind)):
+        cfg = dataclasses.replace(cfg,
+                                  cc_kind=int(tcp["cc_kind"]))
+        sim.cfg = cfg
+    if resume is not None and (sim.injector is not None
+                               or sim.hosting is not None):
+        resume = None  # the engine refuses resume with faults/hosting
+    if resume:
+        print(f"divergence: replaying from checkpoint {resume}",
+              file=sys.stderr)
+    sim.run(digest=out_path, digest_every=1, resume_from=resume,
+            resume_unchecked=True)
+
+
+def bisect(ma, mb, div, workdir, use_checkpoint=False):
+    """Replay both runs at cadence 1 and pin the exact window."""
+    stop_ns = int(div["sim_ns"])
+    pa = os.path.join(workdir, "replay-a.jsonl")
+    pb = os.path.join(workdir, "replay-b.jsonl")
+    resume_a = resume_b = None
+    if use_checkpoint:
+        # the replays are compared record by record, so BOTH must
+        # resume from the same window or neither — misaligned chains
+        # would report a bogus divergence at record 0
+        ca = _pick_checkpoint(ma, div.get("prev_sim_ns"))
+        cb = _pick_checkpoint(mb, div.get("prev_sim_ns"))
+        if ca and cb and ca[1] == cb[1]:
+            resume_a, resume_b = ca[0], cb[0]
+        elif ca or cb:
+            print("divergence: checkpoints unusable or misaligned "
+                  "across the two runs — replaying from the start",
+                  file=sys.stderr)
+    replay_digest(ma, stop_ns, pa, resume=resume_a)
+    replay_digest(mb, stop_ns, pb, resume=resume_b)
+    ra, _ = load_chain(pa)
+    rb, _ = load_chain(pb)
+    fine = first_divergence(ra, rb, ma, mb)
+    if fine is None:
+        return {"note": ("cadence-1 replays are identical up to the "
+                         "divergent record — the original divergence "
+                         "is not reproducible from the manifests "
+                         "(an unrecorded input differs between the "
+                         "original runs)")}
+    return fine
+
+
+# --- report rendering ----------------------------------------------------
+
+def _render(div, deltas, bis=None):
+    out = []
+    w = div.get("window")
+    out.append(f"first divergence: record #{div['record']} — window "
+               f"{w} (sim {div.get('sim_ns', 0) / 1e9:.9f}s, "
+               f"kind={div.get('kind')})")
+    if div.get("prev_window") is not None:
+        out.append(f"  last matching record: window "
+                   f"{div['prev_window']} "
+                   f"(sim {div['prev_sim_ns'] / 1e9:.9f}s)")
+    if div.get("truncated"):
+        out.append(f"  {div['note']}")
+    if div.get("window_b") is not None and div["window_b"] != w:
+        out.append(f"  (chain B is at window {div['window_b']} here — "
+                   "the runs advanced differently)")
+    if div.get("sections"):
+        out.append("  divergent sections: " + ", ".join(div["sections"]))
+    hosts = div.get("hosts")
+    if hosts:
+        names = ", ".join(
+            f"{h['host']}" + (f" ({h['name']})" if h.get("name") else "")
+            for h in hosts[:16])
+        more = f" (+{len(hosts) - 16} more)" if len(hosts) > 16 else ""
+        out.append(f"  divergent hosts: {names}{more}")
+    elif hosts is not None:
+        out.append("  divergent hosts: none individually (global "
+                   "section state only)")
+    else:
+        out.append("  per-host detail not recorded (host count above "
+                   "the digest host_detail cap)")
+    hosted = div.get("hosted")
+    if hosted:
+        if hosted.get("shim_hosts"):
+            out.append("  hosted op stream diverged on: "
+                       + ", ".join(hosted["shim_hosts"]))
+        elif hosted.get("ops_diverged"):
+            out.append("  hosted op-batch stream diverged")
+    if deltas:
+        out.append("  manifest deltas: " + ", ".join(
+            f"{k} ({v['a']!r} vs {v['b']!r})" if k == "seed" else k
+            for k, v in deltas.items()))
+    if bis is not None:
+        if "note" in bis and "window" not in bis:
+            out.append(f"  bisect: {bis['note']}")
+        else:
+            out.append(f"  bisect: exact divergent window = "
+                       f"{bis.get('window')} (sim "
+                       f"{bis.get('sim_ns', 0) / 1e9:.9f}s); sections: "
+                       + (", ".join(bis.get("sections") or ["-"])))
+            bh = bis.get("hosts")
+            if bh:
+                out.append("  bisect hosts: " + ", ".join(
+                    f"{h['host']}" + (f" ({h['name']})"
+                                      if h.get("name") else "")
+                    for h in bh[:16]))
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="diff two shadow_tpu digest chains; report the "
+                    "first divergent window with section/host "
+                    "attribution")
+    ap.add_argument("chain_a")
+    ap.add_argument("chain_b")
+    ap.add_argument("--json", action="store_true",
+                    help="print the report as one JSON object")
+    ap.add_argument("--bisect", action="store_true",
+                    help="replay both runs from their manifests at "
+                         "digest cadence 1 to pin the exact window "
+                         "(imports shadow_tpu; recompiles)")
+    ap.add_argument("--use-checkpoint", action="store_true",
+                    help="with --bisect: resume from the checkpoint "
+                         "recorded in the manifest when usable")
+    ap.add_argument("--keep-replays", default=None, metavar="DIR",
+                    help="with --bisect: write the cadence-1 replay "
+                         "chains here instead of a temp dir")
+    args = ap.parse_args(argv)
+
+    a_recs, ma = load_chain(args.chain_a)
+    b_recs, mb = load_chain(args.chain_b)
+    deltas = manifest_deltas(ma, mb)
+    if (ma and mb and
+            ma.get("digest_every") != mb.get("digest_every")):
+        _die("chains were recorded at different cadences "
+             f"({ma['digest_every']} vs {mb['digest_every']} windows) "
+             "— re-record with matching --digest-every")
+
+    div = first_divergence(a_recs, b_recs, ma, mb)
+    if div is None:
+        if args.json:
+            print(json.dumps({"identical": True,
+                              "records": len(a_recs),
+                              "manifest_deltas": deltas}))
+        else:
+            print(f"chains identical ({len(a_recs)} records"
+                  + (", manifest deltas: " + ", ".join(deltas)
+                     if deltas else "") + ")")
+        return 0
+
+    bis = None
+    if args.bisect:
+        if not (ma and mb):
+            _die("--bisect needs both <chain>.manifest.json companions")
+        workdir = args.keep_replays
+        if workdir:
+            os.makedirs(workdir, exist_ok=True)
+            bis = bisect(ma, mb, div, workdir,
+                         use_checkpoint=args.use_checkpoint)
+        else:
+            import tempfile
+            with tempfile.TemporaryDirectory(
+                    prefix="shadow-divergence.") as tmp:
+                bis = bisect(ma, mb, div, tmp,
+                             use_checkpoint=args.use_checkpoint)
+
+    if args.json:
+        print(json.dumps({"identical": False, "first_divergence": div,
+                          "manifest_deltas": deltas, "bisect": bis}))
+    else:
+        print(_render(div, deltas, bis))
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,13 +28,44 @@ from collections import defaultdict
 def load_events(path):
     """-> (complete events, dropped count). A nonzero dropped count
     means the recorder hit its MAX_EVENTS cap (obs.trace) and the
-    timeline is TRUNCATED — totals under-report the run."""
-    with open(path) as f:
-        doc = json.load(f)
-    evs = doc["traceEvents"] if isinstance(doc, dict) else doc
+    timeline is TRUNCATED — totals under-report the run.
+
+    Bad input (missing, empty, truncated or non-trace JSON) exits
+    with a one-line diagnosis instead of a traceback."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        raise SystemExit(
+            f"trace_report: cannot read {path}: {e.strerror or e}")
+    if not text.strip():
+        raise SystemExit(f"trace_report: {path}: empty file — the run "
+                         "may have died before the trace was flushed")
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError as e:
+        raise SystemExit(
+            f"trace_report: {path}: not valid JSON (truncated trace? "
+            f"{e.msg} at line {e.lineno})")
+    if isinstance(doc, dict):
+        evs = doc.get("traceEvents")
+        if evs is None:
+            raise SystemExit(
+                f"trace_report: {path}: no traceEvents key — not a "
+                "Chrome trace-event file")
+    elif isinstance(doc, list):
+        evs = doc
+    else:
+        raise SystemExit(
+            f"trace_report: {path}: not a Chrome trace-event document")
     dropped = (doc.get("otherData", {}).get("dropped_events", 0)
                if isinstance(doc, dict) else 0)
-    return [e for e in evs if e.get("ph") == "X"], dropped
+    events = [e for e in evs if e.get("ph") == "X"]
+    if not events:
+        raise SystemExit(
+            f"trace_report: {path}: trace contains no complete spans "
+            "(empty or metadata-only timeline)")
+    return events, dropped
 
 
 def self_times(events):
