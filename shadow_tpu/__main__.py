@@ -159,10 +159,42 @@ def main(argv=None):
                    help="digest cadence in windows (default 64; "
                         "records also land at every fault boundary "
                         "and at the end of the run)")
-    p.add_argument("--checkpoint", default=None, metavar="PATH")
+    p.add_argument("--checkpoint", default=None, metavar="PATH",
+                   help="crash-safe checkpoint store base: snapshots "
+                        "rotate as PATH.w<windows>.npz (atomic "
+                        "tmp+fsync+rename writes, content-hashed, "
+                        "last --checkpoint-keep retained) with a "
+                        "PATH.latest pointer (docs/durability.md)")
     p.add_argument("--checkpoint-every", type=float, default=0,
                    metavar="SEC")
-    p.add_argument("--resume", default=None, metavar="PATH")
+    p.add_argument("--checkpoint-keep", type=int, default=0,
+                   metavar="N",
+                   help="snapshots retained in the store (default 3; "
+                        "SHADOW_TPU_CHECKPOINT_KEEP also sets it)")
+    p.add_argument("--resume", default=None, metavar="PATH|latest",
+                   help="restore a snapshot and continue: a concrete "
+                        ".npz, a checkpoint store base, or the "
+                        "literal 'latest' to resolve the newest valid "
+                        "snapshot in the --checkpoint store (corrupt "
+                        "heads fall back loudly to the previous "
+                        "snapshot; no snapshot yet = start fresh with "
+                        "a warning). Resume covers fault schedules "
+                        "and hosted apps (journal replay)")
+    p.add_argument("--until-complete", action="store_true",
+                   help="auto-resume supervision: run the simulation "
+                        "in a child process and, if it crashes or is "
+                        "preempted, re-exec it with --resume latest "
+                        "until it completes (capped retries, "
+                        "exponential backoff, crash-cause log at "
+                        "<checkpoint>.supervisor.jsonl). Requires "
+                        "--checkpoint + --checkpoint-every")
+    p.add_argument("--max-retries", type=int, default=5, metavar="N",
+                   help="with --until-complete: resume attempts "
+                        "before giving up (default 5)")
+    p.add_argument("--retry-backoff", type=float, default=1.0,
+                   metavar="SEC",
+                   help="with --until-complete: initial backoff "
+                        "between attempts, doubling to a 60s cap")
     p.add_argument("--fault", action="append", default=None,
                    metavar="K=V,...",
                    help="schedule one fault (repeatable), e.g. "
@@ -181,13 +213,62 @@ def main(argv=None):
                    help="print the final summary as one JSON line")
     args = p.parse_args(argv)
 
+    if args.checkpoint and not args.checkpoint_every:
+        p.error("--checkpoint requires --checkpoint-every SEC")
+
+    if args.until_complete:
+        # supervise BEFORE any heavy import/compile: the child
+        # processes do the real work (engine.supervisor)
+        if not (args.checkpoint and args.checkpoint_every):
+            p.error("--until-complete requires --checkpoint PATH and "
+                    "--checkpoint-every SEC (resume needs snapshots)")
+        from .engine.supervisor import Supervisor, strip_supervisor_args
+        from .obs import metrics as MT
+        from .obs import trace as TR
+        own_tr = own_mt = False
+        # the supervisor's own obs stream rides sidecar paths so the
+        # child's --trace/--metrics files stay the child's
+        if args.trace and not TR.ENABLED:
+            TR.install(args.trace + ".supervisor")
+            own_tr = True
+        if args.metrics and not MT.ENABLED:
+            MT.install(args.metrics + ".supervisor")
+            own_mt = True
+        sup = Supervisor(
+            strip_supervisor_args(argv if argv is not None
+                                  else sys.argv[1:]),
+            args.checkpoint, max_retries=args.max_retries,
+            backoff_s=args.retry_backoff)
+        try:
+            return sup.run()
+        finally:
+            if own_tr:
+                TR.finish()
+            if own_mt:
+                MT.finish()
+
     from .core.config import load_xml
     from .core.simtime import parse_time
     from .engine.sim import Simulation
     from .obs.logger import SimLogger
 
-    if args.checkpoint and not args.checkpoint_every:
-        p.error("--checkpoint requires --checkpoint-every SEC")
+    if args.resume == "latest":
+        if not args.checkpoint:
+            p.error("--resume latest needs --checkpoint PATH to name "
+                    "the store to resolve in")
+        from .engine.checkpoint import resolve_latest
+        resolved = resolve_latest(args.checkpoint)
+        if resolved is None:
+            sys.stderr.write(
+                "shadow_tpu: no usable snapshot under "
+                f"{args.checkpoint!r} yet — starting fresh\n")
+            args.resume = None
+        else:
+            # thread the already-verified snapshot through so load()
+            # hashes one file instead of re-resolving the whole store;
+            # every supervisor retry re-execs this preflight, so the
+            # corrupt-head fallback still runs per attempt
+            args.resume = resolved
 
     if args.test:
         scenario = build_test_scenario(args.test_clients)
@@ -307,6 +388,7 @@ def main(argv=None):
                      logger=logger,
                      checkpoint_path=args.checkpoint,
                      checkpoint_every_s=args.checkpoint_every,
+                     checkpoint_keep=args.checkpoint_keep,
                      resume_from=args.resume, pcap_dir=args.pcap_dir,
                      trace=args.trace, metrics=args.metrics,
                      digest=args.digest,

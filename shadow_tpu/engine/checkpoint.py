@@ -1,20 +1,49 @@
-"""Checkpoint/resume: snapshot the simulation state arrays.
+"""Crash-safe checkpoint/resume: snapshot the simulation state arrays.
 
 The reference has no checkpointing (SURVEY §5 calls it out as absent);
 on TPU the whole simulation is a pytree of dense arrays, so a snapshot
 is one device->host copy + npz write, and resume is exact: the restored
 run produces the same results as an uninterrupted one (asserted by
-tests/test_checkpoint.py).
+tests/test_checkpoint.py, digest-chain-level by
+tests/test_until_complete.py).
+
+Durability contract (docs/durability.md):
+
+- a save is ATOMIC: the npz is written to ``<file>.tmp``, fsynced,
+  and ``os.replace``d into place, so a SIGKILL at any instant leaves
+  either the previous complete snapshot set or the new one — never a
+  half-written head;
+- every snapshot is stamped with a content hash (``<file>.sha256``
+  sidecar) verified on load; a corrupt head falls back LOUDLY to the
+  newest older snapshot that verifies;
+- the last ``keep`` snapshots are retained as ``<base>.w<windows>.npz``
+  siblings with a ``<base>.latest`` pointer (JSON, atomically
+  replaced) naming the head — ``--resume latest`` and the auto-resume
+  supervisor (engine.supervisor) resolve through it;
+- runs with a fault schedule stamp the injector's schedule position
+  (``__fault_idx__``) so resume re-arms engine.faults exactly; runs
+  with hosted apps write a ``<file>.hosted`` sidecar (the pickled
+  hosting tier + per-child protocol journals, hosting.runtime) that
+  resume replays to fast-forward respawned children.
 """
 
 from __future__ import annotations
 
 import hashlib
 import json
+import os
+import sys
+import zipfile
+from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# snapshots retained per store; SHADOW_TPU_CHECKPOINT_KEEP overrides
+DEFAULT_KEEP = 3
+
+POINTER_FORMAT = "shadow_tpu.checkpoint.latest"
 
 
 def named_leaves(hosts) -> list:
@@ -40,55 +69,351 @@ def scenario_fingerprint(scenario, cfg, seed: int) -> str:
     return hashlib.sha256(text.encode()).hexdigest()[:16]
 
 
-def save(path: str, hosts, wstart, wend, windows: int, fingerprint: str):
-    leaves, treedef = jax.tree.flatten(hosts)
-    # checkpoints and digests must cover the same leaf SET (orders
-    # legitimately differ — see named_leaves): a pytree leaf that is
-    # not a dataclass field would be digested but not checkpointed,
-    # or vice versa
-    named = named_leaves(hosts)
-    assert (len(named) == len(leaves)
-            and {id(a) for _, a in named} == {id(b) for b in leaves})
-    np.savez_compressed(
-        path,
-        __fingerprint__=np.frombuffer(
-            fingerprint.encode(), dtype=np.uint8),
-        __wstart__=np.int64(int(wstart)),
-        __wend__=np.int64(int(wend)),
-        __windows__=np.int64(windows),
-        **{f"leaf{i}": np.asarray(x) for i, x in enumerate(leaves)},
-    )
+def base_of(path: str) -> str:
+    """Store base for a user-facing checkpoint path: ``run/ck.npz``
+    and ``run/ck`` both name the store whose snapshots are
+    ``run/ck.w<windows>.npz`` and whose pointer is ``run/ck.latest``."""
+    return path[:-4] if path.endswith(".npz") else path
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _fsync_dir(path: str):
+    """Make a rename durable: fsync the containing directory (without
+    this, a machine crash — not just a process kill — can lose the
+    directory entry even though the file data is on disk)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass            # some filesystems refuse directory fsync
+    finally:
+        os.close(fd)
+
+
+def _write_atomic(path: str, data: bytes):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+@dataclass
+class Snapshot:
+    """One restored checkpoint (load())."""
+    hosts: object
+    wstart: int
+    wend: int
+    windows: int
+    fault_idx: int = -1         # engine.faults schedule position at
+    #   save time (-1: no fault schedule was active)
+    digest_records: int = -1    # obs.digest chain position at save
+    #   time: records already written (-1: digest was off) — the
+    #   resumed run truncates the chain file to exactly this many
+    #   records and re-produces the rest live
+    digest_chain: str = None    # running chain hash at that position
+    #   (verified against the refolded prefix on rewind)
+    hosted_blob: bytes = None   # hosting.runtime snapshot sidecar
+    path: str = None            # the .npz actually restored
+    meta: dict = field(default_factory=dict)
+
+
+class CheckpointStore:
+    """Owns one checkpoint base: atomic rotating snapshots + pointer."""
+
+    def __init__(self, path: str, keep: int = 0):
+        self.base = base_of(path)
+        self.keep = int(keep) or int(os.environ.get(
+            "SHADOW_TPU_CHECKPOINT_KEEP", str(DEFAULT_KEEP)))
+        self.keep = max(self.keep, 1)
+        # no directory side effects here: read-only users (resolve_
+        # latest, tools/divergence.py) construct a store just to
+        # enumerate snapshots; save() creates the directory
+
+    # --- writing ---
+    def save(self, hosts, wstart, wend, windows: int, fingerprint: str,
+             fault_idx: int = -1, hosted_blob: bytes = None,
+             digest_records: int = -1,
+             digest_chain: str = None) -> str:
+        """Write one snapshot. Ordering is the whole durability story:
+        the npz is staged to a ``.tmp``, its hash sidecar and hosted
+        sidecar are written FIRST, and only then does ``os.replace``
+        publish the npz — so at no instant does a complete-looking
+        ``.npz`` exist without its sidecars (resolve_latest would
+        otherwise trust a hashless head and, on hosted runs, resume
+        would crash-loop on the missing ``.hosted``). The ``latest``
+        pointer flips last, after every byte is durable."""
+        os.makedirs(os.path.dirname(os.path.abspath(self.base)),
+                    exist_ok=True)
+        leaves, treedef = jax.tree.flatten(hosts)
+        # checkpoints and digests must cover the same leaf SET (orders
+        # legitimately differ — see named_leaves): a pytree leaf that
+        # is not a dataclass field would be digested but not
+        # checkpointed, or vice versa
+        named = named_leaves(hosts)
+        assert (len(named) == len(leaves)
+                and {id(a) for _, a in named} == {id(b) for b in leaves})
+        file = f"{self.base}.w{int(windows):010d}.npz"
+        tmp = file + ".tmp"
+        hosted_name = hosted_sha = None
+        if hosted_blob is not None:
+            hosted_name = os.path.basename(file) + ".hosted"
+            hosted_sha = hashlib.sha256(hosted_blob).hexdigest()
+        with open(tmp, "wb") as f:
+            np.savez_compressed(
+                f,
+                __fingerprint__=np.frombuffer(
+                    fingerprint.encode(), dtype=np.uint8),
+                __wstart__=np.int64(int(wstart)),
+                __wend__=np.int64(int(wend)),
+                __windows__=np.int64(windows),
+                __fault_idx__=np.int64(fault_idx),
+                __digest_records__=np.int64(digest_records),
+                __digest_chain__=np.frombuffer(
+                    (digest_chain or "").encode(), dtype=np.uint8),
+                # stamped INSIDE the hash-verified npz so _verify can
+                # demand a matching .hosted sidecar (a corrupt or
+                # missing sidecar falls back like any corrupt head)
+                __hosted_sha__=np.frombuffer(
+                    (hosted_sha or "").encode(), dtype=np.uint8),
+                **{f"leaf{i}": np.asarray(x)
+                   for i, x in enumerate(leaves)},
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        # the tmp was fsynced a moment ago: this re-read is served
+        # from the page cache, not disk
+        sha = _sha256_file(tmp)
+        _write_atomic(file + ".sha256", (sha + "\n").encode())
+        if hosted_blob is not None:
+            _write_atomic(file + ".hosted", hosted_blob)
+        else:
+            try:                  # a stale sidecar from an earlier
+                os.unlink(file + ".hosted")    # hosted run of the
+            except OSError:                    # same base must not
+                pass                           # survive this save
+        # publish LAST: at no instant does a complete-looking .npz
+        # exist without its sidecars
+        os.replace(tmp, file)
+        _fsync_dir(os.path.dirname(os.path.abspath(file)))
+        pointer = {
+            "format": POINTER_FORMAT, "version": 1,
+            "file": os.path.basename(file), "sha256": sha,
+            "windows": int(windows), "wstart": int(wstart),
+            "fingerprint": fingerprint,
+            "hosted": hosted_name, "hosted_sha256": hosted_sha,
+        }
+        _write_atomic(self.pointer_path(),
+                      (json.dumps(pointer, sort_keys=True) + "\n")
+                      .encode())
+        _fsync_dir(os.path.dirname(os.path.abspath(self.base)))
+        self._prune(protect=file)
+        return file
+
+    def pointer_path(self) -> str:
+        return self.base + ".latest"
+
+    def _prune(self, protect: str):
+        import glob
+        snaps = sorted(self.snapshots())
+        for old in snaps[:-self.keep]:
+            if old == protect:
+                continue
+            for suffix in ("", ".sha256", ".hosted"):
+                try:
+                    os.unlink(old + suffix)
+                except OSError:
+                    pass
+        # stray temp files from killed saves never accumulate past one
+        # resume cycle (the newest tmp may belong to a concurrent
+        # writer only in misuse; one store has one writer)
+        for tmp in glob.glob(glob.escape(self.base) + ".w*.tmp"):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    # --- enumeration ---
+    def snapshots(self) -> list:
+        """All on-disk snapshot .npz paths for this base (any state)."""
+        import glob
+        return glob.glob(glob.escape(self.base) + ".w*.npz")
+
+
+def _verify(path: str) -> bool:
+    """Full verification of one snapshot set: the npz against its hash
+    sidecar (absent sidecar = pre-hash snapshot, trusted like before),
+    then — via the ``__hosted_sha__`` stamp INSIDE the verified npz —
+    the hosted sidecar's presence and content. A hosted snapshot whose
+    ``.hosted`` is missing or corrupt is unusable exactly like a torn
+    npz: resolve_latest falls back to the previous snapshot instead of
+    letting resume crash-loop on it."""
+    sidecar = path + ".sha256"
+    try:
+        if os.path.exists(sidecar):
+            with open(sidecar) as f:
+                want = f.read().strip()
+            if _sha256_file(path) != want:
+                return False
+    except OSError:
+        return False
+    try:
+        with np.load(path) as z:
+            hosted_sha = (bytes(z["__hosted_sha__"]).decode()
+                          if "__hosted_sha__" in z else "")
+    except Exception:
+        return False        # unreadable/truncated npz, never usable
+    if hosted_sha:
+        try:
+            with open(path + ".hosted", "rb") as f:
+                got = hashlib.sha256(f.read()).hexdigest()
+        except OSError:
+            return False
+        if got != hosted_sha:
+            return False
+    return True
+
+
+def resolve_latest(path: str) -> str | None:
+    """``--resume latest`` / supervisor resolution: newest snapshot of
+    the store at `path` (a base, a base.npz, or a direct pointer file)
+    whose content hash verifies. Returns the .npz path or None when
+    the store holds no usable snapshot. A corrupt head is reported
+    loudly and skipped — resume falls back to the previous snapshot."""
+    base = base_of(path)
+    candidates = []
+    ptr = base + ".latest"
+    if path.endswith(".latest"):
+        ptr, base = path, path[:-len(".latest")]
+    head = None
+    if os.path.exists(ptr):
+        try:
+            with open(ptr) as f:
+                meta = json.load(f)
+            head = os.path.join(os.path.dirname(os.path.abspath(base)),
+                                meta["file"])
+        except (OSError, json.JSONDecodeError, KeyError):
+            sys.stderr.write(
+                f"shadow_tpu: warning: checkpoint pointer {ptr} is "
+                "unreadable; scanning for snapshots instead\n")
+    if head is not None:
+        candidates.append(head)
+    store = CheckpointStore(base)
+    # dedup by absolute path: the pointer head is absolutized above,
+    # snapshots() globs relative to the (possibly relative) base
+    seen = {os.path.abspath(c) for c in candidates}
+    for snap in sorted(store.snapshots(), reverse=True):
+        if os.path.abspath(snap) not in seen:
+            candidates.append(snap)
+    for cand in candidates:
+        if not os.path.exists(cand):
+            sys.stderr.write(
+                f"shadow_tpu: warning: checkpoint head {cand} is "
+                "missing; falling back to an older snapshot\n")
+            continue
+        if not _verify(cand):
+            sys.stderr.write(
+                f"shadow_tpu: WARNING: checkpoint {cand} fails "
+                "verification (content hash mismatch, torn npz, or "
+                "a missing/corrupt .hosted sidecar) — falling back "
+                "to the previous snapshot\n")
+            continue
+        return cand
+    return None
 
 
 def load(path: str, hosts_template, fingerprint: str,
-         strict: bool = True):
-    """-> (hosts, wstart, wend, windows). `hosts_template` supplies the
-    pytree structure (a freshly built Hosts). `strict=False` downgrades
-    a fingerprint mismatch to a stderr warning (the shape check below
-    still applies) — for tooling that deliberately resumes under a
-    changed stop time or chunk size, e.g. tools/divergence.py --bisect
-    replaying from the nearest checkpoint at digest cadence 1."""
-    z = np.load(path)
-    got = bytes(z["__fingerprint__"]).decode()
+         strict: bool = True) -> Snapshot:
+    """Restore a snapshot -> Snapshot. `path` may be a concrete .npz,
+    a store base (``ck`` / ``ck.npz`` — resolved through the
+    ``latest`` pointer with corrupt-head fallback), or a ``.latest``
+    pointer file. `hosts_template` supplies the pytree structure (a
+    freshly built Hosts).
+
+    Check order (hard to soft): content hash, array layout (ALWAYS a
+    hard error, both shapes in the message), then the scenario
+    fingerprint — which `strict=False` downgrades to a stderr warning,
+    for tooling that deliberately resumes under a changed stop time or
+    chunk size (e.g. tools/divergence.py --bisect replaying from the
+    nearest checkpoint at digest cadence 1)."""
+    file = path
+    if not (os.path.isfile(path) and path.endswith(".npz")):
+        file = resolve_latest(path)
+        if file is None:
+            raise FileNotFoundError(
+                f"no usable checkpoint under {path!r} (no snapshot "
+                "written yet, or every candidate failed verification)")
+    elif not _verify(file):
+        raise ValueError(
+            f"checkpoint {file} fails verification — the npz is "
+            "unreadable or truncated or fails its content hash, or "
+            "its .hosted sidecar is missing or corrupt; resume from "
+            "an older snapshot (pass the store base or 'latest' to "
+            "fall back automatically)")
+    import zlib
+    try:
+        with np.load(file) as z:
+            got = bytes(z["__fingerprint__"]).decode()
+            leaves, treedef = jax.tree.flatten(hosts_template)
+            n = len(leaves)
+            new_leaves = [jnp.asarray(z[f"leaf{i}"]) for i in range(n)]
+            wstart = int(z["__wstart__"])
+            wend = int(z["__wend__"])
+            windows = int(z["__windows__"])
+            fault_idx = (int(z["__fault_idx__"])
+                         if "__fault_idx__" in z else -1)
+            digest_records = (int(z["__digest_records__"])
+                              if "__digest_records__" in z else -1)
+            digest_chain = (bytes(z["__digest_chain__"]).decode()
+                            if "__digest_chain__" in z else "") or None
+    except (OSError, ValueError, KeyError, zipfile.BadZipFile,
+            zlib.error) as e:
+        raise ValueError(
+            f"checkpoint {file} is unreadable or truncated "
+            f"({type(e).__name__}: {e})") from e
+    # layout FIRST: a shape/dtype mismatch means the snapshot belongs
+    # to a different engine configuration — never resumable, whatever
+    # the caller vouches for, so it must fail before the fingerprint
+    # check can be softened past it
+    for i, (tpl, new) in enumerate(zip(leaves, new_leaves)):
+        if tpl.shape != new.shape or tpl.dtype != new.dtype:
+            raise ValueError(
+                f"checkpoint layout mismatch at leaf {i}: snapshot "
+                f"has {new.shape}/{new.dtype}, this scenario builds "
+                f"{tpl.shape}/{tpl.dtype} — the snapshot belongs to a "
+                "different engine configuration")
     if got != fingerprint:
         if strict:
             raise ValueError(
                 f"checkpoint fingerprint {got} does not match scenario "
                 f"{fingerprint}: refusing to resume into a different "
                 "simulation")
-        import sys
         sys.stderr.write(
             f"shadow_tpu: warning: resuming past a checkpoint "
             f"fingerprint mismatch ({got} vs {fingerprint}) — caller "
             "vouches the scenario only differs in run parameters\n")
-    leaves, treedef = jax.tree.flatten(hosts_template)
-    n = len(leaves)
-    new_leaves = [jnp.asarray(z[f"leaf{i}"]) for i in range(n)]
-    for tpl, new in zip(leaves, new_leaves):
-        if tpl.shape != new.shape or tpl.dtype != new.dtype:
-            raise ValueError("checkpoint layout mismatch "
-                             f"({new.shape}/{new.dtype} vs "
-                             f"{tpl.shape}/{tpl.dtype})")
     hosts = jax.tree.unflatten(treedef, new_leaves)
-    return (hosts, int(z["__wstart__"]), int(z["__wend__"]),
-            int(z["__windows__"]))
+    hosted_blob = None
+    hosted_path = file + ".hosted"
+    if os.path.exists(hosted_path):
+        with open(hosted_path, "rb") as f:
+            hosted_blob = f.read()
+    return Snapshot(hosts=hosts, wstart=wstart, wend=wend,
+                    windows=windows,
+                    fault_idx=fault_idx,
+                    digest_records=digest_records,
+                    digest_chain=digest_chain,
+                    hosted_blob=hosted_blob,
+                    path=file,
+                    meta={"fingerprint": got})

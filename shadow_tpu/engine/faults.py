@@ -252,6 +252,34 @@ class FaultInjector:
         """Earliest unapplied fault time, or None."""
         return self.events[self.i].t if self.pending() else None
 
+    def fast_forward(self, idx: int, sh):
+        """Resume support (engine.sim): re-arm the injector at
+        schedule position `idx` — the ``__fault_idx__`` a checkpoint
+        stamps. The schedule is a pure function of the config, so the
+        snapshot only needs the POSITION: host-fault device effects
+        already live in the restored arrays, and the link-fault
+        bookkeeping (down counts, active loss/latency episodes) is
+        replayed here so the Shared lat/rel tables — which are NOT
+        part of the Hosts snapshot — come out exactly as the
+        uninterrupted run's. Replayed events are appended to the log
+        so SimReport.faults reports the whole logical run. Returns the
+        (possibly rebuilt) Shared tables."""
+        if not (0 <= idx <= len(self.events)):
+            raise ValueError(
+                f"checkpoint fault index {idx} is outside this "
+                f"schedule (0..{len(self.events)}) — the snapshot "
+                "belongs to a different fault config")
+        shared_dirty = False
+        for ev in self.events[:idx]:
+            if ev.kind not in ("host_down", "host_up"):
+                self._link_event(ev)
+                shared_dirty = True
+            self.log.append(self._record(ev))
+        self.i = idx
+        if shared_dirty:
+            sh = self._recompute_shared(sh)
+        return sh
+
     # --- application ---
     def apply_batch(self, hosts, sh):
         """Apply every event sharing the head time. Returns
@@ -419,3 +447,81 @@ class FaultInjector:
         self._cur_lat = lat
         return sh.replace(lat_ns=jnp.asarray(lat, jnp.int64),
                           rel=jnp.asarray(rel, jnp.float32))
+
+
+class CrashHook:
+    """Simulator-suicide triggers for durability testing: SIGKILL
+    THIS process (the whole simulator — exactly a preemption, no
+    cleanup runs) either at the first chunk boundary at/after a given
+    SIMULATED time, or after a WALL-clock delay. The durability proof
+    (tests/test_until_complete.py, verify skill crash-resume smoke)
+    uses both: the sim-time trigger lands at a deterministic point,
+    the wall-clock one at an arbitrary instant — resume must be
+    byte-identical either way.
+
+    Environment knobs (read by engine.sim's run loop):
+
+    - ``SHADOW_TPU_CRASH_SIM_NS``: fire when the run loop first sees
+      ``ws >= value`` (after the checkpoint block, so a snapshot due
+      at the same boundary is durable before the kill);
+    - ``SHADOW_TPU_CRASH_WALL_S``: arm a wall-clock timer at run
+      start; fires mid-anything, including mid-``checkpoint.save``
+      (the atomicity contract under test);
+    - ``SHADOW_TPU_CRASH_GUARD``: path created O_EXCL at fire time —
+      the guard makes the crash one-shot, so a supervised resume of
+      the SAME command line does not crash again.
+    """
+
+    def __init__(self, sim_ns: int = None, wall_s: float = None,
+                 guard: str = None):
+        self.sim_ns = sim_ns
+        self.guard = guard
+        self._timer = None
+        if wall_s is not None:
+            import threading
+            self._timer = threading.Timer(wall_s, self._fire)
+            self._timer.daemon = True
+            self._timer.start()
+
+    @classmethod
+    def from_env(cls):
+        import os as _os
+        sim_ns = _os.environ.get("SHADOW_TPU_CRASH_SIM_NS")
+        wall_s = _os.environ.get("SHADOW_TPU_CRASH_WALL_S")
+        if not sim_ns and not wall_s:
+            return None
+        return cls(sim_ns=int(sim_ns) if sim_ns else None,
+                   wall_s=float(wall_s) if wall_s else None,
+                   guard=_os.environ.get("SHADOW_TPU_CRASH_GUARD"))
+
+    def _fire(self):
+        import os as _os
+        import signal as _signal
+        if self.guard:
+            try:
+                fd = _os.open(self.guard,
+                              _os.O_CREAT | _os.O_EXCL | _os.O_WRONLY)
+                _os.close(fd)
+            except FileExistsError:
+                self.sim_ns = None          # already fired once: disarm
+                if self._timer is not None:
+                    self._timer.cancel()
+                return
+            except OSError as e:
+                # a broken guard (e.g. missing directory) must not
+                # silently skip the kill — fire anyway; the repeated
+                # SIGKILLs exhaust the supervisor's retries loudly
+                sys.stderr.write(
+                    f"shadow_tpu: CrashHook guard {self.guard!r} "
+                    f"unusable ({e}) — firing without fire-once "
+                    "protection\n")
+        sys.stderr.write(
+            "shadow_tpu: CrashHook firing — SIGKILLing the simulator "
+            "(durability test)\n")
+        sys.stderr.flush()
+        _os.kill(_os.getpid(), _signal.SIGKILL)
+
+    def maybe_fire(self, ws: int):
+        """Run-loop check for the sim-time trigger."""
+        if self.sim_ns is not None and ws >= self.sim_ns:
+            self._fire()

@@ -587,18 +587,28 @@ class Simulation:
 
     def run(self, verbose: bool = False, mesh=None, heartbeat_s: float = 0,
             logger=None, checkpoint_path: str = None,
-            checkpoint_every_s: float = 0,
+            checkpoint_every_s: float = 0, checkpoint_keep: int = 0,
             resume_from: str = None, pcap_dir: str = None,
             trace: str = None, metrics: str = None,
             digest: str = None, digest_every: int = 0,
-            digest_context: dict = None,
+            digest_context: dict = None, digest_rewind: bool = True,
             resume_unchecked: bool = False) -> SimReport:
         """Run to the stop time. With `mesh` (a 1-D jax Mesh over a
         "hosts" axis) the window program runs under shard_map with the
         host dimension block-sharded — same results, N chips.
         `heartbeat_s` > 0 emits tracker heartbeats on that sim-time
         interval (obs.tracker). `checkpoint_path` + `checkpoint_every_s`
-        snapshot state periodically; `resume_from` restores one.
+        snapshot state periodically into a crash-safe rotating store
+        (engine.checkpoint.CheckpointStore: atomic tmp+fsync+rename
+        writes, content hashes, the last `checkpoint_keep` snapshots —
+        default 3 — and a ``latest`` pointer); `resume_from` restores
+        a snapshot (a concrete .npz, or the store base to resolve the
+        newest valid one with corrupt-head fallback). Resume covers
+        fault schedules (the snapshot stamps the injector's schedule
+        position and link-episode bookkeeping is replayed) and hosted
+        apps (checkpointed runs journal each child's shim protocol
+        stream; resume respawns children and fast-forwards them by
+        deterministic replay — docs/durability.md).
 
         `trace` writes a Chrome trace-event JSON timeline (obs.trace:
         per-chunk spans with sim-time args, compile/hosting/tracker/
@@ -617,7 +627,11 @@ class Simulation:
         the effective chunk so records land on exact window
         boundaries. `resume_unchecked` downgrades the checkpoint
         fingerprint check on `resume_from` to a warning (divergence
-        bisection replays under a clamped stop time).
+        bisection replays under a clamped stop time). On
+        `resume_from`, `digest` is by default treated as the crashed
+        attempt's own chain file and rewound to the snapshot's stamped
+        position; pass `digest_rewind=False` when the chain is a FRESH
+        file recording the resumed tail only (divergence replays).
 
         Trace, metrics and digest install their process-global
         recorders for the duration of this run only; with all unset
@@ -639,9 +653,15 @@ class Simulation:
         own_tr = own_mt = own_dg = False
         if digest is not None:
             if not DG.ENABLED:
+                # under a multi-process mesh every process runs the
+                # recorder state machine (the per-record state pull is
+                # a collective, so cadence must agree everywhere) but
+                # only process 0 writes the chain/manifest files
                 DG.install(digest,
                            every=digest_every or DG.DEFAULT_EVERY,
-                           context=digest_context)
+                           context=digest_context,
+                           writer=(not dist.is_multiprocess()
+                                   or jax.process_index() == 0))
                 own_dg = True
             else:
                 import sys as _sys
@@ -674,8 +694,10 @@ class Simulation:
                 verbose=verbose, mesh=mesh, heartbeat_s=heartbeat_s,
                 logger=logger, checkpoint_path=checkpoint_path,
                 checkpoint_every_s=checkpoint_every_s,
+                checkpoint_keep=checkpoint_keep,
                 resume_from=resume_from, pcap_dir=pcap_dir,
-                resume_unchecked=resume_unchecked)
+                resume_unchecked=resume_unchecked,
+                digest_rewind=digest_rewind)
         finally:
             if own_tr:
                 TR.finish()
@@ -686,7 +708,8 @@ class Simulation:
 
     def _run_impl(self, verbose, mesh, heartbeat_s, logger,
                   checkpoint_path, checkpoint_every_s, resume_from,
-                  pcap_dir, resume_unchecked=False) -> SimReport:
+                  pcap_dir, resume_unchecked=False,
+                  checkpoint_keep=0, digest_rewind=True) -> SimReport:
         from ..obs import digest as DG
         from ..obs import metrics as MT
         from ..obs import trace as TR
@@ -709,22 +732,17 @@ class Simulation:
                     "fault injection + multi-process mesh not "
                     "supported (host-fault surgery needs addressable "
                     "state)")
-            if dg is not None:
+            if dg is not None and resume_from:
                 raise NotImplementedError(
-                    "digest recording + multi-process mesh not "
-                    "supported (the state pull would need a per-record "
-                    "allgather)")
-        if self.injector is not None and resume_from:
-            raise NotImplementedError(
-                "resume with a fault schedule is not supported: the "
-                "snapshot holds device state only, not the injector's "
-                "episode bookkeeping")
-            # checkpoint/resume and pcap ARE supported on a
-            # multi-process mesh: both allgather the relevant state
-            # and process 0 writes the files (pcap rings are a debug
-            # path — the per-chunk DCN hop is the documented price);
-            # every process must be able to read the snapshot path on
-            # resume (shared storage)
+                    "resume + digest + multi-process mesh not "
+                    "supported: the chain rewind reads/truncates the "
+                    "chain file, which only process 0 owns")
+            # digest recording, checkpoint/resume and pcap ARE
+            # supported on a multi-process mesh: each allgathers the
+            # relevant state per record/chunk (the documented DCN-hop
+            # price of these debug/durability paths) and process 0
+            # writes the files; every process must be able to read
+            # the snapshot path on resume (shared storage)
 
         tracker = None
         if heartbeat_s:
@@ -745,6 +763,22 @@ class Simulation:
         from . import checkpoint as ckpt
         fingerprint = ckpt.scenario_fingerprint(self.scenario, self.cfg,
                                                 self.seed)
+        store = None
+        if checkpoint_path:
+            store = ckpt.CheckpointStore(checkpoint_path,
+                                         keep=checkpoint_keep)
+            if self.hosting is not None:
+                # checkpointed hosted runs journal every child's shim
+                # protocol stream so resume can fast-forward respawned
+                # children by deterministic replay (must be armed
+                # before any child spawns)
+                self.hosting.enable_journal()
+        # durability-test crash triggers (SHADOW_TPU_CRASH_SIM_NS /
+        # _WALL_S / _GUARD): SIGKILL this process mid-run, exactly a
+        # preemption — tests/test_until_complete.py proves the
+        # supervised resume is byte-identical
+        from .faults import CrashHook
+        crash = CrashHook.from_env()
 
         if dg is not None:
             # run manifest (seed, fingerprint, engine shape, versions,
@@ -806,9 +840,18 @@ class Simulation:
             # one digest-chain sample (obs.digest): the state pull is
             # the whole cadence cost, accounted as a span + metrics
             _d0 = TR.TRACER.now() if TR.ENABLED else None
+            pulled = hosts
+            if multiproc:
+                # materialize the GLOBAL state on every process (the
+                # collective must run on all of them — which is why
+                # the recorder's cadence state machine runs
+                # everywhere); only process 0 writes the record
+                from jax.experimental import multihost_utils
+                pulled = multihost_utils.process_allgather(hosts,
+                                                           tiled=True)
             hosted = (self.hosting.digest_state()
                       if self.hosting is not None else None)
-            dg.record(hosts, H, window, sim_ns, kind, hosted=hosted)
+            dg.record(pulled, H, window, sim_ns, kind, hosted=hosted)
             if TR.ENABLED:
                 TR.TRACER.complete("digest.record", _d0,
                                    args={"window": window,
@@ -842,27 +885,69 @@ class Simulation:
 
         total_windows = 0
         if resume_from:
-            if self.hosting is not None:
-                raise NotImplementedError(
-                    "resume with hosted apps is not supported: the "
-                    "snapshot holds device state only, not the hosted "
-                    "processes' Python state")
-            hosts, ws0, we0, total_windows = ckpt.load(
-                resume_from, hosts, fingerprint,
-                strict=not resume_unchecked)
-            wstart = jnp.int64(ws0)
-            wend = jnp.int64(we0)
+            snap = ckpt.load(resume_from, hosts, fingerprint,
+                             strict=not resume_unchecked)
+            hosts = snap.hosts
+            wstart = jnp.int64(snap.wstart)
+            wend = jnp.int64(snap.wend)
+            total_windows = snap.windows
             if mesh is not None:
                 # hp/sh are already placed; only the restored Hosts
                 # arrays need (re-)sharding
                 from ..parallel.shard import put_hosts
                 hosts = put_hosts(hosts, mesh)
+            if inj is not None:
+                # the schedule is a pure function of the config, so
+                # the snapshot records only the POSITION: fast_forward
+                # replays the link-episode bookkeeping (host-fault
+                # effects already live in the restored arrays) and
+                # rebuilds the Shared lat/rel tables exactly
+                if snap.fault_idx < 0:
+                    raise ValueError(
+                        "snapshot records no fault schedule position "
+                        "(__fault_idx__); it was taken by a run "
+                        "without this fault config — refusing to "
+                        "resume into one")
+                sh = inj.fast_forward(snap.fault_idx, sh)
+                if mesh is not None:
+                    from ..parallel.shard import put_shared
+                    sh = put_shared(sh, mesh)
+            if self.hosting is not None:
+                if snap.hosted_blob is None:
+                    raise ValueError(
+                        "scenario hosts real processes but the "
+                        "snapshot has no hosted sidecar "
+                        "(<snapshot>.npz.hosted) — it was taken "
+                        "without hosted-app support")
+                # rebuild the hosted tier and fast-forward respawned
+                # children by journal replay (hosting.runtime.restore)
+                self.hosting.restore(snap.hosted_blob)
 
         if dg is not None:
             # the cadence clock is per-run: a recorder spanning
             # several runs (outer harness) or a resume jump must not
-            # inherit the previous run's next_due
-            dg.begin_run(total_windows)
+            # inherit the previous run's next_due. A resumed run
+            # REWINDS the chain the crashed attempt left to exactly
+            # the position the snapshot stamped: the kept prefix is
+            # identical to a fresh run's (determinism), later records
+            # are re-produced live, so the final chain is
+            # byte-identical to an uninterrupted run's. A divergence
+            # replay resumes the SIMULATION from a snapshot but
+            # records a fresh chain of the tail only — it opts out
+            # via digest_rewind=False (the snapshot's stamped count
+            # belongs to the original run's chain, not this file)
+            if (resume_from and snap.digest_records >= 0
+                    and digest_rewind):
+                dg.rewind(snap.digest_records, snap.digest_chain)
+                if dg.due(total_windows):
+                    # the crashed attempt died between this snapshot
+                    # and the cadence record due at the very same
+                    # boundary — emit it now from the restored state,
+                    # exactly where the uninterrupted run did
+                    dg_record("cadence", total_windows,
+                              min(int(wstart), stop_ns))
+            else:
+                dg.begin_run(total_windows)
 
         if checkpoint_path and not checkpoint_every_s:
             raise ValueError(
@@ -996,11 +1081,31 @@ class Simulation:
                     to_save = multihost_utils.process_allgather(
                         hosts, tiled=True)
                 if not multiproc or jax.process_index() == 0:
-                    ckpt.save(checkpoint_path, to_save, ws, int(wend),
-                              total_windows, fingerprint)
+                    # stamp the injector's schedule position and the
+                    # digest chain position (record count + running
+                    # hash): resume re-arms both exactly, so records
+                    # and fault applications landing AFTER this save
+                    # in the same loop iteration are re-produced
+                    # live, never duplicated or lost
+                    store.save(
+                        to_save, ws, int(wend), total_windows,
+                        fingerprint,
+                        fault_idx=(inj.i if inj is not None else -1),
+                        digest_records=(len(dg.records)
+                                        if dg is not None else -1),
+                        digest_chain=(dg.chain_hex
+                                      if dg is not None else None),
+                        hosted_blob=(self.hosting.snapshot()
+                                     if self.hosting is not None
+                                     else None))
                 ckpt_at += next_ckpt
                 if TR.ENABLED:
                     TR.TRACER.complete("checkpoint.save", _k0)
+            if crash is not None:
+                # durability-test preemption: lands AFTER the
+                # checkpoint block, so a snapshot due at this boundary
+                # is durable before the kill
+                crash.maybe_fire(ws)
             if obs_on:
                 # per-chunk sim<->wall correlation: one jitted scalar
                 # reduction per chunk (replicated on every process

@@ -20,6 +20,10 @@ from ..obs import digest as _DG
 from .api import HostOS
 from .bridge import OP_WORDS, apply_ops_jit
 
+# first link of the hosted op-stream digest chain (see _op_chain)
+OPS_CHAIN_SEED = hashlib.blake2b(
+    b"shadow_tpu.hosted.ops.v1", digest_size=8).hexdigest()
+
 
 class HostingRuntime:
     """Owns the hosted app instances and the window-boundary exchange."""
@@ -38,12 +42,18 @@ class HostingRuntime:
         self.factories = factories or {}
         self.names = names
         self.batch_cap = batch_cap
+        self._dns = dns
         self._now = 0
-        # hosted-channel op-stream digest (obs.digest): running hash
-        # over every applied op batch — with the per-app shim request
-        # digests it attributes a determinism divergence to the hosted
-        # tier. Updated only while a digest recorder is installed.
-        self._op_hash = hashlib.blake2b(digest_size=8)
+        self._journal_on = False    # enable_journal(): checkpoint runs
+        #   journal each child's protocol stream for resume replay
+        # hosted-channel op-stream digest (obs.digest): a rolling
+        # CHAIN hash over every applied op batch — with the per-app
+        # shim request digests it attributes a determinism divergence
+        # to the hosted tier. A chain (hash of previous hex + batch)
+        # rather than one long hash object so checkpoints can carry it
+        # (hashlib midstates do not pickle). Updated only while a
+        # digest recorder is installed.
+        self._op_chain = OPS_CHAIN_SEED
         self._dead = set()      # generic apps killed by a fault (shim
         #   apps self-guard; these need their wakes suppressed here)
         self._exit_log = {}     # host_id -> exit record of the LAST
@@ -120,8 +130,104 @@ class HostingRuntime:
         attach = getattr(app, "attach_payload_broker", None)
         if attach is not None:
             attach(self.payloads)
+        if self._journal_on:
+            ej = getattr(app, "enable_journal", None)
+            if ej is not None:
+                ej()
         self.apps[hid] = app
         self._dead.discard(hid)
+
+    # --- checkpoint/resume (engine.checkpoint hosted sidecar) ---
+    def enable_journal(self):
+        """Checkpointed runs journal each shim child's protocol
+        stream so a resume can fast-forward a respawned child by
+        deterministic replay (docs/durability.md). Must be enabled
+        before children spawn (engine.sim does, before the run loop).
+        The journal grows with the child's syscall traffic for the
+        whole run — the documented price of hosted resumability."""
+        self._journal_on = True
+        for app in self.apps.values():
+            ej = getattr(app, "enable_journal", None)
+            if ej is not None:
+                ej()
+
+    def snapshot(self) -> bytes:
+        """Pickle the hosted tier for one checkpoint: app instances
+        (ShimApp excludes its live process/channel and keeps the
+        journal), per-host OS state (PRNG + live socket handles — ONE
+        pickle, so Sock identity shared between HostOS and app state
+        survives), the payload broker, and the op-stream chain.
+        Runs at a window boundary: every pending op batch has been
+        flushed and every live child is parked in a blocked call."""
+        import pickle
+        for os_ in self.os.values():
+            assert not os_._ops, \
+                "hosted snapshot mid-batch (ops not flushed)"
+        state = {
+            "version": 1,
+            "op_chain": self._op_chain,
+            "dead": set(self._dead),
+            "exit_log": dict(self._exit_log),
+            "payload_streams": self.payloads._streams,
+            "payload_subs": self.payloads._subs,
+            "apps": dict(self.apps),
+            "os": {hid: {"rng": o._rng, "socks": o._socks}
+                   for hid, o in self.os.items()},
+        }
+        try:
+            return pickle.dumps(state,
+                                protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception as e:
+            raise RuntimeError(
+                "hosted tier is not snapshotable: a hosted app holds "
+                f"unpicklable state ({type(e).__name__}: {e}); give "
+                "it __getstate__/__setstate__ like hosting.shim."
+                "ShimApp") from e
+
+    def restore(self, blob: bytes):
+        """Rebuild the hosted tier from a checkpoint sidecar, then
+        fast-forward each shim child by replaying its journaled
+        protocol stream (ShimApp.resume_replay): the respawned binary
+        re-executes deterministically (time, entropy and I/O are
+        virtualized), re-issues the same requests, and receives the
+        journaled responses — byte divergence is diagnosed loudly in
+        SimReport.hosted and the child is killed, never desynced."""
+        import pickle
+        state = pickle.loads(blob)
+        self._op_chain = state["op_chain"]
+        self._dead = state["dead"]
+        self._exit_log = state["exit_log"]
+        self.payloads._streams = state["payload_streams"]
+        self.payloads._subs = state["payload_subs"]
+        self.apps = state["apps"]
+        self.os = {}
+        for hid, osd in state["os"].items():
+            o = HostOS(hid, self.names.get(hid, f"host{hid}"),
+                       osd["rng"], self._dns, lambda: self._now)
+            o._socks = osd["socks"]
+            self.os[hid] = o
+        for hid, app in sorted(self.apps.items()):
+            attach = getattr(app, "attach_payload_broker", None)
+            if attach is not None:
+                attach(self.payloads)
+            if self._journal_on:
+                ej = getattr(app, "enable_journal", None)
+                if ej is not None:
+                    ej()
+        # replay AFTER the whole tier is rewired (a replaying child's
+        # payload pops must see the restored broker)
+        for hid, app in sorted(self.apps.items()):
+            rr = getattr(app, "resume_replay", None)
+            if rr is not None:
+                rr(self.os[hid])
+        if not self._journal_on:
+            # this run takes no further snapshots, so the restored
+            # journals have no consumer left — drop them instead of
+            # buffering the rest of the run's traffic
+            for app in self.apps.values():
+                dj = getattr(app, "disable_journal", None)
+                if dj is not None:
+                    dj()
 
     def exit_info(self) -> dict:
         """Per-host exit report, keyed by hostname (SimReport.hosted):
@@ -143,7 +249,7 @@ class HostingRuntime:
         """Hosted-tier digests for one obs.digest record: the running
         op-batch stream hash plus each shim app's protocol-request
         stream hash (hostname-keyed — stable across runs)."""
-        out = {"ops": self._op_hash.hexdigest()}
+        out = {"ops": self._op_chain}
         shim = {}
         for hid, app in sorted(self.apps.items()):
             f = getattr(app, "op_stream_digest", None)
@@ -264,8 +370,11 @@ class HostingRuntime:
                       enc(op.d), op.t, self.procs.get(hid, 0))
         if _DG.ENABLED:
             # the un-padded batch IS the hosted-channel op stream the
-            # device replays — hash it in flush order
-            self._op_hash.update(ops[:len(pending)].tobytes())
+            # device replays — chain-hash it in flush order
+            self._op_chain = hashlib.blake2b(
+                bytes.fromhex(self._op_chain) +
+                ops[:len(pending)].tobytes(),
+                digest_size=8).hexdigest()
         hosts, results = apply_ops_jit(hosts, hp, sh, jnp.asarray(ops))
         res = np.asarray(results)
         for k, (hid, os, op) in enumerate(pending):

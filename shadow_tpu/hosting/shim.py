@@ -158,6 +158,11 @@ GRACE_NS = 30 * 10**9
 WATCHDOG_S_DEFAULT = 30.0
 
 
+# first link of the per-child protocol-stream digest chain
+SHIM_CHAIN_SEED = _hashlib.blake2b(
+    b"shadow_tpu.shim.ops.v1", digest_size=8).hexdigest()
+
+
 class ShimHang(Exception):
     """Watchdog: the child made no RPC progress within the deadline."""
 
@@ -269,6 +274,17 @@ class ShimApp(HostedApp):
         self.parked = None
         self.park_seq = 0         # increments per park: stale-timeout guard
         self.exited = False
+        self._started = False     # a child was spawned at least once
+        # --- checkpoint/resume (docs/durability.md) ---
+        # protocol-stream journal: ordered ("rx"/"tx", bytes) records
+        # of everything that crossed the channel since THIS child
+        # spawned. None = disabled; enable_journal() (checkpointed
+        # runs) arms it. resume_replay() respawns the child and pumps
+        # the journal back: the shim virtualizes time, entropy and
+        # I/O, so a deterministic binary re-issues byte-identical
+        # requests and lands parked in the same blocked call.
+        self._journal = None
+        self._replaying = False
         # --- supervision (per-host exit report; SimReport.hosted) ---
         self.exit_status = None   # OS exit status (negative = -signal)
         self.exit_cause = None    # human diagnosis ("hung: ...", ...)
@@ -281,8 +297,10 @@ class ShimApp(HostedApp):
         # protocol-request stream digest (obs.digest): every frame the
         # child issued, in service order — pins a determinism
         # divergence to "the child behaved differently" vs "the engine
-        # diverged". Updated only while a digest recorder is installed.
-        self._op_hash = _hashlib.blake2b(digest_size=8)
+        # diverged". A rolling chain (not one hash object) so
+        # checkpoints can carry it — hashlib midstates do not pickle.
+        # Updated only while a digest recorder is installed.
+        self._op_chain = SHIM_CHAIN_SEED
         self._payloads = None     # api.PayloadBroker (runtime attaches)
         self._opened = set()      # broker keys this app opened
         self._mysubs = set()      # the subset I subscribed (I read)
@@ -353,21 +371,42 @@ class ShimApp(HostedApp):
             stdout.close()
         theirs.close()
         self.chan = ours
+        self._started = True
         # wall-clock RPC deadline (module doc above WATCHDOG_S_DEFAULT):
         # applies to every channel read AND write, so a child that
         # stops draining its end cannot wedge _rsp either
         if self.watchdog_s > 0:
             self.chan.settimeout(self.watchdog_s)
 
+    def _jrec(self, d: str, data: bytes):
+        """Journal one channel transfer (adjacent same-direction
+        records coalesce, so the journal is bounded by traffic, not
+        read granularity). Replay traffic is never re-journaled — the
+        restored journal already holds those bytes."""
+        if self._journal is None or self._replaying or not data:
+            return
+        if self._journal and self._journal[-1][0] == d:
+            self._journal[-1][1] += data
+        else:
+            self._journal.append([d, bytearray(data)])
+
     def _recv(self, n):
         """One watchdog-supervised channel read."""
         import socket as pysock
         try:
-            return self.chan.recv(n)
+            chunk = self.chan.recv(n)
         except pysock.timeout:
             raise ShimHang(
                 f"no RPC progress within {self.watchdog_s:g}s wall"
                 f" (pid {self.proc.pid if self.proc else '?'})")
+        self._jrec("rx", chunk)
+        return chunk
+
+    def _send(self, data: bytes):
+        """One journaled channel write (every response goes through
+        here so resume replay can reproduce the exact byte stream)."""
+        self.chan.sendall(data)
+        self._jrec("tx", data)
 
     def _read_req(self):
         buf = b""
@@ -400,7 +439,7 @@ class ShimApp(HostedApp):
         return bytes(buf)
 
     def _rsp(self, r0=0, r1=0, r2=0):
-        self.chan.sendall(RSP.pack(int(r0), int(r1), int(r2)))
+        self._send(RSP.pack(int(r0), int(r1), int(r2)))
 
     def _rsp_data(self, k, data=None):
         """OP_RECV/OP_RANDOM answer: header then, when `data` is real
@@ -410,10 +449,10 @@ class ShimApp(HostedApp):
         hosted<->modeled hot path free of per-byte channel traffic)."""
         k = max(int(k), 0)
         if data is None:
-            self.chan.sendall(RSP.pack(k, 0, 0))
+            self._send(RSP.pack(k, 0, 0))
             return
         out = data[:k] + b"\0" * (k - len(data))
-        self.chan.sendall(RSP.pack(k, 1, 0) + out)
+        self._send(RSP.pack(k, 1, 0) + out)
 
     # --- epoll/poll readiness ---
     def _events_of(self, vfd):
@@ -462,7 +501,7 @@ class ShimApp(HostedApp):
         out = RSP.pack(len(hits), 0, 0)
         for vfd, ev in hits:
             out += EVPAIR.pack(vfd, ev)
-        self.chan.sendall(out)
+        self._send(out)
 
     def _take_vfd(self, vfd):
         """Adopt the C-side reserved fd number as a vfd id. The number
@@ -614,7 +653,9 @@ class ShimApp(HostedApp):
                     self._child_gone(os)       # clean channel EOF
                     break
                 if _DG.ENABLED:
-                    self._op_hash.update(REQ.pack(*req))
+                    self._op_chain = _hashlib.blake2b(
+                        bytes.fromhex(self._op_chain) + REQ.pack(*req),
+                        digest_size=8).hexdigest()
                 # per-op protocol metrics: count + HANDLER latency (a
                 # call that parks is counted when it arrives; the
                 # sim-time it stays parked is not wall cost)
@@ -703,9 +744,93 @@ class ShimApp(HostedApp):
                 vs.closed = True
 
     def op_stream_digest(self) -> str:
-        """Running hash of every protocol request served so far
+        """Running chain hash of every protocol request served so far
         (hosting.runtime.digest_state -> obs.digest records)."""
-        return self._op_hash.hexdigest()
+        return self._op_chain
+
+    # --- checkpoint/resume (hosting.runtime snapshot/restore) ---
+    def enable_journal(self):
+        """Arm protocol-stream journaling (idempotent: a restored app
+        keeps the journal it was pickled with)."""
+        if self._journal is None:
+            self._journal = []
+
+    def disable_journal(self):
+        """Drop the journal: a run that will never snapshot again
+        (resume without --checkpoint) must not keep buffering the
+        child's protocol traffic in RAM."""
+        self._journal = None
+
+    def __getstate__(self):
+        """Checkpoint pickling: everything but the live OS process,
+        its channel, the shared payload broker (runtime re-attaches)
+        and the id()-keyed socket index (rebuilt on restore)."""
+        d = dict(self.__dict__)
+        for k in ("proc", "chan", "_payloads", "by_sock"):
+            d.pop(k, None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.proc = None
+        self.chan = None
+        self._payloads = None
+        self._replaying = False
+        self.by_sock = {}
+        for vfd, vs in self.vfds.items():
+            if vs.sock is not None:
+                self.by_sock[id(vs.sock)] = vfd
+
+    def resume_replay(self, os):
+        """Fast-forward a respawned child to the snapshot point: spawn
+        the binary fresh and pump the journaled protocol stream — read
+        back each request the original child issued (byte-compared:
+        the shim virtualizes time, entropy and I/O, so a deterministic
+        binary MUST reproduce it exactly) and answer with the recorded
+        response bytes. No ops are re-issued and no simulator state is
+        touched: the device arrays already hold the post-checkpoint
+        truth; only the real OS process needs to catch up. Afterwards
+        the child sits parked in the same blocked call the snapshot
+        recorded. A byte divergence (non-deterministic child: wall
+        clock, unvirtualized I/O, ...) is a diagnosed supervisor kill
+        — loud in SimReport.hosted — never a desynced channel."""
+        if self.exited or not self._started:
+            return          # dead before the snapshot, or never ran
+        if self._journal is None:
+            self._supervise_kill(os, "resume: snapshot carries no "
+                                     "protocol journal; cannot "
+                                     "fast-forward the child")
+            return
+        self._replaying = True
+        try:
+            self._spawn()
+            for dirn, data in self._journal:
+                data = bytes(data)
+                if dirn == "tx":
+                    self.chan.sendall(data)
+                    continue
+                got = bytearray()
+                while len(got) < len(data):
+                    chunk = self._recv(len(data) - len(got))
+                    if not chunk:
+                        raise ShimProtocolError(
+                            f"channel EOF {len(got)}/{len(data)} "
+                            "bytes into a journaled request (child "
+                            "died during replay)")
+                    got += chunk
+                if bytes(got) != data:
+                    off = next(i for i, (x, y)
+                               in enumerate(zip(got, data)) if x != y)
+                    raise ShimProtocolError(
+                        f"request stream diverged at byte {off} of a "
+                        f"{len(data)}-byte journaled read")
+        except (ShimHang, ShimProtocolError, OSError) as e:
+            self._supervise_kill(
+                os, "resume: journal replay diverged — the respawned "
+                    f"child did not reproduce its recorded protocol "
+                    f"stream ({e})")
+        finally:
+            self._replaying = False
 
     def exit_info(self) -> dict:
         """Per-host exit record for SimReport.hosted (None while the

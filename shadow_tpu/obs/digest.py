@@ -106,10 +106,15 @@ def _hash_arrays(arrs: dict, H: int, host_detail: int):
 
 
 class DigestRecorder:
-    """One digest chain. `path=None` collects in memory only (tests)."""
+    """One digest chain. `path=None` collects in memory only (tests).
+    `writer=False` runs the full cadence/chain state machine but never
+    touches the filesystem — non-zero processes of a multi-process
+    mesh stay in lockstep with process 0 (every process must agree on
+    when a record is due, because the state pull is a collective)."""
 
     def __init__(self, path: str | None, every: int = DEFAULT_EVERY,
-                 host_detail: int = None, context: dict = None):
+                 host_detail: int = None, context: dict = None,
+                 writer: bool = True):
         self.path = path
         self.every = max(int(every), 1)
         if host_detail is None:
@@ -122,8 +127,10 @@ class DigestRecorder:
         self.records = []
         self.manifest = None
         self.bytes_hashed = 0
+        self.writer = bool(writer)
         self._chain = _CHAIN_SEED
         self._file = None
+        self._mode = "w"
         self.next_due = self.every
 
     # --- cadence ---
@@ -138,6 +145,87 @@ class DigestRecorder:
         suppress every cadence sample of the next run."""
         self.next_due = int(total_windows) + self.every
 
+    @property
+    def chain_hex(self) -> str:
+        """Current running chain hash — stamped into checkpoints
+        (engine.checkpoint ``__digest_chain__``) so rewind() can
+        verify the kept prefix refolds to exactly the snapshot's
+        position."""
+        return self._chain.hex()
+
+    def rewind(self, n_records: int, chain_hex: str = None):
+        """Resume a chain a crashed attempt left behind: reload the
+        chain file and keep EXACTLY the first `n_records` records —
+        the count the checkpoint stamped at save time — dropping
+        everything later (records past the snapshot die with the
+        crash and are re-produced live by the resumed run; the
+        determinism contract makes the kept prefix identical to what
+        this run would have written). The kept prefix is refolded and
+        verified against the snapshot's `chain_hex`; the cadence
+        re-arms exactly as the uninterrupted run's was (every record
+        sets next_due = its window + every). Later records APPEND to
+        the truncated file: the final chain is byte-identical to an
+        uninterrupted same-seed run's (tests/test_until_complete.py).
+
+        A trailing torn line (the crash landed mid-write) never
+        matters — it is past the kept count; a kept record that does
+        not refold is a corrupted prefix and fails loud."""
+        n = max(int(n_records), 0)
+        kept = []
+        if self.path is not None and os.path.exists(self.path):
+            assert self._file is None, "rewind() must precede records"
+            with open(self.path) as f:
+                lines = f.read().splitlines()
+            for i, line in enumerate(lines):
+                if len(kept) >= n:
+                    break
+                if not line.strip():
+                    continue
+                try:
+                    kept.append(json.loads(line))
+                except json.JSONDecodeError:
+                    raise ValueError(
+                        f"digest chain {self.path}: line {i + 1} is "
+                        "corrupt inside the checkpointed prefix "
+                        f"({len(kept)}/{n} records); refusing to "
+                        "resume it")
+        if len(kept) < n:
+            raise ValueError(
+                f"digest chain {self.path} holds {len(kept)} records "
+                f"but the checkpoint was taken after {n} — the chain "
+                "file does not belong to this run")
+        chain = _CHAIN_SEED
+        for rec in kept:
+            body = {k: v for k, v in rec.items() if k != "chain"}
+            payload = json.dumps(body, sort_keys=True,
+                                 separators=(",", ":")).encode()
+            chain = hashlib.blake2b(chain + payload,
+                                    digest_size=16).digest()
+            if rec.get("chain") != chain.hex():
+                raise ValueError(
+                    f"digest chain {self.path}: record at window "
+                    f"{rec.get('window')} does not refold — the "
+                    "prefix is corrupted; delete the chain and record "
+                    "fresh")
+        if chain_hex and chain.hex() != chain_hex:
+            raise ValueError(
+                f"digest chain {self.path}: the {n}-record prefix "
+                "refolds to a different chain hash than the "
+                "checkpoint stamped — chain and snapshot are from "
+                "different runs")
+        self._chain = chain
+        self.records = kept
+        if self.path is not None and self.writer:
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                for rec in kept:
+                    f.write(json.dumps(rec, sort_keys=True,
+                                       separators=(",", ":")) + "\n")
+            os.replace(tmp, self.path)
+        self._mode = "a"
+        self.next_due = ((kept[-1]["window"] + self.every) if kept
+                         else self.every)
+
     # --- manifest ---
     def manifest_path(self) -> str | None:
         return self.path + ".manifest.json" if self.path else None
@@ -149,7 +237,7 @@ class DigestRecorder:
             return
         self.manifest = manifest
         mp = self.manifest_path()
-        if mp is not None:
+        if mp is not None and self.writer:
             tmp = mp + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(manifest, f, indent=1, sort_keys=True)
@@ -191,9 +279,9 @@ class DigestRecorder:
                                       digest_size=16).digest()
         rec["chain"] = self._chain.hex()
         self.records.append(rec)
-        if self.path is not None:
+        if self.path is not None and self.writer:
             if self._file is None:
-                self._file = open(self.path, "w")
+                self._file = open(self.path, self._mode)
             self._file.write(json.dumps(rec, sort_keys=True,
                                         separators=(",", ":")) + "\n")
             self._file.flush()
@@ -273,12 +361,15 @@ def _git_rev() -> str | None:
 
 
 def install(path: str | None, every: int = DEFAULT_EVERY,
-            host_detail: int = None, context: dict = None) -> DigestRecorder:
+            host_detail: int = None, context: dict = None,
+            writer: bool = True) -> DigestRecorder:
     """Enable digest recording process-wide; the installer owns
-    finish() (the obs.trace/obs.metrics contract)."""
+    finish() (the obs.trace/obs.metrics contract). `writer=False`
+    keeps the full recorder state machine but never writes files —
+    the non-zero processes of a multi-process mesh."""
     global ENABLED, RECORDER
     RECORDER = DigestRecorder(path, every=every, host_detail=host_detail,
-                              context=context)
+                              context=context, writer=writer)
     ENABLED = True
     return RECORDER
 

@@ -2,14 +2,16 @@
 
 Launched N times by tests/test_distributed.py over loopback TCP:
     python dist_worker.py <coordinator> <num_procs> <proc_id> <out.npy>
-        [--ckpt <path>] [--resume]
+        [--ckpt <path>] [--resume] [--digest <path>]
 Each process contributes 2 virtual CPU devices; the global mesh spans
 all processes — the same shape a real multi-host TPU deployment has
 (ICI within a process's slice, DCN between processes).
 
 --ckpt: checkpoint every simulated second into <path> while running
 (process 0 writes the global snapshot). --resume: restore from <path>
-instead of starting fresh.
+instead of starting fresh. --digest: record a determinism digest
+chain at cadence 8 (every process pulls the global state — the
+per-record allgather — and process 0 writes the chain file).
 """
 
 import os
@@ -22,6 +24,8 @@ def main():
     ckpt = rest[rest.index("--ckpt") + 1] if "--ckpt" in rest else None
     resume = "--resume" in rest
     pcap = rest[rest.index("--pcap") + 1] if "--pcap" in rest else None
+    digest = (rest[rest.index("--digest") + 1]
+              if "--digest" in rest else None)
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -50,6 +54,8 @@ def main():
         kw = dict(checkpoint_path=ckpt, checkpoint_every_s=1.0)
     if pcap:
         kw["pcap_dir"] = pcap
+    if digest:
+        kw.update(digest=digest, digest_every=8)
     r = Simulation(scen, engine_cfg=cfg).run(mesh=mesh, **kw)
     if int(pid) == 0:
         np.save(out, r.stats)

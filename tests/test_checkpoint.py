@@ -1,5 +1,7 @@
 """Checkpoint/resume and CLI smoke tests."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -47,6 +49,214 @@ def test_checkpoint_rejects_other_scenario(tmp_path):
                        engine_cfg=EngineConfig(num_hosts=8, **CFG))
     with pytest.raises(ValueError, match="fingerprint"):
         other.run(resume_from=path)
+
+
+def test_resume_rewinds_digest_chain(tmp_path):
+    """Interrupted ≡ uninterrupted at digest-chain level, in-process:
+    an uninterrupted run records chain A; a checkpointed run records
+    chain B, which we then truncate to the position a crash just
+    after a mid-run snapshot would leave; a resumed run rewinds B to
+    the snapshot's stamped record count and re-produces the rest —
+    the final B must equal A byte for byte. (The subprocess SIGKILL
+    variants live in tests/test_until_complete.py.)"""
+    dg_a = str(tmp_path / "a.jsonl")
+    Simulation(scen(), engine_cfg=EngineConfig(num_hosts=8, **CFG)).run(
+        digest=dg_a, digest_every=8)
+
+    base = str(tmp_path / "ck")
+    dg_b = str(tmp_path / "b.jsonl")
+    Simulation(scen(), engine_cfg=EngineConfig(num_hosts=8, **CFG)).run(
+        digest=dg_b, digest_every=8, checkpoint_path=base,
+        checkpoint_every_s=2, checkpoint_keep=8)
+
+    # pick a MID-RUN snapshot (not the newest) and cut the chain to
+    # one record past its stamped position — the state a kill shortly
+    # after that save leaves behind
+    from shadow_tpu.engine import checkpoint as ck
+    store = ck.CheckpointStore(base)
+    snap_path = sorted(store.snapshots())[0]
+    n_recs = int(np.load(snap_path)["__digest_records__"])
+    lines = open(dg_b).read().splitlines()
+    assert n_recs + 1 < len(lines), "snapshot too late for this test"
+    with open(dg_b, "w") as f:
+        f.write("\n".join(lines[:n_recs + 1]) + "\n")
+
+    resumed = Simulation(scen(),
+                         engine_cfg=EngineConfig(num_hosts=8, **CFG))
+    report = resumed.run(digest=dg_b, digest_every=8,
+                         resume_from=snap_path)
+    assert report.windows > 0
+    assert open(dg_a, "rb").read() == open(dg_b, "rb").read(), (
+        "resumed digest chain differs from the uninterrupted run's")
+
+
+def test_resume_fresh_chain_opts_out_of_rewind(tmp_path):
+    """A divergence replay resumes the SIMULATION from a snapshot but
+    records a FRESH chain of the tail only (tools/divergence.py
+    --bisect --use-checkpoint). The snapshot stamps the original
+    run's record count, so the default rewind must refuse the empty
+    file loudly, and `digest_rewind=False` must instead arm the
+    cadence from the restored window and record a correct tail."""
+    import json
+
+    dg_a = str(tmp_path / "a.jsonl")
+    Simulation(scen(), engine_cfg=EngineConfig(num_hosts=8, **CFG)).run(
+        digest=dg_a, digest_every=8)
+
+    base = str(tmp_path / "ck")
+    Simulation(scen(), engine_cfg=EngineConfig(num_hosts=8, **CFG)).run(
+        digest=str(tmp_path / "b.jsonl"), digest_every=8,
+        checkpoint_path=base, checkpoint_every_s=2, checkpoint_keep=8)
+
+    from shadow_tpu.engine import checkpoint as ck
+    store = ck.CheckpointStore(base)
+    snap_path = sorted(store.snapshots())[0]
+    snap_w = int(np.load(snap_path)["__windows__"])
+    assert int(np.load(snap_path)["__digest_records__"]) > 0
+
+    # default rewind treats the chain as the crashed attempt's own
+    # file — a fresh file with a stamped count > 0 must fail loud
+    fresh = str(tmp_path / "fresh.jsonl")
+    with pytest.raises(ValueError, match="does not belong"):
+        Simulation(scen(), engine_cfg=EngineConfig(num_hosts=8, **CFG)).run(
+            digest=fresh, digest_every=8, resume_from=snap_path)
+
+    # the replay opt-out: fresh tail-only chain, no rewind
+    assert not os.path.exists(fresh)
+    report = Simulation(
+        scen(), engine_cfg=EngineConfig(num_hosts=8, **CFG)).run(
+        digest=fresh, digest_every=8, resume_from=snap_path,
+        digest_rewind=False)
+    assert report.windows > 0
+    recs = [json.loads(l) for l in open(fresh).read().splitlines()]
+    assert recs, "replay recorded no tail records"
+    assert all(r["window"] > snap_w for r in recs), (
+        "a fresh tail chain must not contain pre-snapshot records")
+    # the tail's end-of-run record hashes the same final state as the
+    # uninterrupted run's (alignment-free equivalence check)
+    end_a = [json.loads(l) for l in open(dg_a).read().splitlines()
+             if json.loads(l)["kind"] == "final"][-1]
+    end_c = [r for r in recs if r["kind"] == "final"][-1]
+    assert (end_c["window"], end_c["sim_ns"]) == (
+        end_a["window"], end_a["sim_ns"])
+    assert end_c["sections"] == end_a["sections"], (
+        "replayed tail reached a different final state")
+
+
+# --- checkpoint store unit tests (no window program: alloc only) ---
+
+def _tiny_hosts():
+    from shadow_tpu.engine.state import alloc_hosts
+    return alloc_hosts(EngineConfig(num_hosts=2, qcap=4, scap=2,
+                                    obcap=4, incap=8))
+
+
+def test_store_atomicity_kill_mid_save(tmp_path):
+    """A kill mid-save leaves only a .tmp (os.replace never ran):
+    `latest` still resolves to the prior good snapshot, and the stray
+    temp neither resolves nor survives the next save's prune."""
+    from shadow_tpu.engine import checkpoint as ck
+    hosts = _tiny_hosts()
+    store = ck.CheckpointStore(str(tmp_path / "ck.npz"), keep=3)
+    good = store.save(hosts, 100, 200, 1, "fp")
+    # simulate the torn write a SIGKILL inside save() leaves behind
+    torn = str(tmp_path / "ck.w0000000099.npz.tmp")
+    with open(torn, "wb") as f:
+        f.write(b"\x50\x4b\x03\x04 truncated npz")
+    assert ck.resolve_latest(str(tmp_path / "ck.npz")) == good
+    snap = ck.load(str(tmp_path / "ck"), hosts, "fp")
+    assert (snap.wstart, snap.windows) == (100, 1)
+    store.save(hosts, 300, 400, 2, "fp")
+    assert not os.path.exists(torn)      # prune collected the stray
+
+
+def test_store_corrupt_head_falls_back(tmp_path, capsys):
+    """A corrupted newest snapshot (hash mismatch) is skipped LOUDLY
+    and resume falls back to the previous good one."""
+    from shadow_tpu.engine import checkpoint as ck
+    hosts = _tiny_hosts()
+    store = ck.CheckpointStore(str(tmp_path / "ck"), keep=3)
+    prev = store.save(hosts, 100, 200, 1, "fp")
+    head = store.save(hosts, 300, 400, 2, "fp")
+    with open(head, "r+b") as f:
+        f.truncate(64)
+    assert ck.resolve_latest(str(tmp_path / "ck")) == prev
+    snap = ck.load(str(tmp_path / "ck"), hosts, "fp")
+    assert snap.wstart == 100
+    err = capsys.readouterr().err
+    assert "content hash" in err and "falling back" in err
+
+
+def test_store_retention(tmp_path):
+    from shadow_tpu.engine import checkpoint as ck
+    hosts = _tiny_hosts()
+    store = ck.CheckpointStore(str(tmp_path / "ck"), keep=2)
+    paths = [store.save(hosts, 100 * i, 0, i, "fp")
+             for i in range(1, 4)]
+    assert not os.path.exists(paths[0])
+    assert os.path.exists(paths[1]) and os.path.exists(paths[2])
+    assert ck.resolve_latest(str(tmp_path / "ck")) == paths[2]
+
+
+def test_store_hosted_sidecar_verified(tmp_path):
+    """The npz stamps its hosted sidecar's sha (__hosted_sha__): a
+    snapshot whose .hosted is corrupted — or deleted, the state a
+    kill between sidecar and npz publication can never leave but
+    bit-rot can — fails verification and resolve_latest falls back
+    to the previous good snapshot instead of letting a hosted resume
+    crash-loop on it."""
+    from shadow_tpu.engine import checkpoint as ck
+    hosts = _tiny_hosts()
+    store = ck.CheckpointStore(str(tmp_path / "ck"), keep=3)
+    prev = store.save(hosts, 100, 200, 1, "fp", hosted_blob=b"ok-1")
+    head = store.save(hosts, 300, 400, 2, "fp", hosted_blob=b"ok-2")
+    with open(head + ".hosted", "wb") as f:
+        f.write(b"corrupted")
+    assert ck.resolve_latest(str(tmp_path / "ck")) == prev
+    os.unlink(head + ".hosted")
+    assert ck.resolve_latest(str(tmp_path / "ck")) == prev
+    snap = ck.load(str(tmp_path / "ck"), hosts, "fp")
+    assert snap.wstart == 100 and snap.hosted_blob == b"ok-1"
+    # a save without hosted state scrubs any stale sidecar of the
+    # same snapshot name and verifies clean
+    os.unlink(head)
+    again = store.save(hosts, 300, 400, 2, "fp")
+    assert again == head and not os.path.exists(head + ".hosted")
+    assert ck.resolve_latest(str(tmp_path / "ck")) == head
+
+
+def test_load_truncated_snapshot_is_diagnosed(tmp_path):
+    """A truncated .npz passed DIRECTLY (no sidecar, no store) must
+    fail with a clear 'unreadable or truncated' error, not a raw
+    zipfile traceback."""
+    from shadow_tpu.engine import checkpoint as ck
+    hosts = _tiny_hosts()
+    store = ck.CheckpointStore(str(tmp_path / "ck"), keep=3)
+    f = store.save(hosts, 100, 200, 1, "fp")
+    os.unlink(f + ".sha256")             # direct load path, unverified
+    with open(f, "r+b") as fh:
+        fh.truncate(128)
+    with pytest.raises(ValueError, match="unreadable or truncated"):
+        ck.load(f, hosts, "fp")
+
+
+def test_shape_mismatch_always_hard_error(tmp_path):
+    """The layout check precedes the fingerprint check: even with
+    strict=False (resume_unchecked), a snapshot from a different
+    engine shape errors with BOTH shapes in the message — never a
+    softened warning."""
+    from shadow_tpu.engine import checkpoint as ck
+    from shadow_tpu.engine.state import alloc_hosts
+    hosts = _tiny_hosts()
+    store = ck.CheckpointStore(str(tmp_path / "ck"), keep=3)
+    f = store.save(hosts, 100, 200, 1, "fp")
+    other = alloc_hosts(EngineConfig(num_hosts=2, qcap=8, scap=2,
+                                     obcap=4, incap=8))
+    with pytest.raises(ValueError) as ei:
+        ck.load(f, other, "DIFFERENT-FP", strict=False)
+    msg = str(ei.value)
+    assert "layout mismatch" in msg
+    assert "(2, 4" in msg and "(2, 8" in msg    # both shapes named
 
 
 def test_cli_test_scenario_smoke(capsys):

@@ -34,6 +34,10 @@ def _free_port():
 
 
 def test_two_process_mesh_matches_single(tmp_path):
+    """Stats AND the determinism digest chain: the 2-process mesh run
+    must be bit-identical to the single-process run, record for
+    record (the chain is recorded via the per-record allgather,
+    process 0 writing — the lifted digest+multi-process gate)."""
     sys.path.insert(0, str(HELPERS))
     try:
         from scenario_phold import make_scenario, make_cfg
@@ -43,14 +47,22 @@ def test_two_process_mesh_matches_single(tmp_path):
 
     # ground truth: single-process run (virtual 8-device CPU already
     # configured by conftest; mesh=None = single chip)
-    truth = Simulation(make_scenario(), engine_cfg=make_cfg()).run()
+    dg_single = str(tmp_path / "dg_single.jsonl")
+    truth = Simulation(make_scenario(), engine_cfg=make_cfg()).run(
+        digest=dg_single, digest_every=8)
     assert truth.events > 0
 
     out = tmp_path / "stats.npy"
-    _spawn_workers(out, [], "fresh")
+    dg_multi = str(tmp_path / "dg_multi.jsonl")
+    _spawn_workers(out, ["--digest", dg_multi], "fresh")
     stats = np.load(out)
     assert np.array_equal(stats, truth.stats), (
         "multi-process stats diverge from single-process run")
+    a = Path(dg_single).read_bytes()
+    b = Path(dg_multi).read_bytes()
+    assert a and a == b, (
+        "2-process digest chain differs from the single-process "
+        "chain — run tools/divergence.py on the two files")
 
 
 def _spawn_workers(out, extra, tag):
@@ -130,7 +142,8 @@ def test_multiprocess_checkpoint_resume(tmp_path):
     ckpt = str(tmp_path / "snap.npz")
     out_a = tmp_path / "stats_a.npy"
     _spawn_workers(out_a, ["--ckpt", ckpt], "checkpointing")
-    assert os.path.exists(ckpt), "process 0 never wrote the snapshot"
+    from shadow_tpu.engine.checkpoint import resolve_latest
+    assert resolve_latest(ckpt), "process 0 never wrote a snapshot"
     stats_a = np.load(out_a)
     assert np.array_equal(stats_a, truth.stats)
 

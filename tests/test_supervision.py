@@ -211,6 +211,62 @@ def test_acceptance_robustness_scenario(tmp_path):
     assert r1.stats[0, defs.ST_FAULTS] == 2
 
 
+def test_hosted_checkpoint_resume_replay(tmp_path):
+    """Hosted resume in-process (the subprocess SIGKILL variant lives
+    in tests/test_until_complete.py): a checkpointed hosted run's
+    mid-run snapshot — pickled hosting tier + protocol journal — is
+    restored into a FRESH Simulation, the child is respawned and
+    fast-forwarded by journal replay, and the completed digest chain
+    is byte-identical to an uninterrupted run's."""
+    import numpy as np
+    script = _write(tmp_path, "slow.py", SLOW_UPLOADER_SRC)
+
+    dg_a = str(tmp_path / "a.jsonl")
+    out_a = str(tmp_path / "a.out")
+    Simulation(hosted_scenario(script, out_a, stop_s=26),
+               engine_cfg=EngineConfig(**ENGINE_CFG)).run(
+        digest=dg_a, digest_every=8)
+    assert "done" in open(out_a).read()
+
+    out_b = str(tmp_path / "b.out")
+    dg_b = str(tmp_path / "b.jsonl")
+    base = str(tmp_path / "ck")
+    scen_b = hosted_scenario(script, out_b, stop_s=26)
+    Simulation(scen_b, engine_cfg=EngineConfig(**ENGINE_CFG)).run(
+        digest=dg_b, digest_every=8, checkpoint_path=base,
+        checkpoint_every_s=2, checkpoint_keep=16)
+
+    # rewind the world to a mid-run snapshot: truncate the chain to
+    # the stamped position (as a crash just after that save would
+    # leave it) and resume a fresh Simulation from it
+    from shadow_tpu.engine import checkpoint as ck
+    snaps = sorted(ck.CheckpointStore(base).snapshots())
+    snap_path = snaps[len(snaps) // 2]
+    z = np.load(snap_path)
+    n_recs = int(z["__digest_records__"])
+    assert os.path.exists(snap_path + ".hosted"), "no hosted sidecar"
+    lines = open(dg_b).read().splitlines()
+    assert 0 < n_recs < len(lines)
+    with open(dg_b, "w") as f:
+        f.write("\n".join(lines[:n_recs]) + "\n")
+    open(out_b, "w").close()             # the crash also loses stdout
+
+    scen_c = hosted_scenario(script, out_b, stop_s=26)
+    sim_c = Simulation(scen_c, engine_cfg=EngineConfig(**ENGINE_CFG))
+    r = sim_c.run(
+        digest=dg_b, digest_every=8, resume_from=snap_path)
+    assert r.sim_time_ns == 26 * 10**9
+    # the resumed run takes no snapshots of its own: restore() must
+    # drop the replayed journals instead of buffering traffic forever
+    assert all(getattr(a, "_journal", None) is None
+               for a in sim_c.hosting.apps.values())
+    # the respawned child replayed its journal and then really
+    # finished the transfer
+    assert "done" in open(out_b).read()
+    assert open(dg_a, "rb").read() == open(dg_b, "rb").read(), (
+        "resumed hosted digest chain differs from uninterrupted run")
+
+
 def test_fopen_urandom_deterministic(tmp_path):
     """fopen("/dev/urandom") serves host-PRNG bytes (glibc fopen
     bypasses the open() interposition — ADVICE r5): same seed, same

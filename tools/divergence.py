@@ -150,26 +150,41 @@ def first_divergence(a_recs, b_recs, ma=None, mb=None):
 # --- bisection: cadence-1 replay from the manifests ----------------------
 
 def _pick_checkpoint(manifest, bound_ns):
-    """-> (path, wstart_ns) for a usable checkpoint, else None:
-    recorded in the manifest, still on disk, and saved at or before
-    the last MATCHING record (`bound_ns`) — a checkpoint inside the
-    divergence bracket already embodies the divergence, and resuming
-    from it would pin the wrong window. Manifests that record faults
-    or hosted apps never resume (the engine refuses; replay from the
-    start instead)."""
+    """-> (path, wstart_ns) for a usable checkpoint, else None: in
+    the rotating store the manifest records (or a legacy single-file
+    snapshot), content-verified, and saved at or before the last
+    MATCHING record (`bound_ns`) — a checkpoint inside the divergence
+    bracket already embodies the divergence, and resuming from it
+    would pin the wrong window. Fault-schedule runs resume fine (the
+    snapshot stamps the injector position); hosted manifests never
+    resume here (journal replay respawns real children — replay from
+    the start instead)."""
     ck = manifest.get("checkpoint_path")
-    if (not ck or not os.path.exists(ck) or bound_ns is None
-            or manifest.get("faults") or manifest.get("hosted")):
+    if not ck or bound_ns is None or manifest.get("hosted"):
         return None
     try:
         import numpy as np
-        z = np.load(ck)
-        ws = int(z["__wstart__"])
-        if ws <= int(bound_ns):
-            return ck, ws
+        from shadow_tpu.engine.checkpoint import (CheckpointStore,
+                                                  _verify)
+        if os.path.isfile(ck) and ck.endswith(".npz"):
+            cands = [ck]
+        else:
+            cands = sorted(CheckpointStore(ck).snapshots(),
+                           reverse=True)
+        best = None
+        for c in cands:
+            if not _verify(c):
+                continue
+            try:
+                with np.load(c) as z:
+                    ws = int(z["__wstart__"])
+            except Exception:
+                continue
+            if ws <= int(bound_ns) and (best is None or ws > best[1]):
+                best = (c, ws)
+        return best
     except Exception:
         return None
-    return None
 
 
 def replay_digest(manifest, stop_ns, out_path, resume=None):
@@ -216,14 +231,16 @@ def replay_digest(manifest, stop_ns, out_path, resume=None):
         cfg = dataclasses.replace(cfg,
                                   cc_kind=int(tcp["cc_kind"]))
         sim.cfg = cfg
-    if resume is not None and (sim.injector is not None
-                               or sim.hosting is not None):
-        resume = None  # the engine refuses resume with faults/hosting
+    if resume is not None and sim.hosting is not None:
+        resume = None  # hosted replay respawns real children; bisect
+        #                replays from the start instead (fault-schedule
+        #                resume is supported: the snapshot stamps the
+        #                injector's position)
     if resume:
         print(f"divergence: replaying from checkpoint {resume}",
               file=sys.stderr)
     sim.run(digest=out_path, digest_every=1, resume_from=resume,
-            resume_unchecked=True)
+            resume_unchecked=True, digest_rewind=False)
 
 
 def bisect(ma, mb, div, workdir, use_checkpoint=False):
