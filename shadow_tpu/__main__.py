@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -149,6 +150,16 @@ def main(argv=None):
     p.add_argument("--metrics", default=None, metavar="FILE",
                    help="write a metrics snapshot to FILE and "
                         "per-chunk JSON lines to FILE.chunks.jsonl")
+    p.add_argument("--perf", nargs="?", const="", default=None,
+                   metavar="LEDGER",
+                   help="per-phase wall attribution + perf ledger: "
+                        "collect spans in memory (no --trace file "
+                        "needed), print the phase report after the "
+                        "run, and append one entry to the perf "
+                        "ledger (default perf/ledger.jsonl; pass a "
+                        "path to override; SHADOW_TPU_LEDGER=off "
+                        "disables appends). Host-side only — digest "
+                        "chains are unchanged (docs/performance.md)")
     p.add_argument("--digest", default=None, metavar="FILE",
                    help="append a determinism digest chain to FILE "
                         "(one JSON line of per-section state hashes "
@@ -378,6 +389,17 @@ def main(argv=None):
         from .parallel.shard import make_mesh
         mesh = make_mesh(args.workers)
 
+    # --perf: install the span recorder ourselves (in-memory when no
+    # --trace path was given) so the phase attribution + ledger append
+    # below can read the retired tracer — run() sees it installed and
+    # leaves the lifecycle to us (the bench.py outer-harness pattern)
+    own_perf_tr = False
+    if args.perf is not None:
+        from .obs import trace as TR
+        if not TR.ENABLED:
+            TR.install(args.trace)
+            own_perf_tr = True
+
     # the digest context records the CLI invocation in the manifest —
     # the replay context tools/divergence.py --bisect needs
     dg_ctx = ({"argv": list(argv) if argv is not None else sys.argv[1:],
@@ -390,11 +412,49 @@ def main(argv=None):
                      checkpoint_every_s=args.checkpoint_every,
                      checkpoint_keep=args.checkpoint_keep,
                      resume_from=args.resume, pcap_dir=args.pcap_dir,
-                     trace=args.trace, metrics=args.metrics,
+                     trace=None if own_perf_tr else args.trace,
+                     metrics=args.metrics,
                      digest=args.digest,
                      digest_every=args.digest_every,
                      digest_context=dg_ctx)
     s = report.summary()
+    if own_perf_tr:
+        # phase attribution + ledger append (obs.perf / obs.ledger):
+        # the retired tracer's spans name where the wall went; the
+        # ledger line extends this scenario's durable trajectory
+        # (tools/perf_regress.py gates on it)
+        from .obs import ledger as LG
+        from .obs import perf as PF
+        from .obs import trace as TR
+        import jax
+        tr = TR.finish()
+        att = PF.attribute(tr.events, report.wall_seconds,
+                           report.events)
+        print(PF.format_report(att))
+        if args.resume:
+            # a resumed run's events span the WHOLE run (restored
+            # stats) but its wall covers only the tail — the rate is
+            # inflated and would poison the gated trajectory. The
+            # phase table above is still the point of --perf here.
+            logger.message(report.sim_time_ns, "main",
+                           "perf ledger: skipping append for a "
+                           "resumed run (tail-only wall would "
+                           "inflate the rate)")
+        scen_label = ("test" if args.test else
+                      os.path.splitext(
+                          os.path.basename(args.config))[0])
+        entry = None if args.resume else LG.entry_from_report(
+            scen_label,
+            LG.fingerprint_of(sim.cfg, seed=scenario.seed,
+                              stop_ns=int(scenario.stop_time),
+                              runahead=args.runahead or "",
+                              workers=args.workers),
+            jax.default_backend(), report, att)
+        lpath = (LG.append(entry, args.perf or None)
+                 if entry is not None else None)
+        if lpath:
+            logger.message(report.sim_time_ns, "main",
+                           f"perf ledger += {lpath}")
     logger.message(report.sim_time_ns, "main",
                    f"done: {s['events']} events in {s['wall_seconds']:.2f}s "
                    f"wall ({s['events_per_sec']:.0f} ev/s, "

@@ -869,6 +869,27 @@ class Simulation:
         _pass_labels = [lbl for lbl, _ in _pl]
         _pass_sizes = [size for _, size in _pl]
         pass_acc = np.zeros(len(_pass_labels), np.int64)
+        # shard-imbalance accounting (VERDICT r5 missing #4 — the
+        # prerequisite for load-aware placement): the sharded window
+        # program returns a PER-SHARD rung mix, and per chunk one
+        # jitted reduction yields per-shard cumulative events +
+        # currently-active host counts (multiproc-safe: replicated
+        # outputs, the eager-t0 pattern above). Published as shard.*
+        # gauges -> the metrics.json `shards` section.
+        n_shards = 1 if mesh is None else cfg.num_hosts // per_chip_h
+        shard_pass_acc = (np.zeros((n_shards, len(_pass_labels)),
+                                   np.int64) if n_shards > 1 else None)
+        _shard_load = None
+        # per-shard load is a SINGLE-process feature (like the [S,NR]
+        # pass mix): on a multi-process mesh the reduction's [S]
+        # output inherits the host axis's sharding, so each process
+        # could not np.asarray it (non-addressable shards)
+        if MT.ENABLED and n_shards > 1 and jax.process_count() == 1:
+            _shard_load = jax.jit(lambda st, eqn: (
+                jnp.sum(st[:, defs.ST_EVENTS].reshape(n_shards, -1),
+                        axis=1),
+                jnp.sum((eqn < SIMTIME_MAX).reshape(n_shards, -1),
+                        axis=1, dtype=jnp.int32)))
         row_bytes = sum(
             int(np.prod(leaf.shape[1:])) * leaf.dtype.itemsize
             for leaf in jax.tree.leaves(hosts))
@@ -997,7 +1018,13 @@ class Simulation:
             hosts, wstart, wend, n, pc = step(hosts, sh_seg, wstart,
                                               wend)
             total_windows += int(n)
-            pass_acc += np.asarray(pc)
+            pc_np = np.asarray(pc)
+            if pc_np.ndim == 2:    # sharded: [n_shards, NR] rung mix
+                pass_acc += pc_np.sum(axis=0)
+                if shard_pass_acc is not None:
+                    shard_pass_acc += pc_np
+            else:
+                pass_acc += pc_np
             if first_chunk_wall is None:
                 # everything after this excludes the cold compile
                 first_chunk_wall = _time.perf_counter() - wall0
@@ -1139,6 +1166,24 @@ class Simulation:
                         wall_per_sim_second=(
                             round(chunk_wall / chunk_sim, 6)
                             if chunk_sim else None))
+                    if _shard_load is not None:
+                        # per-shard load: cumulative events + hosts
+                        # with pending work right now; the imbalance
+                        # gauge is max/mean (1.0 = perfectly balanced)
+                        ev_s, act_s = _shard_load(hosts.stats,
+                                                  hosts.eq_next)
+                        ev_s = np.asarray(ev_s)
+                        act_s = np.asarray(act_s)
+                        for si in range(n_shards):
+                            reg.gauge(f"shard.events.{si}").set(
+                                int(ev_s[si]))
+                            reg.gauge(
+                                f"shard.active_hosts.{si}").set(
+                                int(act_s[si]))
+                        mean_ev = float(ev_s.mean())
+                        reg.gauge("shard.imbalance").set(
+                            float(ev_s.max()) / mean_ev
+                            if mean_ev else 0.0)
                 chunk_i += 1
             if dg is not None and dg.due(total_windows):
                 dg_record("cadence", total_windows, min(ws, stop_ns))
@@ -1231,9 +1276,38 @@ class Simulation:
         if MT.ENABLED:
             MT.REGISTRY.gauge("engine.first_chunk_wall_s").set(
                 first_chunk_wall or 0.0)
+            if shard_pass_acc is not None and shard_pass_acc.any():
+                # per-shard pass totals + rung mix: which shard went
+                # dense while its peers rode the small rungs — the
+                # busy-shard signature load-aware placement needs
+                # (multi-process meshes return only the reduced
+                # total, so the per-shard table stays zero and is
+                # not published there)
+                reg = MT.REGISTRY
+                for si in range(n_shards):
+                    reg.gauge(f"shard.passes.{si}").set(
+                        int(shard_pass_acc[si].sum()))
+                    for lbl, npss in zip(_pass_labels,
+                                         shard_pass_acc[si]):
+                        if npss:
+                            reg.gauge(
+                                f"shard.pass_mix.{lbl}.{si}").set(
+                                int(npss))
             # summary() publishes itself into the registry (sim.*
             # gauges) — the snapshot's BENCH-diffable section
             report.summary()
+            if TR.ENABLED:
+                # phase attribution into the snapshot's `perf`
+                # section: the registry closes with this run, so a
+                # --perf/--metrics combo (where main owns the tracer
+                # and only reads it AFTER run returns) still gets the
+                # breakdown metrics.json documents. The finalize span
+                # just completed above, so the spans cover the run.
+                from ..obs import perf as _PF
+                _PF.publish(
+                    _PF.attribute(TR.TRACER.events, wall,
+                                  report.events),
+                    MT.REGISTRY)
         return report
 
 

@@ -92,10 +92,15 @@ class EngineConfig:
     #   per event. -1 (default) = AUTO: a small rung ladder sized from
     #   num_hosts, each pass picking the smallest rung that fits its
     #   ready count (engine.window.ladder_of — replaces the round-3
-    #   hand-tuned per-config constant). > 0 = one explicit rung of
-    #   that size. 0 = off (always dense). Bit-identical in every
-    #   mode: hosts only interact at window boundaries, so per-host
-    #   (time, seq) execution order is unchanged.
+    #   hand-tuned per-config constant), plus ONE window-level rung
+    #   under the quarter rule 4K <= H (engine.window.window_ladder;
+    #   round 9 tightened it from 2K <= H after the paired phold-4096
+    #   A/B showed the half-state [2048] window rung losing 1.2x to
+    #   [512] — BASELINE.md round-9 table, tools/perf_ab.py). > 0 =
+    #   one explicit rung of that size. 0 = off (always dense).
+    #   Bit-identical in every mode: hosts only interact at window
+    #   boundaries, so per-host (time, seq) execution order is
+    #   unchanged.
     exsortcap: int = 0      # exchange sort-compaction cap: the window
     #   exchange's group-by-destination argsort ran over ALL H x obcap
     #   outbox slots (240k at socks10k — measured ~110 ms/window on

@@ -264,15 +264,27 @@ def window_ladder(cfg: EngineConfig, H: int = None):
         return []
     if cfg.active_block > 0:
         return [min(cfg.active_block, H)]
-    # ONE auto rung: the largest candidate with 2K <= H. A window rung
-    # pays its gather once for the whole window and the inner drain
-    # re-compacts per pass (drain_window), so finer window rungs buy
-    # almost nothing — while every extra rung compiles another full
-    # copy of the event-handler machine (measured: the 3-rung nested
-    # build took ~29 min of XLA compile; program size, not run time,
-    # is the binding cost of extra rungs)
+    # ONE auto rung: the largest candidate with 4K <= H — the same
+    # quarter rule as the per-pass ladder (ladder_of): gathering more
+    # than a quarter of the rows costs close to a dense pass. The
+    # round-4..8 rule here was the looser 2K <= H, which at H=4096
+    # picked a [2048] rung — HALF the state gathered per window — and
+    # is the measured phold-4096 regression suspect: the round-9
+    # paired A/B (tools/perf_ab.py, BASELINE.md round-9 table;
+    # platform cpu) has active_block=512 beating the 2048-rung AUTO
+    # 1.21-1.25x in EVERY paired rep at identical pass counts, so the
+    # quarter rule now picks 512 there (same pass mix as the winning
+    # variant). At the at-scale shapes nothing changes: H >= 8192
+    # still selects the 2048 rung socks10k/tor50k were measured with.
+    # Only one rung either way: a window rung pays its gather once
+    # for the whole window and the inner drain re-compacts per pass
+    # (drain_window), so finer window rungs buy almost nothing —
+    # while every extra rung compiles another full copy of the
+    # event-handler machine (measured: the 3-rung nested build took
+    # ~29 min of XLA compile; program size, not run time, is the
+    # binding cost of extra rungs)
     for k in (2048, 512):
-        if 2 * k <= H:
+        if 4 * k <= H:
             return [k]
     return []
 

@@ -1,3 +1,4 @@
 """obs subpackage: trace (span timeline), metrics (registry),
+perf (phase attribution), ledger (durable perf trajectory),
 tracker (heartbeats), pcap (capture), logger (text log) — see
 README.md in this directory for roles, usage and overhead notes."""

@@ -1,0 +1,207 @@
+"""Durable perf ledger: an append-only JSONL trajectory of measured
+throughput, keyed by scenario x config-fingerprint x platform x git
+rev.
+
+The round-5 verdict's finding was not that perf regressed — it was
+that perf regressed TWO ROUNDS EARLIER and nothing noticed: bench
+numbers lived in per-round BENCH_r{N}.json artifacts nobody diffed
+mechanically. The ledger is the durable, machine-checkable record:
+every bench line, every ``--perf`` run and every A/B variant appends
+one line here, and ``tools/perf_regress.py`` compares the newest
+entry of each (scenario, platform, fingerprint) group against its
+own history with a noise band — so "phold fell 83k -> 34k" becomes
+an exit-1 event in the round it happens, not an archaeology finding
+two rounds later.
+
+Keying rules (docs/performance.md):
+
+- entries are only ever compared within the same ``platform``
+  (``jax.default_backend()``): this repo's dev container is CPU-only
+  while the bench box has the accelerator, and a cross-platform
+  "regression" is noise by construction (BASELINE.md protocol);
+- ``fingerprint`` hashes the engine config + scenario shape, so a
+  deliberate config change starts a NEW trajectory instead of
+  tripping the gate;
+- ``git_rev`` is recorded for audit, never used for grouping.
+
+The file format is one JSON object per line, append-only (the same
+crash-tolerant shape as the digest chain and metrics chunk stream: a
+torn final line is detectable and skippable). Default location:
+``perf/ledger.jsonl`` at the repo root, committed so the trajectory
+survives across rounds; ``SHADOW_TPU_LEDGER`` overrides the path
+(set it to ``off`` to disable appends entirely).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import sys
+import time
+
+FORMAT = "shadow_tpu.perf.ledger"
+VERSION = 1
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_path() -> str | None:
+    """The ledger path appends resolve to: SHADOW_TPU_LEDGER if set
+    (the literal ``off``/``0``/empty disables appends -> None), else
+    ``perf/ledger.jsonl`` at the repo root."""
+    env = os.environ.get("SHADOW_TPU_LEDGER")
+    if env is not None:
+        if env.strip().lower() in ("", "0", "off", "none"):
+            return None
+        return env
+    return os.path.join(_REPO_ROOT, "perf", "ledger.jsonl")
+
+
+def fingerprint_of(cfg=None, **extra) -> str:
+    """Stable 16-hex fingerprint of an EngineConfig (or any dict) plus
+    keyword extras (seed, runahead, scenario knobs...) — the ledger's
+    "same config" key. Key order never matters; any value change
+    changes the fingerprint."""
+    d = {}
+    if cfg is not None:
+        d["cfg"] = (dataclasses.asdict(cfg)
+                    if dataclasses.is_dataclass(cfg) else dict(cfg))
+    if extra:
+        d["extra"] = extra
+    blob = json.dumps(d, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def git_rev() -> str | None:
+    import subprocess
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5, cwd=_REPO_ROOT)
+        rev = out.stdout.strip()
+        return rev or None
+    except Exception:
+        return None
+
+
+def make_entry(scenario: str, fingerprint: str, platform: str,
+               summary: dict, cost: dict = None, phases: dict = None,
+               attributed_frac: float = None, note: str = None,
+               rep_rates=None, rep_spread=None, cold_wall=None,
+               warm_wall=None) -> dict:
+    """One ledger line from a run's summary (SimReport.summary()) and
+    cost model (SimReport.cost_model()). `phases` is the per-phase
+    wall map from obs.perf (``{phase: wall_s}``)."""
+    warm_eps = None
+    if warm_wall and summary.get("events"):
+        # warm throughput excludes the cold compile — the number the
+        # regression gate prefers (compile time varies with cache
+        # state; steady-state throughput is the real trajectory)
+        warm_eps = round(summary["events"] / warm_wall, 1)
+    e = {
+        "format": FORMAT, "version": VERSION,
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "scenario": scenario,
+        "fingerprint": fingerprint,
+        "platform": platform,
+        "git_rev": git_rev(),
+        "events": int(summary.get("events", 0)),
+        "sim_seconds": summary.get("sim_seconds"),
+        "windows": summary.get("windows"),
+        "wall_seconds": round(summary.get("wall_seconds", 0.0), 3),
+        "cold_wall": cold_wall,
+        "warm_wall": warm_wall,
+        "events_per_sec": round(summary.get("events_per_sec", 0.0), 1),
+        "warm_events_per_sec": warm_eps,
+    }
+    if rep_rates:
+        e["rep_rates"] = list(rep_rates)
+    if rep_spread is not None:
+        e["rep_spread"] = rep_spread
+    if cost:
+        e["roofline_frac"] = round(cost.get("roofline_frac", 0.0), 5)
+        e["passes_per_window"] = round(
+            cost.get("passes_per_window", 0.0), 3)
+    if phases:
+        e["phases"] = {k: round(v, 4) for k, v in phases.items()}
+    if attributed_frac is not None:
+        e["attributed_frac"] = attributed_frac
+    if note:
+        e["note"] = note
+    return e
+
+
+def entry_from_report(scenario: str, fingerprint: str, platform: str,
+                      report, attribution: dict = None, **kw) -> dict:
+    """One ledger line straight from a SimReport (+ optional obs.perf
+    attribution) — the shared construction behind the CLI's ``--perf``
+    and ``tools/perf_report.py --ledger``, so the cold/warm split and
+    the phase map are derived in exactly one place."""
+    warm = report.cost.get("warm_wall")
+    phases = attributed = None
+    if attribution is not None:
+        phases = {p: r["wall_s"]
+                  for p, r in attribution["phases"].items()}
+        attributed = attribution["attributed_frac"]
+    return make_entry(
+        scenario=scenario, fingerprint=fingerprint, platform=platform,
+        summary=report.summary(), cost=report.cost_model(),
+        phases=phases, attributed_frac=attributed,
+        cold_wall=round(report.wall_seconds - (warm or 0), 3),
+        warm_wall=round(warm, 3) if warm else None, **kw)
+
+
+def entry_rate(e: dict) -> float | None:
+    """The throughput figure the regression gate compares: warm
+    events/sec when the entry has a warm wall, else the cold-inclusive
+    rate (single-chunk runs have no split)."""
+    return e.get("warm_events_per_sec") or e.get("events_per_sec")
+
+
+def key_of(e: dict) -> tuple:
+    """The trajectory-grouping key: same scenario, same platform, same
+    config fingerprint — the only entries comparable as a series."""
+    return (e.get("scenario"), e.get("platform"), e.get("fingerprint"))
+
+
+def append(entry: dict, path: str = None) -> str | None:
+    """Append one entry (atomic enough: one write+flush of one line).
+    Resolves `path` through default_path(); returns the path written,
+    or None when the ledger is disabled."""
+    if path is None:
+        path = default_path()
+    if path is None:
+        return None
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+        f.flush()
+    return path
+
+
+def read(path: str) -> list:
+    """All well-formed entries, file order. A torn/corrupt line (a run
+    killed mid-append) is skipped with a stderr warning, never a
+    crash — the gate must keep working on a crashed round's ledger."""
+    out = []
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except json.JSONDecodeError:
+                sys.stderr.write(
+                    f"ledger: {path}:{i}: skipping malformed line "
+                    "(torn append?)\n")
+                continue
+            if isinstance(e, dict):
+                out.append(e)
+    return out

@@ -182,6 +182,15 @@ class Registry:
                            "shim.violations")}
         faults = {k[len("fault."):]: v for k, v in counters.items()
                   if k.startswith("fault.")}
+        # perf views (engine.sim / obs.perf): the per-shard load tables
+        # + imbalance gauge of a mesh run, and the per-phase wall
+        # attribution of a --perf run — both assembled from their
+        # gauge families so metrics.json shows them as sections
+        shards = _assemble_indexed(
+            {k[len("shard."):]: v for k, v in gauges.items()
+             if k.startswith("shard.")})
+        perf = {k[len("perf."):]: v for k, v in gauges.items()
+                if k.startswith("perf.")}
         out = {"sim": sim,
                "shim": {"ops": ops, "op_latency_us": lat},
                "counters": counters, "gauges": gauges,
@@ -190,6 +199,10 @@ class Registry:
             out["shim"]["supervision"] = superv
         if faults:
             out["faults"] = faults
+        if shards:
+            out["shards"] = shards
+        if perf:
+            out["perf"] = perf
         return out
 
     def close(self):
@@ -204,6 +217,27 @@ class Registry:
                 json.dump(self.snapshot(), f, indent=1)
             import os
             os.replace(tmp, self.path)
+
+
+def _assemble_indexed(flat: dict) -> dict:
+    """Fold ``<name>.<int>`` gauge families into per-index lists:
+    ``{"events.0": 5, "events.1": 7, "imbalance": 1.2}`` becomes
+    ``{"events": [5, 7], "imbalance": 1.2}`` — how the per-shard
+    gauges (engine.sim's mesh-run publishing) become the snapshot's
+    ``shards`` section. Missing indices read as None (a shard that
+    never reported)."""
+    series, scalars = {}, {}
+    for k, v in flat.items():
+        base, _, idx = k.rpartition(".")
+        if base and idx.isdigit():
+            series.setdefault(base, {})[int(idx)] = v
+        else:
+            scalars[k] = v
+    out = dict(scalars)
+    for base, vals in series.items():
+        n = max(vals) + 1
+        out[base] = [vals.get(i) for i in range(n)]
+    return out
 
 
 def install(path: str = None, jsonl_path: str = None) -> Registry:

@@ -249,8 +249,13 @@ def _a2a_hop(cfg, lcfg, sortkey_l, arrival, pkts, n_shards):
             g_pkt.reshape(N2, P.PKT_WORDS), oj, cell_ok)
 
 
-def _windows_body(hosts, hp, sh, wstart, wend, cfg, lcfg, max_windows):
-    """Per-shard window loop (runs inside shard_map)."""
+def _windows_body(hosts, hp, sh, wstart, wend, cfg, lcfg, max_windows,
+                  reduce_pc=False):
+    """Per-shard window loop (runs inside shard_map). `reduce_pc`
+    psums the pass counters back to a replicated [NR] total (the
+    multi-process path: a host-sharded output would be
+    non-addressable there, and the per-shard mix is a single-process
+    observability feature)."""
 
     def next_time_global(h):
         return jax.lax.pmin(jnp.min(h.eq_next), AXIS)
@@ -295,8 +300,14 @@ def _windows_body(hosts, hp, sh, wstart, wend, cfg, lcfg, max_windows):
     hosts, ws, we, i, pc = jax.lax.while_loop(
         win_cond, win_body,
         (hosts, wstart, wend, jnp.int32(0), jnp.zeros((NR,), jnp.int64)))
-    # total passes across shards (each shard counts its own rung mix)
-    return hosts, ws, we, i, jax.lax.psum(pc, AXIS)
+    if reduce_pc:
+        return hosts, ws, we, i, jax.lax.psum(pc, AXIS)
+    # per-shard rung mix (out_specs shards it into [S, NR]): shards
+    # run the same pass COUNT in lockstep but choose rungs
+    # independently, so the per-shard mix is the load-imbalance
+    # signal — a shard stuck on dense passes while its peers ride the
+    # small rungs is the busy shard (obs.metrics `shards` section)
+    return hosts, ws, we, i, pc
 
 
 _RWS_INSTANCES = {}
@@ -306,14 +317,20 @@ def run_windows_sharded(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
                         max_windows: int, mesh: Mesh):
     """Sharded equivalent of engine.window.run_windows.
 
-    Same contract: returns (hosts, wstart', wend', windows_run,
-    pass_counts) with hosts block-sharded over the mesh's "hosts"
-    axis; pass_counts sums every shard's per-rung pass mix (shards run
-    the same pass COUNT in lockstep but may pick different rungs).
-    AOT-compiled per (cfg, max_windows, mesh) — see core.jitcache for
-    why.
+    Near-identical contract: returns (hosts, wstart', wend',
+    windows_run, pass_counts) with hosts block-sharded over the
+    mesh's "hosts" axis — except pass_counts is PER-SHARD, shape
+    [n_shards, NR] (each shard's own rung mix; ``pass_counts.sum(0)``
+    is the single-chip total). Shards run the same pass COUNT in
+    lockstep but pick rungs independently, so the per-shard mix is
+    the cross-shard load-imbalance signal the metrics layer publishes
+    (engine.sim -> obs.metrics ``shards`` section). On a
+    MULTI-PROCESS mesh pass_counts stays the replicated [NR] total
+    (sharded counters would be non-addressable). AOT-compiled per
+    (cfg, max_windows, mesh) — see core.jitcache for why.
     """
     from ..core.jitcache import AotJit
+    from ..engine.window import pass_labels
 
     n = mesh.shape[AXIS]
     assert cfg.num_hosts % n == 0, (
@@ -323,11 +340,19 @@ def run_windows_sharded(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
     key = (cfg, max_windows, mesh)
     fn = _RWS_INSTANCES.get(key)
     if fn is None:
+        # multi-process meshes keep the old replicated pass TOTAL (a
+        # host-sharded counter output would be non-addressable across
+        # processes); the per-shard mix is a single-process feature
+        multiproc = jax.process_count() > 1
         lcfg = dataclasses.replace(cfg, num_hosts=cfg.num_hosts // n)
+        NR = len(pass_labels(cfg, lcfg.num_hosts))
         body = partial(_windows_body, cfg=cfg, lcfg=lcfg,
-                       max_windows=max_windows)
+                       max_windows=max_windows, reduce_pc=multiproc)
         in_specs = (PS(AXIS), PS(AXIS), PS(), PS(), PS())
-        out_specs = (PS(AXIS), PS(), PS(), PS(), PS())
+        # pass counters come back sharded: each shard's [NR] mix
+        # concatenates to [n * NR], reshaped to [n, NR] below
+        out_specs = (PS(AXIS), PS(), PS(), PS(),
+                     PS() if multiproc else PS(AXIS))
         try:
             # the row-level engine mixes unvarying constants into
             # sharded state everywhere (e.g. `.at[slot].set(True)`),
@@ -345,7 +370,10 @@ def run_windows_sharded(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
                           out_specs=out_specs, check_rep=False)
 
         def impl(hosts, hp, sh, wstart, wend):
-            return smapped(hosts, hp, sh, wstart, wend)
+            h, ws, we, i, pc = smapped(hosts, hp, sh, wstart, wend)
+            if not multiproc:
+                pc = pc.reshape(n, NR)
+            return h, ws, we, i, pc
 
         impl.__name__ = f"run_windows_sharded_v{len(_RWS_INSTANCES)}"
         impl.__qualname__ = impl.__name__

@@ -1,0 +1,346 @@
+"""Perf-observatory tests (PR 6): ledger round-trip + fingerprint
+keying, the regression gate's exit semantics, phase attribution
+(synthetic spans and a real compiled phold run), the per-shard
+imbalance gauges on the virtual mesh, and the observability-must-not-
+perturb-determinism contract for --perf runs.
+
+Note on tier-1: this file sorts after test_parallel, past the
+compile-bound tier-1 horizon on the CPU dev container — the pure-unit
+tests up top cost milliseconds anyway; the compiled-run tests at the
+bottom are for file-by-file validation (and the CLI one is `slow`).
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+from shadow_tpu.obs import ledger as LG  # noqa: E402
+from shadow_tpu.obs import perf as PF  # noqa: E402
+from shadow_tpu.obs.metrics import Registry, _assemble_indexed  # noqa: E402
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --- ledger ---------------------------------------------------------------
+
+def _entry(scenario="phold-64", rate=100.0, platform="cpu", fp="f0",
+           warm=None, phases=None):
+    s = {"events": 1000, "wall_seconds": 1000 / rate,
+         "events_per_sec": rate, "sim_seconds": 5.0, "windows": 10}
+    return LG.make_entry(scenario, fp, platform, s, phases=phases,
+                         warm_wall=(1000 / warm if warm else None))
+
+
+def test_ledger_roundtrip(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    e1 = _entry(rate=100.0)
+    e2 = _entry(rate=105.0)
+    assert LG.append(e1, path) == path
+    LG.append(e2, path)
+    got = LG.read(path)
+    assert len(got) == 2
+    assert got[0]["events_per_sec"] == 100.0
+    assert got[1]["events_per_sec"] == 105.0
+    assert got[0]["format"] == LG.FORMAT
+    # grouping key: same scenario/platform/fingerprint -> same series
+    assert LG.key_of(got[0]) == LG.key_of(got[1])
+    # warm rate preferred by the gate when present
+    ew = _entry(rate=50.0, warm=200.0)
+    assert LG.entry_rate(ew) == ew["warm_events_per_sec"]
+    assert LG.entry_rate(e1) == 100.0
+
+
+def test_ledger_skips_torn_line(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    LG.append(_entry(), path)
+    with open(path, "a") as f:
+        f.write('{"format": "shadow_tpu.perf.led')  # torn append
+    got = LG.read(path)
+    assert len(got) == 1  # torn line skipped, not a crash
+
+
+def test_fingerprint_keying():
+    from shadow_tpu.engine.state import EngineConfig
+    a = EngineConfig(num_hosts=64, qcap=16)
+    b = EngineConfig(num_hosts=64, qcap=32)
+    assert LG.fingerprint_of(a) != LG.fingerprint_of(b)
+    assert LG.fingerprint_of(a) == LG.fingerprint_of(
+        EngineConfig(num_hosts=64, qcap=16))
+    # extras change the key; kwarg order does not
+    assert (LG.fingerprint_of(a, stop=10, runahead=5) ==
+            LG.fingerprint_of(a, runahead=5, stop=10))
+    assert (LG.fingerprint_of(a, stop=10) !=
+            LG.fingerprint_of(a, stop=20))
+
+
+def test_ledger_disabled_by_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("SHADOW_TPU_LEDGER", "off")
+    assert LG.default_path() is None
+    assert LG.append(_entry()) is None
+    monkeypatch.setenv("SHADOW_TPU_LEDGER", str(tmp_path / "l.jsonl"))
+    assert LG.append(_entry()) == str(tmp_path / "l.jsonl")
+
+
+# --- phase attribution (synthetic) ----------------------------------------
+
+def _ev(name, ts_ms, dur_ms):
+    return {"name": name, "ph": "X", "pid": 1, "tid": 0,
+            "ts": ts_ms * 1000.0, "dur": dur_ms * 1000.0}
+
+
+def test_attribute_nested_self_time():
+    # a 500ms chunk containing a 100ms heartbeat: window self = 400ms
+    events = [_ev("chunk", 0, 500), _ev("tracker.heartbeat", 200, 100)]
+    att = PF.attribute(events, 0.5, n_events=100)
+    assert abs(att["phases"]["window"]["wall_s"] - 0.4) < 1e-9
+    assert abs(att["phases"]["tracker"]["wall_s"] - 0.1) < 1e-9
+    assert att["attributed_frac"] == 1.0 and att["ok"]
+    assert att["phases"]["window"]["us_per_event"] == pytest.approx(
+        4000.0)
+
+
+def test_attribute_residual_flagged():
+    att = PF.attribute([_ev("chunk", 0, 100)], 1.0)
+    assert not att["ok"]
+    assert att["residual_frac"] == pytest.approx(0.9)
+    assert att["residual_label"]  # explicit, never a silent gap
+    # unknown spans attribute under their own name, never dropped
+    att2 = PF.attribute([_ev("surprise", 0, 950)], 1.0)
+    assert att2["ok"] and "surprise" in att2["phases"]
+
+
+# --- regression gate ------------------------------------------------------
+
+def _regress(tmp_path, rates, band=0.15, **kw):
+    pr = _load_tool("perf_regress")
+    path = str(tmp_path / "l.jsonl")
+    for r in rates:
+        LG.append(_entry(rate=r, **kw), path)
+    return pr.main([path, "--band", str(band)])
+
+
+def test_regress_exit0_on_flat_trajectory(tmp_path):
+    assert _regress(tmp_path, [100, 102, 98, 101]) == 0
+
+
+def test_regress_exit1_on_synthetic_regression(tmp_path):
+    assert _regress(tmp_path, [100, 102, 98, 50]) == 1
+
+
+def test_regress_band_widen_with_noisy_history(tmp_path):
+    # history wobbles 40%: a 25% dip must NOT gate at the 15% band
+    assert _regress(tmp_path, [80, 120, 100, 75]) == 0
+
+
+def test_regress_insufficient_history(tmp_path):
+    assert _regress(tmp_path, [100]) == 0  # nothing to compare yet
+
+
+def test_regress_zero_rate_candidate_fails(tmp_path):
+    """A scenario collapsing to zero events/sec against real history
+    is the most extreme regression — it must exit 1, never be
+    misfiled as insufficient history."""
+    pr = _load_tool("perf_regress")
+    path = str(tmp_path / "l.jsonl")
+    for r in (100, 102, 98):
+        LG.append(_entry(rate=r), path)
+    e = _entry(rate=1.0)
+    e["events_per_sec"] = 0.0
+    LG.append(e, path)
+    results, reg = pr.check(LG.read(path))
+    assert reg and results[0]["status"] == "REGRESSION"
+    assert pr.main([path]) == 1
+
+
+def test_regress_platform_and_fingerprint_split(tmp_path):
+    pr = _load_tool("perf_regress")
+    path = str(tmp_path / "l.jsonl")
+    # cpu history at 100, a "tpu" entry at 10: different platform,
+    # different trajectory — never compared
+    LG.append(_entry(rate=100.0, platform="cpu"), path)
+    LG.append(_entry(rate=101.0, platform="cpu"), path)
+    LG.append(_entry(rate=10.0, platform="tpu"), path)
+    assert pr.main([path]) == 0
+    # same platform but a config change (new fingerprint): new series
+    LG.append(_entry(rate=10.0, platform="cpu", fp="f-new"), path)
+    assert pr.main([path]) == 0
+    # an actual same-key regression still fires
+    LG.append(_entry(rate=10.0, platform="cpu"), path)
+    assert pr.main([path]) == 1
+
+
+def test_regress_compile_bound_not_gated(tmp_path):
+    """A no-warm-split entry whose own phase breakdown says the XLA
+    compile dominated its wall carries no throughput signal — its
+    cold-inclusive rate is compile-cache state (a 5 sim-s phold on
+    the CPU container is 99.9% compile). Reported, never gated, and
+    never counted into another candidate's history median."""
+    pr = _load_tool("perf_regress")
+    path = str(tmp_path / "l.jsonl")
+
+    def cb(rate):  # wall = 1000/rate, compile = 99% of it
+        return _entry(rate=rate,
+                      phases={"compile": 0.99 * 1000 / rate,
+                              "window": 0.005 * 1000 / rate})
+
+    # a 40% "drop" across compile-bound entries: cache state, exit 0
+    for r in (100.0, 95.0, 60.0):
+        LG.append(cb(r), path)
+    results, reg = pr.check(LG.read(path))
+    assert not reg
+    assert results[0]["status"] == "compile-bound"
+    assert pr.main([path]) == 0
+    # compile-bound history is excluded from a REAL candidate's
+    # median: two warm entries at ~100 gate the 50-rate candidate
+    # against 100, not against the compile-bound 60
+    LG.append(_entry(rate=30.0, warm=100.0), path)
+    LG.append(_entry(rate=30.0, warm=101.0), path)
+    LG.append(_entry(rate=30.0, warm=50.0), path)
+    assert pr.main([path]) == 1
+    # a warm split always wins over the phase heuristic
+    assert not pr.compile_bound(_entry(rate=30.0, warm=100.0))
+
+
+def test_regress_candidate_mode(tmp_path):
+    pr = _load_tool("perf_regress")
+    path = str(tmp_path / "l.jsonl")
+    for r in (100, 102, 98):
+        LG.append(_entry(rate=r), path)
+    cand = tmp_path / "cand.json"
+    cand.write_text(json.dumps(_entry(rate=40.0)))
+    assert pr.main([path, "--candidate", str(cand)]) == 1
+    cand.write_text(json.dumps(_entry(rate=99.0)))
+    assert pr.main([path, "--candidate", str(cand)]) == 0
+
+
+# --- metrics shard assembly ----------------------------------------------
+
+def test_metrics_shard_section_assembly():
+    assert _assemble_indexed(
+        {"events.0": 5, "events.1": 7, "imbalance": 1.2}) == {
+        "events": [5, 7], "imbalance": 1.2}
+    r = Registry()
+    r.gauge("shard.events.0").set(3)
+    r.gauge("shard.events.2").set(9)  # sparse: missing index -> None
+    r.gauge("shard.imbalance").set(1.5)
+    r.gauge("perf.attributed_frac").set(0.97)
+    snap = r.snapshot()
+    assert snap["shards"]["events"] == [3, None, 9]
+    assert snap["shards"]["imbalance"] == 1.5
+    assert snap["perf"]["attributed_frac"] == 0.97
+
+
+def test_perf_publish_gauges():
+    att = PF.attribute([_ev("chunk", 0, 900)], 1.0, n_events=10)
+    r = Registry()
+    PF.publish(att, r)
+    snap = r.snapshot()
+    assert snap["perf"]["phase.window_s"] == pytest.approx(0.9)
+    assert snap["perf"]["attributed_frac"] == pytest.approx(0.9)
+
+
+# --- compiled-run coverage (file-by-file validation tier) -----------------
+
+def test_phase_attribution_on_phold_run():
+    """Acceptance: a real run's spans attribute >= 90% of its wall."""
+    from shadow_tpu.engine.sim import Simulation
+    from shadow_tpu.obs import trace as TR
+    from test_phold import phold_scenario
+
+    TR.install(None)
+    try:
+        report = Simulation(phold_scenario(n=16, stop=5)).run()
+    finally:
+        tr = TR.finish()
+    att = PF.attribute(tr.events, report.wall_seconds, report.events)
+    assert att["ok"], f"attribution below the 90% floor: {att}"
+    assert "window" in att["phases"]
+    assert "compile" in att["phases"]
+    # per-event cost present and sane
+    assert att["phases"]["window"]["us_per_event"] > 0
+
+
+def test_shard_imbalance_gauges_on_mesh(tmp_path):
+    """Acceptance: per-shard load + imbalance visible in metrics.json
+    on a mesh run (VERDICT r5 missing #4)."""
+    from shadow_tpu.engine.sim import Simulation
+    from shadow_tpu.parallel.shard import make_mesh
+    from test_phold import phold_scenario
+
+    mpath = str(tmp_path / "metrics.json")
+    report = Simulation(phold_scenario(n=16, stop=5)).run(
+        mesh=make_mesh(8), metrics=mpath)
+    assert report.events > 0
+    m = json.load(open(mpath))
+    sh = m.get("shards")
+    assert sh, "mesh run must publish the shards section"
+    assert len(sh["events"]) == 8
+    assert sum(e or 0 for e in sh["events"]) == report.events
+    assert sh["imbalance"] >= 1.0  # max/mean, 1.0 = balanced
+    assert len(sh["passes"]) == 8
+    # per-shard rung mix sums to the global pass total
+    mix_total = sum(
+        sum(v or 0 for v in vals) for k, vals in sh.items()
+        if k.startswith("pass_mix."))
+    assert mix_total == sum(sh["passes"])
+
+
+def test_perf_observation_does_not_perturb_digest(tmp_path):
+    """Acceptance: observing a run (--perf's in-memory tracer +
+    metrics) must not change a single simulated bit — the digest
+    chain of an observed run equals an unobserved run's."""
+    from shadow_tpu.engine.sim import Simulation
+    from shadow_tpu.obs import trace as TR
+    from test_phold import phold_scenario
+
+    plain = str(tmp_path / "plain.jsonl")
+    observed = str(tmp_path / "observed.jsonl")
+    Simulation(phold_scenario(n=16, stop=5)).run(digest=plain)
+    TR.install(None)
+    try:
+        Simulation(phold_scenario(n=16, stop=5)).run(
+            digest=observed,
+            metrics=str(tmp_path / "m.json"))
+    finally:
+        TR.finish()
+    assert (open(plain, "rb").read() == open(observed, "rb").read()), \
+        "observation perturbed the digest chain"
+
+
+@pytest.mark.slow
+def test_perf_cli_dual_run_ledger(tmp_path):
+    """The end-to-end CLI contract: two same-seed --perf runs produce
+    byte-identical digest chains AND two ledger entries under one
+    (scenario, platform, fingerprint) key."""
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="",
+               JAX_PLATFORMS="cpu")
+    led = str(tmp_path / "ledger.jsonl")
+    chains = []
+    for tag in ("a", "b"):
+        dg = str(tmp_path / f"{tag}.jsonl")
+        r = subprocess.run(
+            [sys.executable, "-m", "shadow_tpu", "examples/ping.xml",
+             "--stop-time", "5s", "--perf", led, "--digest", dg],
+            cwd=str(REPO), env=env, capture_output=True, text=True,
+            timeout=600)
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "perf: phase attribution" in r.stdout
+        chains.append(open(dg, "rb").read())
+    assert chains[0] == chains[1]
+    entries = LG.read(led)
+    assert len(entries) == 2
+    assert LG.key_of(entries[0]) == LG.key_of(entries[1])

@@ -41,6 +41,42 @@ def edge(a, b, lat, loss=0.0):
             f'<data key="d9">{loss}</data></edge>')
 
 
+def er_topology(n=64, p=0.1, seed=1, bw=102400, loss=0.0,
+                latency=25.0, latency_range=(5.0, 80.0)):
+    """Connected Erdős–Rényi GraphML as a string: random graph plus a
+    spanning tree (connectivity), 1ms self-loops. The LIBRARY entry
+    point — tools.baseline_configs._plab_or_fallback builds the
+    at-scale configs' stand-in topology through this when the
+    reference PlanetLab file is absent (e.g. the CPU dev container;
+    the import was previously broken because only the CLI existed).
+
+    `latency_range=None` gives every edge the fixed `latency` WITHOUT
+    consuming randomness (the CLI's --latency mode); a range — even a
+    degenerate (x, x) one — draws one uniform per edge. The
+    distinction preserves the pre-library CLI's RNG stream in both
+    modes: same seed, same edge set."""
+    rng = random.Random(seed)
+
+    def lat():
+        if latency_range is None:
+            return latency
+        return round(rng.uniform(*latency_range), 2)
+
+    lines = [HEADER]
+    for i in range(n):
+        lines.append(node(i, bw))
+    for i in range(n):
+        lines.append(edge(i, i, 1.0, 0.0))
+    for i in range(1, n):
+        lines.append(edge(rng.randrange(i), i, lat(), loss))
+    for a in range(n):
+        for b in range(a + 1, n):
+            if rng.random() < p:
+                lines.append(edge(a, b, lat(), loss))
+    lines.append("  </graph>\n</graphml>")
+    return "\n".join(lines)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("kind", choices=["single", "ring", "star", "er"])
@@ -81,19 +117,16 @@ def main():
             lines.append(edge(i, i, 1.0, 0.0))
             lines.append(edge(0, i, lat(), args.loss))
     else:  # er: random graph + spanning tree for connectivity
-        for i in range(args.n):
-            lines.append(node(i, args.bw))
-        for i in range(args.n):
-            lines.append(edge(i, i, 1.0, 0.0))
-        for i in range(1, args.n):
-            lines.append(edge(rng.randrange(i), i, lat(), args.loss))
-        for a in range(args.n):
-            for b in range(a + 1, args.n):
-                if rng.random() < args.p:
-                    lines.append(edge(a, b, lat(), args.loss))
-    lines.append("  </graph>\n</graphml>")
-
-    text = "\n".join(lines)
+        text = er_topology(n=args.n, p=args.p, seed=args.seed,
+                           bw=args.bw, loss=args.loss,
+                           latency=args.latency,
+                           latency_range=(tuple(args.latency_range)
+                                          if args.latency_range
+                                          else None))
+        lines = None
+    if lines is not None:
+        lines.append("  </graph>\n</graphml>")
+        text = "\n".join(lines)
     if args.out == "-":
         print(text)
     else:

@@ -1,0 +1,276 @@
+#!/usr/bin/env python3
+"""Generalized perf A/B: any config x any set of EngineConfig knobs,
+paired interleaved reps, median/spread significance, ledger entries
+and a BASELINE.md-ready table.
+
+Generalizes tools/phold_ab.py (which is now a thin wrapper): instead
+of a hard-coded phold variant list, A/B ANY scenario the perf tooling
+knows (phold + the baseline_configs names) across ANY set of
+EngineConfig overrides. Protocol:
+
+- one short warm-up run per variant (pays each variant's compile
+  off the clock; stop_time is a dynamic scalar so the measured run
+  reuses the program);
+- PAIRED INTERLEAVED reps — rep r runs every variant once before rep
+  r+1 starts — so machine drift (thermal, background load) lands on
+  all variants equally instead of biasing whoever ran last;
+- per variant: sorted rep rates, median, spread; the verdict vs the
+  first (baseline) variant is "significant" only when the median gap
+  exceeds the two spreads combined — single-rep deltas are not
+  evidence (round-3 verdict);
+- every variant's event count must be IDENTICAL (the compaction /
+  exchange knobs are bit-exact by contract): a mismatch is reported
+  loudly as a correctness bug, and that variant's ledger entry is
+  withheld;
+- results append to the perf ledger (scenario ``<config>+<variant>``,
+  fingerprint over the variant's full EngineConfig) and print as a
+  markdown table for BASELINE.md, stamped with the platform so
+  CPU-container numbers are never mistaken for chip numbers.
+
+Usage:
+  python tools/perf_ab.py phold --n 4096 --stop 5 --reps 3 --cpu \
+      --variant auto --variant dense:active_block=0 \
+      --variant block512:active_block=512
+  python tools/perf_ab.py socks10k --n 400 --stop 10 --cpu \
+      --runahead-ms 10 --variant auto --variant v1:exchange_a2a=0
+
+With no --variant, the phold regression-suspect set from the round-4
+investigation is used (see tools/phold_ab.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import dataclasses
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def parse_variant(spec: str):
+    """``name[:k=v[,k=v...]]`` -> (name, overrides). Values are ints
+    (EngineConfig knobs are int/bool; bools take 0/1)."""
+    name, _, kvs = spec.partition(":")
+    overrides = {}
+    if kvs:
+        for part in kvs.split(","):
+            k, eq, v = part.partition("=")
+            if not eq:
+                raise ValueError(f"variant {spec!r}: {part!r} is "
+                                 "not k=v")
+            k = k.strip()
+            val = int(v)
+            if k == "exchange_a2a":
+                val = bool(val)
+            overrides[k] = val
+    return name, overrides
+
+
+def default_suspects(n: int, obcap: int):
+    """The phold-regression suspect set (round-4 verdict item 3 /
+    ROADMAP #1): isolates the window/per-pass rung ladder, the
+    exchange sort compaction and the destination-compacted merge."""
+    return [
+        ("auto", {}),                      # the regressed r4 default
+        ("dense", {"active_block": 0}),    # all compaction off (r3)
+        ("auto_noex", {"exsortcap": n * obcap}),  # full-sort exchange
+        ("auto_nodst", {"dstcap": 1}),     # dst compaction off
+        ("block512", {"active_block": 512}),
+        ("block256", {"active_block": 256}),
+    ]
+
+
+def run_once(scen, cfg, runahead_ms):
+    from shadow_tpu.engine.sim import Simulation
+    from tools.baseline_configs import apply_runahead
+    sim = apply_runahead(Simulation(scen, engine_cfg=cfg), runahead_ms)
+    report = sim.run()
+    return report
+
+
+def measure(config, variants, n=None, stop=10, reps=3, runahead_ms=0,
+            warm_stop_s=None, seed=None, chunk=0):
+    """-> list of per-variant result dicts, baseline (first) variant
+    first, plus the shared protocol header."""
+    from tools.perf_report import build_config
+
+    scen0, base_cfg, n = build_config(config, n, stop)
+    if seed is not None:
+        scen0.seed = seed
+    if chunk:
+        base_cfg = dataclasses.replace(base_cfg, chunk_windows=chunk)
+    if warm_stop_s is None:
+        # TCP-tier programs need the connect wave inside the warm-up
+        warm_stop_s = 1.2 if config == "phold" else 2.4
+    cfgs = []
+    for name, ov in variants:
+        try:
+            cfgs.append((name, ov,
+                         dataclasses.replace(base_cfg, **ov)))
+        except TypeError as e:
+            raise SystemExit(f"perf_ab: variant {name!r}: {e}")
+
+    # warm-up: one short run per variant compiles its program
+    for name, _, cfg in cfgs:
+        warm = copy.deepcopy(scen0)
+        warm.stop_time = int(warm_stop_s * 10**9)
+        t0 = time.perf_counter()
+        run_once(warm, cfg, runahead_ms)
+        print(json.dumps({"variant": name, "warmup_wall_s":
+                          round(time.perf_counter() - t0, 1)}),
+              file=sys.stderr, flush=True)
+
+    rates = {name: [] for name, _, _ in cfgs}
+    events = {}
+    cost = {}
+    for rep in range(max(reps, 1)):
+        for name, _, cfg in cfgs:      # paired interleaved
+            report = run_once(copy.deepcopy(scen0), cfg, runahead_ms)
+            s = report.summary()
+            rates[name].append(round(s["events_per_sec"], 1))
+            events.setdefault(name, s["events"])
+            cost[name] = report.cost_model()
+            print(json.dumps({"rep": rep, "variant": name,
+                              "events_per_sec": rates[name][-1]}),
+                  file=sys.stderr, flush=True)
+
+    from statistics import median
+
+    ev0 = events[cfgs[0][0]]
+    out = []
+    for name, ov, cfg in cfgs:
+        rs = sorted(rates[name])
+        med = round(median(rs), 1)
+        spread = round(rs[-1] - rs[0], 1)
+        out.append({
+            "variant": name, "overrides": ov, "rates": rs,
+            "median": med, "spread": spread,
+            "events": events[name],
+            "events_match_baseline": events[name] == ev0,
+            "passes": cost[name].get("passes"),
+            "cfg": cfg,
+        })
+    base = out[0]
+    for row in out:
+        row["vs_baseline"] = (round(row["median"] / base["median"], 3)
+                              if base["median"] else None)
+        gap = abs(row["median"] - base["median"])
+        row["significant"] = gap > (row["spread"] + base["spread"])
+    return out, {"config": config, "hosts": n, "stop_s": stop,
+                 "reps": reps, "runahead_ms": runahead_ms,
+                 "seed": seed}
+
+
+def markdown_table(results, header, platform) -> str:
+    lines = [
+        f"A/B: {header['config']} n={header['hosts']} "
+        f"{header['stop_s']} sim-s, {header['reps']} paired "
+        f"interleaved reps, platform **{platform}**"
+        + (f", runahead {header['runahead_ms']}ms"
+           if header["runahead_ms"] else ""),
+        "",
+        "| variant | overrides | median ev/s | reps (sorted) | "
+        "spread | vs baseline | significant |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in results:
+        ov = (",".join(f"{k}={v}" for k, v in r["overrides"].items())
+              or "(default)")
+        note = "" if r["events_match_baseline"] else " **EVENTS DIFFER**"
+        lines.append(
+            f"| {r['variant']} | `{ov}` | {r['median']:,} | "
+            f"{r['rates']} | {r['spread']} | "
+            f"{r['vs_baseline']}x | "
+            f"{'yes' if r['significant'] else 'no'}{note} |")
+    return "\n".join(lines)
+
+
+def main(argv):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("config",
+                    help="phold | socks10k | tor50k | bulk1k")
+    ap.add_argument("--n", type=int, default=None)
+    ap.add_argument("--stop", type=int, default=10)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--runahead-ms", type=int, default=0)
+    ap.add_argument("--chunk", type=int, default=0)
+    ap.add_argument("--warm-stop-s", type=float, default=None)
+    ap.add_argument("--variant", action="append", default=None,
+                    metavar="NAME[:K=V,...]",
+                    help="repeatable; first is the baseline. Default: "
+                         "the phold regression-suspect set")
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--markdown", action="store_true",
+                    help="print the BASELINE.md-ready table")
+    ap.add_argument("--no-ledger", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.cpu:
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        from bench import _enable_compile_cache
+        _enable_compile_cache()
+    import jax
+
+    if args.variant:
+        variants = [parse_variant(v) for v in args.variant]
+    else:
+        from tools.perf_report import build_config
+        _, cfg0, n0 = build_config(args.config, args.n, args.stop)
+        variants = default_suspects(n0, cfg0.obcap)
+
+    results, header = measure(
+        args.config, variants, n=args.n, stop=args.stop,
+        reps=args.reps, runahead_ms=args.runahead_ms,
+        warm_stop_s=args.warm_stop_s, seed=args.seed,
+        chunk=args.chunk)
+    platform = jax.default_backend()
+
+    mismatches = [r["variant"] for r in results
+                  if not r["events_match_baseline"]]
+    if mismatches:
+        print(f"perf_ab: WARNING: variants {mismatches} executed a "
+              "DIFFERENT event count than the baseline — the knob "
+              "broke bit-equality; their ledger entries are withheld "
+              "and the table flags them", file=sys.stderr)
+
+    if not args.no_ledger:
+        from shadow_tpu.obs import ledger as LG
+        for r in results:
+            if not r["events_match_baseline"]:
+                continue
+            entry = LG.make_entry(
+                scenario=f"{header['config']}+{r['variant']}",
+                fingerprint=LG.fingerprint_of(
+                    r["cfg"], stop=header["stop_s"],
+                    runahead=header["runahead_ms"],
+                    seed=header["seed"]),
+                platform=platform,
+                summary={"events": r["events"],
+                         "events_per_sec": r["median"],
+                         "wall_seconds": (r["events"] / r["median"]
+                                          if r["median"] else 0.0)},
+                rep_rates=r["rates"], rep_spread=r["spread"],
+                note=f"perf_ab vs {results[0]['variant']}")
+            LG.append(entry)
+
+    for r in results:
+        r.pop("cfg")  # not JSON-serializable, ledger consumed it
+        print(json.dumps(r), flush=True)
+    if args.markdown:
+        print()
+        print(markdown_table(results, header, platform))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,205 @@
+#!/usr/bin/env python3
+"""Perf regression gate over the ledger (obs.ledger): the newest
+entry of every (scenario, platform, config-fingerprint) trajectory is
+compared against the median of its own history with a noise band —
+exit 1 the round a regression lands, instead of two rounds later
+("phold fell 83k -> 34k between rounds 3 and 5 and nobody noticed",
+ROADMAP #1).
+
+Policy (docs/performance.md):
+
+- the compared figure is WARM events/sec when the entry has a
+  cold/warm split (compile time varies with cache state and is not
+  the trajectory), else the cold-inclusive rate;
+- baseline = median of the history; regression when the candidate
+  falls below ``baseline * (1 - band)``;
+- the band is ``max(--band, observed history rel-spread)`` capped at
+  50%: a trajectory whose own history wobbles 25% cannot honestly
+  gate at 15% (CPU-container runs are noisy; chip runs are tight);
+- trajectories never mix platforms or fingerprints — a config change
+  or a CPU-vs-TPU comparison starts a new series by construction;
+- groups with fewer than ``--min-history + 1`` entries are reported
+  as "insufficient history", never failed — but a candidate whose
+  rate is zero/absent against REAL history is a failed comparison
+  (the most extreme regression), not insufficient history;
+- an entry with no warm split whose OWN phase breakdown says the XLA
+  compile took more than ``COMPILE_BOUND`` of its wall is
+  "compile-bound": its cold-inclusive rate measures compile-cache
+  state, not throughput (a 5 sim-s phold on the CPU container is
+  99.9% compile), so it is reported but never gated — and never
+  counted into another candidate's history median. The throughput
+  trajectory for such shapes comes from bench.py's warm-split
+  entries.
+
+Pure stdlib + the ledger module loaded by file path (no jax import:
+this gate must run headless in the verify skill on any box).
+
+Usage:
+  python tools/perf_regress.py [LEDGER] [--band 0.15] [--json]
+      [--scenario S] [--platform P] [--min-history 1]
+      [--candidate FILE]   # check one entry JSON without appending
+Exit: 0 ok / 1 regression / 2 usage or unreadable ledger.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from statistics import median
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "_perf_ledger", os.path.join(REPO, "shadow_tpu", "obs",
+                                 "ledger.py"))
+LG = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(LG)
+
+DEFAULT_BAND = 0.15
+MAX_BAND = 0.50
+# no-warm-split entries whose compile phase exceeds this fraction of
+# their wall carry no throughput signal (the rate is compile-cache
+# state): reported, never gated, never history
+COMPILE_BOUND = 0.5
+
+
+def compile_bound(e) -> bool:
+    if e.get("warm_events_per_sec"):
+        return False  # the warm rate already excludes the compile
+    wall = e.get("wall_seconds") or 0.0
+    comp = (e.get("phases") or {}).get("compile", 0.0)
+    return bool(wall) and comp / wall > COMPILE_BOUND
+
+
+def check(entries, band=DEFAULT_BAND, min_history=1, candidate=None):
+    """-> (results, any_regression). `entries` in append order;
+    `candidate` (optional) is checked against ITS key's full ledger
+    history instead of the last-vs-rest split."""
+    groups = {}
+    for e in entries:
+        groups.setdefault(LG.key_of(e), []).append(e)
+    results = []
+    any_reg = False
+    if candidate is not None:
+        keys = [LG.key_of(candidate)]
+        groups.setdefault(keys[0], [])
+    else:
+        keys = list(groups)
+    for key in keys:
+        es = groups[key]
+        if candidate is not None:
+            cand, hist = candidate, es
+        else:
+            cand, hist = es[-1], es[:-1]
+        scenario, platform, fp = key
+        row = {"scenario": scenario, "platform": platform,
+               "fingerprint": fp, "entries": len(hist) + 1}
+        cr = LG.entry_rate(cand) or 0.0
+        if compile_bound(cand):
+            row["status"] = "compile-bound"
+            row["candidate_rate"] = round(cr, 1) if cr else None
+            results.append(row)
+            continue
+        rates = [r for r in (LG.entry_rate(e) for e in hist
+                             if not compile_bound(e)) if r]
+        if len(rates) < min_history or not rates:
+            row["status"] = "insufficient-history"
+            results.append(row)
+            continue
+        # NOTE: a zero/absent candidate rate with real history falls
+        # through to the comparison and FAILS it (0 < any threshold)
+        # — a scenario collapsing to zero events is the most extreme
+        # regression, not "insufficient history"
+        base = median(rates)
+        rel_spread = ((max(rates) - min(rates)) / base
+                      if len(rates) >= 2 and base else 0.0)
+        band_eff = min(max(band, rel_spread), MAX_BAND)
+        threshold = base * (1.0 - band_eff)
+        regressed = cr < threshold
+        row.update({
+            "status": "REGRESSION" if regressed else "ok",
+            "candidate_rate": round(cr, 1),
+            "baseline_median": round(base, 1),
+            "history": [round(r, 1) for r in rates],
+            "band": round(band_eff, 3),
+            "threshold": round(threshold, 1),
+            "delta_frac": round(cr / base - 1.0, 4) if base else None,
+            "candidate_git_rev": cand.get("git_rev"),
+        })
+        any_reg = any_reg or regressed
+        results.append(row)
+    return results, any_reg
+
+
+def main(argv):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("ledger", nargs="?", default=None,
+                    help="ledger JSONL (default perf/ledger.jsonl)")
+    ap.add_argument("--band", type=float, default=DEFAULT_BAND,
+                    help="minimum relative noise band (default 0.15; "
+                         "widened to the history's own spread)")
+    ap.add_argument("--min-history", type=int, default=1,
+                    help="history entries required before gating")
+    ap.add_argument("--scenario", default=None)
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--candidate", default=None, metavar="FILE",
+                    help="check this entry JSON against the ledger "
+                         "without appending it")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    path = args.ledger or LG.default_path()
+    if path is None or not os.path.exists(path):
+        sys.stderr.write(f"perf_regress: no ledger at {path!r}\n")
+        return 2
+    entries = LG.read(path)
+    if args.scenario:
+        entries = [e for e in entries
+                   if e.get("scenario") == args.scenario]
+    if args.platform:
+        entries = [e for e in entries
+                   if e.get("platform") == args.platform]
+    candidate = None
+    if args.candidate:
+        try:
+            with open(args.candidate) as f:
+                candidate = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            sys.stderr.write(f"perf_regress: --candidate: {e}\n")
+            return 2
+    results, any_reg = check(entries, band=args.band,
+                             min_history=args.min_history,
+                             candidate=candidate)
+    if args.json:
+        print(json.dumps({"results": results,
+                          "regression": any_reg}, indent=1))
+    else:
+        for r in results:
+            if r["status"] == "insufficient-history":
+                print(f"~ {r['scenario']} [{r['platform']}] "
+                      f"{r['fingerprint']}: insufficient history "
+                      f"({r['entries']} entries)")
+            elif r["status"] == "compile-bound":
+                print(f"~ {r['scenario']} [{r['platform']}] "
+                      f"{r['fingerprint']}: compile-bound "
+                      f"(rate {r['candidate_rate']} is cache state, "
+                      "not throughput — not gated)")
+            else:
+                mark = "!!" if r["status"] == "REGRESSION" else "ok"
+                print(f"{mark} {r['scenario']} [{r['platform']}] "
+                      f"{r['fingerprint']}: {r['candidate_rate']} "
+                      f"vs median {r['baseline_median']} "
+                      f"(band {r['band'] * 100:.0f}%, "
+                      f"threshold {r['threshold']}, "
+                      f"delta {r['delta_frac'] * 100:+.1f}%)")
+        if any_reg:
+            print("PERF REGRESSION — see rows marked !! "
+                  "(docs/performance.md for the protocol)")
+    return 1 if any_reg else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,271 @@
+#!/usr/bin/env python3
+"""Unified per-phase perf report: where every wall-millisecond went.
+
+This is the one entry point the perf workflow starts from
+(docs/performance.md), unifying the three previous views:
+
+- the HOST phase attribution (obs.perf over obs.trace spans): setup /
+  compile / window chunks / hosting / tracker / pcap / checkpoint /
+  digest / faults / finalize, each with wall, fraction and per-event
+  cost — and an explicit residual when the named phases sum to less
+  than 90% of the measured wall (obs.perf.MIN_ATTRIBUTED);
+- the MODELED roofline view (SimReport.cost_model): pass mix,
+  estimated HBM traffic, roofline_frac;
+- optionally (``--device-phases``) the MEASURED device split of the
+  `window` phase — per-rung pass walls, exchange, reductions — via
+  tools/phase_profile.py's steady-state probes (the xplane decoder,
+  tools/xplane_profile.py, stays the separate deep-dive for naming
+  individual HLOs).
+
+Modes:
+  python tools/perf_report.py phold --n 1024 --stop 5 --cpu
+  python tools/perf_report.py socks10k --n 400 --stop 10 --cpu \
+      [--runahead-ms 10] [--device-phases] [--ledger [PATH]]
+  python tools/perf_report.py --trace trace.json [--wall SEC]
+  python tools/perf_report.py --self-check        # no jax, <1s
+
+Live runs append a perf-ledger entry with ``--ledger`` (obs.ledger;
+default path perf/ledger.jsonl) so ad-hoc measurements extend the
+same trajectory tools/perf_regress.py gates on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _load_stdlib_module(relpath, name):
+    """Import a pure-stdlib module from the package by FILE PATH —
+    shadow_tpu/__init__ imports jax, which the headless modes
+    (--self-check, --trace) must not pay (nor risk the ambient
+    accelerator env)."""
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, relpath))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def perf_mod():
+    return _load_stdlib_module("shadow_tpu/obs/perf.py", "_perf_attr")
+
+
+def ledger_mod():
+    return _load_stdlib_module("shadow_tpu/obs/ledger.py",
+                               "_perf_ledger")
+
+
+# --- scenario builders (shared with tools/perf_ab.py) ---------------------
+
+def build_config(config: str, n: int = None, stop: int = 10):
+    """-> (scenario, engine_cfg, n). `config` is `phold` (bench.py's
+    DES stress shape) or any tools/baseline_configs name
+    (socks10k / tor50k / bulk1k)."""
+    if config == "phold":
+        import bench
+        n = n or 4096
+        return bench._phold_scenario(n, stop), bench._phold_cfg(n), n
+    from tools.baseline_configs import CONFIGS
+    builder, capf, n_default = CONFIGS[config]
+    n = n or n_default
+    return builder(n, stop), capf(n), n
+
+
+# --- offline: attribute an existing trace file ----------------------------
+
+def report_trace(path: str, wall_s: float = None, events: int = None):
+    PF = perf_mod()
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc.get("traceEvents", doc) if isinstance(doc, dict) else doc
+    spans = [e for e in evs if e.get("ph") == "X"]
+    if not spans:
+        raise SystemExit(f"perf_report: {path}: no complete spans")
+    if wall_s is None:
+        t0 = min(e["ts"] for e in spans)
+        t1 = max(e["ts"] + e["dur"] for e in spans)
+        wall_s = (t1 - t0) / 1e6
+    if events is None:
+        events = sum(e.get("args", {}).get("events", 0)
+                     for e in spans if e["name"] == "chunk") or None
+    return PF.attribute(spans, wall_s, events)
+
+
+# --- live: run a config with the span recorder on -------------------------
+
+def report_live(config, n=None, stop=10, runahead_ms=0, chunk=0,
+                device_phases=False, seed=None):
+    import jax
+    from shadow_tpu.engine.sim import Simulation
+    from shadow_tpu.obs import perf as PF
+    from shadow_tpu.obs import trace as TR
+    from tools.baseline_configs import apply_runahead
+
+    scen, cfg, n = build_config(config, n, stop)
+    if seed is not None:
+        scen.seed = seed
+    if chunk:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, chunk_windows=chunk)
+    TR.install(None)  # collect-only: attribution needs spans, no file
+    try:
+        sim = apply_runahead(Simulation(scen, engine_cfg=cfg),
+                             runahead_ms)
+        report = sim.run()
+    finally:
+        tr = TR.finish()
+    s = report.summary()
+    att = PF.attribute(tr.events, report.wall_seconds, report.events)
+    cost = report.cost_model()
+    out = {
+        "config": config, "hosts": n, "stop_s": stop,
+        "runahead_ms": runahead_ms,
+        "platform": jax.default_backend(),
+        "events": s["events"],
+        "events_per_sec": round(s["events_per_sec"], 1),
+        "realtime_x": round(s["speedup"], 4),
+        "roofline_frac": round(cost.get("roofline_frac", 0.0), 5),
+        "passes_per_window": round(
+            cost.get("passes_per_window", 0.0), 2),
+        "attribution": att,
+    }
+    if device_phases:
+        # steady-state device split of the `window` phase (per-rung
+        # passes, exchange, reductions) — phase_profile's probes; only
+        # baseline_configs names have probe harnesses
+        if config == "phold":
+            out["device_phases"] = (
+                "unavailable for `phold` — use a baseline_configs "
+                "name (socks10k/tor50k/bulk1k)")
+        else:
+            from tools.phase_profile import profile
+            out["device_phases"] = profile(
+                config, n=n, stop=stop, runahead_ms=runahead_ms)
+    return out, report, cfg, att
+
+
+# --- self-check: the attribution math, no jax -----------------------------
+
+def self_check() -> int:
+    """Synthetic-trace check of the attribution contract: nested-span
+    self-time, phase mapping, the >=90% floor, residual labeling.
+    Wired into the verify skill next to the collect-only gate."""
+    PF = perf_mod()
+
+    def ev(name, ts_ms, dur_ms):
+        return {"name": name, "ph": "X", "pid": 1, "tid": 0,
+                "ts": ts_ms * 1000.0, "dur": dur_ms * 1000.0}
+
+    # 1.0 s wall: setup 100ms, chunk#1 500ms containing a 100ms
+    # tracker heartbeat (self 400ms), chunk#2 300ms, finalize 50ms
+    # -> attributed 950ms (95%), residual 50ms
+    events = [
+        ev("run.setup", 0, 100),
+        ev("chunk", 100, 500),
+        ev("tracker.heartbeat", 300, 100),
+        ev("chunk", 600, 300),
+        ev("report.finalize", 900, 50),
+    ]
+    att = PF.attribute(events, 1.0, n_events=1000)
+    assert att["ok"], f"95% attributed must pass the floor: {att}"
+    assert abs(att["attributed_s"] - 0.95) < 1e-9, att["attributed_s"]
+    ph = att["phases"]
+    assert abs(ph["window"]["wall_s"] - 0.7) < 1e-9, ph
+    assert abs(ph["tracker"]["wall_s"] - 0.1) < 1e-9, ph
+    assert abs(ph["setup"]["wall_s"] - 0.1) < 1e-9, ph
+    assert ph["window"]["count"] == 2
+    assert abs(ph["window"]["us_per_event"] - 700.0) < 1e-6
+    assert abs(att["residual_s"] - 0.05) < 1e-9
+    assert att["residual_label"], "residual must carry its label"
+    # under-attributed trace must flag itself, never silently pass
+    att2 = PF.attribute(events[:1], 1.0)
+    assert not att2["ok"] and att2["residual_frac"] > 0.85, att2
+    # unknown span names stay visible under their own name
+    att3 = PF.attribute([ev("mystery.phase", 0, 900)], 1.0)
+    assert "mystery.phase" in att3["phases"], att3
+    # ledger round-trip sanity rides along (same headless contract)
+    LG = ledger_mod()
+    e = LG.make_entry("selfcheck", LG.fingerprint_of(None, k=1), "cpu",
+                      {"events": 10, "wall_seconds": 1.0,
+                       "events_per_sec": 10.0})
+    assert LG.entry_rate(e) == 10.0 and LG.key_of(e)[0] == "selfcheck"
+    assert (LG.fingerprint_of(None, a=1, b=2) ==
+            LG.fingerprint_of(None, b=2, a=1))
+    assert (LG.fingerprint_of(None, a=1) != LG.fingerprint_of(None, a=2))
+    print("perf_report: self-check OK (attribution + ledger)")
+    return 0
+
+
+def main(argv):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("config", nargs="?",
+                    help="phold | socks10k | tor50k | bulk1k")
+    ap.add_argument("--n", type=int, default=None)
+    ap.add_argument("--stop", type=int, default=10)
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--runahead-ms", type=int, default=0)
+    ap.add_argument("--chunk", type=int, default=0)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--device-phases", action="store_true",
+                    help="also run phase_profile's steady-state "
+                         "probes to split the window phase on-device")
+    ap.add_argument("--ledger", nargs="?", const="", default=None,
+                    metavar="PATH",
+                    help="append a perf-ledger entry (default "
+                         "perf/ledger.jsonl)")
+    ap.add_argument("--trace", default=None, metavar="FILE",
+                    help="offline: attribute an existing Chrome "
+                         "trace instead of running")
+    ap.add_argument("--wall", type=float, default=None,
+                    help="with --trace: the run's measured wall "
+                         "(default: the trace's span extent)")
+    ap.add_argument("--events", type=int, default=None)
+    ap.add_argument("--self-check", action="store_true",
+                    help="verify the attribution math on a synthetic "
+                         "trace (no jax; the verify-skill smoke)")
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        return self_check()
+    if args.trace:
+        att = report_trace(args.trace, args.wall, args.events)
+        print(json.dumps(att, indent=1))
+        return 0 if att["ok"] else 3
+    if not args.config:
+        ap.error("provide a config, --trace FILE, or --self-check")
+
+    if args.cpu:
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        from bench import _enable_compile_cache
+        _enable_compile_cache()
+
+    out, report, cfg, att = report_live(
+        args.config, n=args.n, stop=args.stop,
+        runahead_ms=args.runahead_ms, chunk=args.chunk,
+        device_phases=args.device_phases, seed=args.seed)
+    if args.ledger is not None:
+        from shadow_tpu.obs import ledger as LG
+        entry = LG.entry_from_report(
+            args.config,
+            LG.fingerprint_of(cfg, stop=args.stop,
+                              runahead=args.runahead_ms,
+                              seed=args.seed),
+            out["platform"], report, att)
+        out["ledger"] = LG.append(entry, args.ledger or None)
+    print(json.dumps(out, indent=1))
+    return 0 if att["ok"] else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,72 +1,57 @@
-"""A/B the phold-4096 regression suspects on the real chip.
+"""A/B the phold-4096 regression suspects (now a thin wrapper).
 
 Round-4 shipped the AUTO compaction ladder as default and phold-4096
-fell 83k -> 34k ev/s (round-4 verdict item 3). Suspects:
-  (a) the window rung (window_ladder -> [2048] at H=4096) gathers half
-      the state per ~1-pass window;
-  (b) dst_cap auto = min(H, 4096) == H at 4096 hosts, making the
-      destination-compacted merge a full-width indirect gather.
+fell 83k -> 34k ev/s (round-4 verdict item 3). The general machinery
+moved to tools/perf_ab.py (any config x any EngineConfig knobs,
+paired interleaved reps, ledger + markdown output); this wrapper
+keeps the historical entry point and the named suspect set:
 
-Usage: python tools/phold_ab.py [variant ...]
-Variants: auto, dense, noladder (window rungs off via active_block>0
-trick is not possible; we use env-free config fields instead).
+  auto        the regressed round-4 default (AUTO ladder)
+  dense       compaction fully off (the round-3 default)
+  auto_noex   exchange sort-compaction off (full-sort path)
+  auto_nodst  destination-compacted merge off
+  block512 / block256   one explicit per-pass rung
+
+Usage: python tools/phold_ab.py [variant ...] [--cpu] [--stop S]
+Results land in the perf ledger and print a BASELINE.md-ready table
+(platform-stamped; CPU-container numbers are labeled as such —
+BASELINE.md protocol).
 """
-import copy
-import json
-import sys
-import time
 import os
+import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
+from tools.perf_ab import default_suspects, main as ab_main  # noqa: E402
 
-def run(tag, cfg_kwargs):
-    import bench
-    from shadow_tpu.engine.sim import Simulation
-    from shadow_tpu.engine.state import EngineConfig
+N = 4096
+OBCAP = 8  # bench._phold_cfg(4096).obcap
 
-    scen = bench._phold_scenario(4096, 10)
-    cfg = EngineConfig(num_hosts=4096, qcap=16, scap=4, obcap=8,
-                       incap=16, chunk_windows=512, **cfg_kwargs)
-    warm = copy.deepcopy(scen)
-    warm.stop_time = int(1.2 * 10**9)
-    t0 = time.perf_counter()
-    Simulation(warm, engine_cfg=cfg).run()
-    t_cold = time.perf_counter() - t0
-    rates = []
-    for _ in range(3):
-        r = Simulation(scen, engine_cfg=cfg).run()
-        s = r.summary()
-        rates.append(round(s["events_per_sec"], 1))
-    rates.sort()
-    cost = r.cost_model()
-    print(json.dumps({"variant": tag, "cfg": cfg_kwargs,
-                      "warmup_wall_s": round(t_cold, 1),
-                      "rates": rates, "median": rates[1],
-                      "events": s["events"],
-                      "passes": cost.get("passes"),
-                      "windows": s["windows"]}), flush=True)
-
-
-VARIANTS = {
-    # round-4 default (the regressed config)
-    "auto": {},
-    # compaction fully off (the round-3 default): isolates the ladder
-    "dense": {"active_block": 0},
-    # exchange compaction off (C == N takes the static full-sort path),
-    # ladder on: isolates exsort+dst compaction
-    "auto_noex": {"exsortcap": 4096 * 8},
-    # dst-compaction effectively off (D=1: dst_full on any real window),
-    # rest of auto on
-    "auto_nodst": {"dstcap": 1},
-    # one explicit 512 rung (the quarter-rule window-rung candidate)
-    "block512": {"active_block": 512},
-    "block256": {"active_block": 256},
-}
+VARIANTS = dict(default_suspects(N, OBCAP))
 
 if __name__ == "__main__":
-    import bench
-    bench._enable_compile_cache()
-    names = sys.argv[1:] or list(VARIANTS)
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("variants", nargs="*",
+                    help=f"subset of {sorted(VARIANTS)} "
+                         "(default: all)")
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--stop", type=int, default=10)
+    ap.add_argument("--reps", type=int, default=3)
+    a = ap.parse_args()
+    unknown = [n for n in a.variants if n not in VARIANTS]
+    if unknown:
+        sys.exit(f"phold_ab: unknown variant(s) {unknown}; "
+                 f"choices: {sorted(VARIANTS)}")
+    names = a.variants or list(VARIANTS)
+    args = ["phold", "--n", str(N), "--stop", str(a.stop),
+            "--reps", str(a.reps), "--markdown"]
+    if a.cpu:
+        args.append("--cpu")
     for n in names:
-        run(n, VARIANTS[n])
+        ov = VARIANTS[n]
+        spec = n if not ov else (
+            n + ":" + ",".join(f"{k}={v}" for k, v in ov.items()))
+        args += ["--variant", spec]
+    sys.exit(ab_main(args))
