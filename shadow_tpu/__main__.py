@@ -87,6 +87,12 @@ def build_test_scenario(n_clients: int = 1000, stop_s: int = 60):
 
 
 def main(argv=None):
+    argv_in = list(argv) if argv is not None else sys.argv[1:]
+    if argv_in[:1] == ["fleet"]:
+        # the sweep scheduler CLI (fleet submit|run|status) has its
+        # own argparse tree — dispatch before the run parser
+        from .fleet.cli import main as fleet_main
+        return fleet_main(argv_in[1:])
     p = argparse.ArgumentParser(
         prog="shadow_tpu",
         description="TPU-native discrete-event network simulator")
@@ -135,10 +141,12 @@ def main(argv=None):
     p.add_argument("--interface-qdisc", default="rr",
                    choices=["fifo", "rr"],
                    help="NIC socket service discipline")
-    p.add_argument("--cpu-threshold", type=int, default=-1, metavar="US",
+    p.add_argument("--cpu-threshold", type=int, default=None,
+                   metavar="US",
                    help="CPU blocked-delay threshold in microseconds "
                         "(negative disables; reference default -1)")
-    p.add_argument("--cpu-precision", type=int, default=1, metavar="US",
+    p.add_argument("--cpu-precision", type=int, default=None,
+                   metavar="US",
                    help="round CPU delays to the nearest microseconds "
                         "(default 1; the reference's 200 would round "
                         "the constant modeled event cost to zero)")
@@ -318,10 +326,17 @@ def main(argv=None):
                 ))
             except ValueError as e:
                 p.error(f"--fault {spec!r}: {e}")
-    scenario.cpu_threshold_ns = (args.cpu_threshold * 1000
-                                 if args.cpu_threshold >= 0 else -1)
-    scenario.cpu_precision_ns = (args.cpu_precision * 1000
-                                 if args.cpu_precision >= 0 else 0)
+    # None = flag absent (argparse sentinel): only an EXPLICIT flag
+    # overrides the scenario — unconditional writes would clobber
+    # CPU-model values the XML carries (the to_xml schema extension
+    # the fleet's self-contained queue relies on), while an explicit
+    # `--cpu-threshold -1` must still win over the XML
+    if args.cpu_threshold is not None:
+        scenario.cpu_threshold_ns = (args.cpu_threshold * 1000
+                                     if args.cpu_threshold >= 0 else -1)
+    if args.cpu_precision is not None:
+        scenario.cpu_precision_ns = (args.cpu_precision * 1000
+                                     if args.cpu_precision >= 0 else 0)
     # CLI buffer defaults apply to hosts whose XML sets none (the
     # reference's CLI-default / XML-override layering, shd-master.c:296-341)
     for h in scenario.hosts:
@@ -400,23 +415,46 @@ def main(argv=None):
             TR.install(args.trace)
             own_perf_tr = True
 
+    # preemption protocol (docs/fleet.md): with a checkpoint store
+    # active, SIGTERM means "save a snapshot at the next chunk
+    # boundary and exit 75 (resumable)" instead of dying with work
+    # lost — the contract the fleet scheduler and any preempting
+    # cluster manager rely on. Installed only in the main thread
+    # (signal API constraint; embedders call request_preempt
+    # themselves).
+    if args.checkpoint:
+        import signal as _signal
+        import threading as _threading
+        if _threading.current_thread() is _threading.main_thread():
+            from .engine.sim import request_preempt
+            _signal.signal(_signal.SIGTERM,
+                           lambda s, f: request_preempt())
+
     # the digest context records the CLI invocation in the manifest —
     # the replay context tools/divergence.py --bisect needs
     dg_ctx = ({"argv": list(argv) if argv is not None else sys.argv[1:],
                "config_path": args.config}
               if args.digest else None)
-    report = sim.run(verbose=args.verbose, mesh=mesh,
-                     heartbeat_s=args.heartbeat_frequency,
-                     logger=logger,
-                     checkpoint_path=args.checkpoint,
-                     checkpoint_every_s=args.checkpoint_every,
-                     checkpoint_keep=args.checkpoint_keep,
-                     resume_from=args.resume, pcap_dir=args.pcap_dir,
-                     trace=None if own_perf_tr else args.trace,
-                     metrics=args.metrics,
-                     digest=args.digest,
-                     digest_every=args.digest_every,
-                     digest_context=dg_ctx)
+    from .engine.sim import Preempted
+    try:
+        report = sim.run(verbose=args.verbose, mesh=mesh,
+                         heartbeat_s=args.heartbeat_frequency,
+                         logger=logger,
+                         checkpoint_path=args.checkpoint,
+                         checkpoint_every_s=args.checkpoint_every,
+                         checkpoint_keep=args.checkpoint_keep,
+                         resume_from=args.resume, pcap_dir=args.pcap_dir,
+                         trace=None if own_perf_tr else args.trace,
+                         metrics=args.metrics,
+                         digest=args.digest,
+                         digest_every=args.digest_every,
+                         digest_context=dg_ctx)
+    except Preempted as pe:
+        from .engine.supervisor import EXIT_PREEMPTED
+        logger.message(pe.sim_ns, "main",
+                       f"preempted: {pe} — resume with "
+                       "--resume latest")
+        return EXIT_PREEMPTED
     s = report.summary()
     if own_perf_tr:
         # phase attribution + ledger append (obs.perf / obs.ledger):

@@ -125,6 +125,92 @@ class Scenario:
     def total_hosts(self) -> int:
         return sum(h.quantity for h in self.hosts)
 
+    def to_xml(self) -> str:
+        """Serialize back to the shadow.config.xml schema load_xml
+        parses — ``load_xml(s.to_xml())`` rebuilds an equivalent
+        scenario (tests/test_fleet.py round-trips it). This is how
+        programmatic scenario builders (tools/baseline_configs.py)
+        become submittable fleet runs: the fleet queue stores
+        self-contained XML files, not Python closures. Times are
+        emitted in exact nanoseconds; the seed is NOT part of the
+        schema (pass ``--seed`` on the run's CLI args)."""
+        root = ElementTree.Element(
+            "shadow", {"stoptime": f"{int(self.stop_time)}ns"})
+        if self.bootstrap_end:
+            root.set("bootstraptime", f"{int(self.bootstrap_end)}ns")
+        # scenario-level CPU-model overrides (a schema extension like
+        # <fault>): emitted only when non-default so reference-style
+        # files stay reference-style, parsed back by load_xml — a
+        # builder's custom CPU model must round-trip into the fleet's
+        # XML copy, not silently revert to defaults
+        for attr, field_name in _CPU_XML_ATTRS:
+            v = getattr(self, field_name)
+            if v != Scenario.__dataclass_fields__[field_name].default:
+                root.set(attr, str(int(v)))
+        topo = ElementTree.SubElement(root, "topology")
+        if self.topology_path:
+            topo.set("path", self.topology_path)
+        elif self.topology_graphml:
+            topo.text = self.topology_graphml
+        for pl in self.plugins:
+            ElementTree.SubElement(root, "plugin",
+                                   {"id": pl.id, "path": pl.path})
+        for fs in self.faults:
+            a = {"kind": fs.kind, "at": f"{int(fs.at)}ns"}
+            if fs.host:
+                a["host"] = fs.host
+            if fs.src:
+                a["src"] = fs.src
+            if fs.dst:
+                a["dst"] = fs.dst
+            if fs.until is not None:
+                a["until"] = f"{int(fs.until)}ns"
+            if fs.rate:
+                a["rate"] = repr(fs.rate)
+            if fs.extra_ns:
+                a["extra"] = f"{int(fs.extra_ns)}ns"
+            ElementTree.SubElement(root, "fault", a)
+        for h in self.hosts:
+            a = {"id": h.id}
+            if h.quantity != 1:
+                a["quantity"] = str(h.quantity)
+            if h.ip_hint:
+                a["iphint"] = h.ip_hint
+            if h.geocode_hint:
+                a["geocodehint"] = h.geocode_hint
+            if h.type_hint:
+                a["typehint"] = h.type_hint
+            if h.bandwidth_down is not None:
+                a["bandwidthdown"] = _to_kib(h.bandwidth_down,
+                                             "bandwidth_down", h.id)
+            if h.bandwidth_up is not None:
+                a["bandwidthup"] = _to_kib(h.bandwidth_up,
+                                           "bandwidth_up", h.id)
+            if h.cpu_frequency is not None:
+                a["cpufrequency"] = str(h.cpu_frequency)
+            if h.log_level:
+                a["loglevel"] = h.log_level
+            if h.pcap:
+                a["logpcap"] = "true"
+            if h.pcap_dir:
+                a["pcapdir"] = h.pcap_dir
+            if h.socket_recv_buffer is not None:
+                a["socketrecvbuffer"] = str(h.socket_recv_buffer)
+            if h.socket_send_buffer is not None:
+                a["socketsendbuffer"] = str(h.socket_send_buffer)
+            if h.interface_buffer is not None:
+                a["interfacebuffer"] = str(h.interface_buffer)
+            he = ElementTree.SubElement(root, "host", a)
+            for pr in h.processes:
+                pa = {"plugin": pr.plugin,
+                      "starttime": f"{int(pr.start_time)}ns"}
+                if pr.stop_time:
+                    pa["stoptime"] = f"{int(pr.stop_time)}ns"
+                if pr.arguments:
+                    pa["arguments"] = pr.arguments
+                ElementTree.SubElement(he, "process", pa)
+        return ElementTree.tostring(root, encoding="unicode")
+
     def expand_hosts(self):
         """Yield (flat_host_index, unique_name, HostSpec) with quantity
         expansion. Names follow the reference's hostname scheme: a host
@@ -139,6 +225,30 @@ class Scenario:
 
 
 _BOOL_TRUE = {"1", "true", "yes", "on"}
+
+# scenario-level CPU-model fields carried through the XML (to_xml
+# emits when non-default, load_xml parses when present)
+_CPU_XML_ATTRS = (
+    ("cpurawfrequencykhz", "cpu_raw_frequency_khz"),
+    ("cpueventcostns", "cpu_event_cost_ns"),
+    ("cpuprecisionns", "cpu_precision_ns"),
+    ("cputhresholdns", "cpu_threshold_ns"),
+)
+
+
+def _to_kib(v: int, what: str, host_id: str) -> str:
+    """The XML schema stores bandwidths in whole KiB/s. A value that
+    cannot round-trip exactly must fail LOUD at serialization time:
+    silently flooring would make the fleet's XML copy of a scenario
+    simulate different bandwidths than the in-process original (and
+    sub-KiB values would emit \"0\", which loads as 'use the topology
+    default')."""
+    if v <= 0 or v % 1024:
+        raise ValueError(
+            f"host {host_id!r}: {what}={v} bytes/s is not expressible "
+            "in the XML schema's whole-KiB granularity — round it to "
+            "a positive multiple of 1024 before to_xml()")
+    return str(v // 1024)
 
 
 def _get_time(attrs, key, default=0):
@@ -167,6 +277,9 @@ def load_xml(source: str) -> Scenario:
     scen = Scenario(stop_time=_get_time(root.attrib, "stoptime"),
                     source_path=src_path)
     scen.bootstrap_end = _get_time(root.attrib, "bootstraptime")
+    for attr, field_name in _CPU_XML_ATTRS:
+        if attr in root.attrib:
+            setattr(scen, field_name, int(root.attrib[attr]))
 
     for el in root:
         if el.tag == "topology":

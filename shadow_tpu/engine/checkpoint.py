@@ -32,6 +32,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import re
 import sys
 import zipfile
 from dataclasses import dataclass, field
@@ -74,6 +75,32 @@ def base_of(path: str) -> str:
     and ``run/ck`` both name the store whose snapshots are
     ``run/ck.w<windows>.npz`` and whose pointer is ``run/ck.latest``."""
     return path[:-4] if path.endswith(".npz") else path
+
+
+# run ids usable as a store namespace: path-safe, no separators, no
+# traversal — one shared definition so fleet queue, status tooling and
+# tests agree on what a valid run id is
+_RUN_ID_OK = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,99}$")
+
+
+def valid_run_id(run_id: str) -> bool:
+    return bool(_RUN_ID_OK.match(run_id or ""))
+
+
+def run_store_base(root: str, run_id: str, name: str = "ck") -> str:
+    """Per-run checkpoint-store namespacing for fleets of runs
+    (shadow_tpu.fleet): each run owns ``<root>/<run_id>/<name>`` as
+    its store base, so rotation, the ``latest`` pointer, the
+    supervisor crash log and the hosted sidecars of concurrent runs
+    can never collide. `run_id` must be path-safe (valid_run_id) —
+    rejected loudly here rather than silently nesting directories or
+    escaping `root`."""
+    if not valid_run_id(run_id):
+        raise ValueError(
+            f"run id {run_id!r} is not a valid store namespace "
+            "(want: letters/digits/._- only, starting with an "
+            "alphanumeric, <=100 chars)")
+    return os.path.join(root, run_id, name)
 
 
 def _sha256_file(path: str) -> str:

@@ -20,15 +20,57 @@ byte-identical to an uninterrupted same-seed run's
 
 from __future__ import annotations
 
-import json
 import signal
 import subprocess
 import sys
 import time
 
+# exit code of a cooperative preemption (SIGTERM under --checkpoint:
+# the run saved a snapshot at a chunk boundary and stopped — see
+# engine.sim.Preempted). EX_TEMPFAIL: "try again later" — a resume
+# completes the run; supervisors treat it as resumable, never as a
+# crash that counts toward giving up / quarantine.
+EXIT_PREEMPTED = 75
+
 # flags the supervisor consumes; never forwarded to the child
 _SUPERVISOR_FLAGS = {"--until-complete"}
 _SUPERVISOR_OPTS = {"--max-retries", "--retry-backoff"}
+
+
+def backoff_delay(base_s: float, failures: int,
+                  cap_s: float = 60.0) -> float:
+    """Exponential backoff: delay before retry number `failures`
+    (1-based count of crashes so far), doubling from `base_s` to a
+    cap. The one backoff rule both the single-run supervisor and the
+    fleet scheduler (shadow_tpu.fleet) apply."""
+    return min(float(base_s) * (2 ** max(int(failures) - 1, 0)),
+               float(cap_s))
+
+
+class CrashLog:
+    """Append-only crash-cause journal (``<base>.supervisor.jsonl`` /
+    the fleet's per-run ``crash.jsonl``): one JSON line per attempt,
+    appended atomically and fsync'd so a kill mid-append can tear at
+    most the line in flight — which read() skips (the obs.ledger
+    torn-line contract). The fleet's quarantine decision and the
+    post-mortem both read this file, so it must survive exactly the
+    crashes it documents."""
+
+    def __init__(self, path: str, log=None):
+        self.path = path
+        self._log = log or (lambda msg: sys.stderr.write(
+            f"shadow_tpu: crash log: {msg}\n"))
+
+    def append(self, rec: dict):
+        from ..obs.ledger import jsonl_append
+        try:
+            jsonl_append(self.path, rec, fsync=True, sort_keys=True)
+        except OSError as e:
+            self._log(f"cannot write {self.path}: {e}")
+
+    def read(self) -> list:
+        from ..obs.ledger import jsonl_read
+        return jsonl_read(self.path, label="crash log")
 
 
 def strip_supervisor_args(argv: list) -> list:
@@ -89,7 +131,7 @@ class Supervisor:
     def __init__(self, child_argv: list, checkpoint: str,
                  max_retries: int = 5, backoff_s: float = 1.0,
                  backoff_cap_s: float = 60.0, python: str = None,
-                 log=None):
+                 log=None, max_preemptions: int = 100):
         self.child_argv = list(child_argv)
         # engine.checkpoint.base_of, inlined: importing the checkpoint
         # module would pull jax into the (deliberately light)
@@ -100,25 +142,30 @@ class Supervisor:
         self.max_retries = int(max_retries)
         self.backoff_s = float(backoff_s)
         self.backoff_cap_s = float(backoff_cap_s)
+        # preemptions (exit 75) are resumable and never count as
+        # crashes, but an environment that SIGTERMs every attempt
+        # must not loop us forever — the same livelock bound the
+        # fleet scheduler applies (max_spont_preempts), sized for a
+        # long spot-instance run here
+        self.max_preemptions = int(max_preemptions)
         self.python = python or sys.executable
         self.log = log or (lambda msg: sys.stderr.write(
             f"shadow_tpu: supervisor: {msg}\n"))
         self.attempts = []          # attempt records (also JSONL'd)
+        self.crash_log = CrashLog(self.log_path(), log=self.log)
 
     def log_path(self) -> str:
         return self.checkpoint_base + ".supervisor.jsonl"
 
+    def read_log(self) -> list:
+        """All attempt records of this store's crash-cause journal,
+        torn-line tolerant (a kill mid-append never breaks the next
+        supervisor's — or the fleet's — read of it)."""
+        return self.crash_log.read()
+
     def _record(self, rec: dict):
         self.attempts.append(rec)
-        try:
-            import os
-            d = os.path.dirname(os.path.abspath(self.log_path()))
-            os.makedirs(d, exist_ok=True)
-            with open(self.log_path(), "a") as f:
-                f.write(json.dumps(rec, sort_keys=True) + "\n")
-                f.flush()
-        except OSError as e:
-            self.log(f"cannot write crash log: {e}")
+        self.crash_log.append(rec)
 
     def _child_argv(self, attempt: int) -> list:
         if attempt == 1:
@@ -134,7 +181,8 @@ class Supervisor:
         from ..obs import metrics as MT
         from ..obs import trace as TR
         attempt = 0
-        delay = self.backoff_s
+        crashes = 0
+        preemptions = 0
         while True:
             attempt += 1
             argv = self._child_argv(attempt)
@@ -157,7 +205,10 @@ class Supervisor:
                 reg = MT.REGISTRY
                 reg.counter("supervisor.attempts").inc()
                 reg.gauge("supervisor.last_exit_status").set(rc)
-                if rc != 0:
+                if rc != 0 and rc != EXIT_PREEMPTED:
+                    # preemptions are resumable, not crashes — a
+                    # dashboard alerting on crashes must not fire on
+                    # healthy spot-instance churn
                     reg.counter("supervisor.crashes").inc()
                 if resumed:
                     reg.counter("supervisor.resumes").inc()
@@ -180,17 +231,40 @@ class Supervisor:
                 if MT.ENABLED:
                     MT.REGISTRY.counter("supervisor.gave_up").inc()
                 return rc
-            if attempt > self.max_retries:
+            if rc == EXIT_PREEMPTED:
+                # a cooperative preemption (SIGTERM → snapshot at the
+                # boundary, engine.sim.Preempted) is not a crash: it
+                # never counts toward the retry cap — but a child
+                # preempted on EVERY attempt is a livelock, so it has
+                # its own generous bound
+                preemptions += 1
+                if MT.ENABLED:
+                    MT.REGISTRY.counter("supervisor.preemptions").inc()
+                if preemptions > self.max_preemptions:
+                    self.log(
+                        f"preempted {preemptions} times without "
+                        "completing — something SIGTERMs every "
+                        "attempt; giving up (state is resumable)")
+                    if MT.ENABLED:
+                        MT.REGISTRY.counter("supervisor.gave_up").inc()
+                    return rc
+                self.log("child was preempted (saved a snapshot); "
+                         "resuming from 'latest'")
+                time.sleep(self.backoff_s)
+                continue
+            crashes += 1
+            if crashes > self.max_retries:
                 self.log(
-                    f"giving up after {attempt} attempts "
+                    f"giving up after {crashes} crashes "
                     f"({self.max_retries} retries); last cause: "
                     f"{cause}")
                 if MT.ENABLED:
                     MT.REGISTRY.counter("supervisor.gave_up").inc()
                 return rc if rc > 0 else 70    # EX_SOFTWARE for signals
+            delay = backoff_delay(self.backoff_s, crashes,
+                                  self.backoff_cap_s)
             self.log(f"restarting from 'latest' in {delay:.1f}s "
-                     f"(retry {attempt}/{self.max_retries})")
+                     f"(retry {crashes}/{self.max_retries})")
             if MT.ENABLED:
                 MT.REGISTRY.gauge("supervisor.backoff_s").set(delay)
             time.sleep(delay)
-            delay = min(delay * 2, self.backoff_cap_s)

@@ -1,0 +1,36 @@
+"""Fleet: a crash-safe sweep scheduler over the durable-run substrate.
+
+The reference's Master/Slave split exists so one controller keeps many
+workers making progress through failures (shd-master.c / shd-slave.c);
+PR 5 built the inverse half here — ONE run that survives any crash
+(engine.supervisor + engine.checkpoint + the digest rewind). This
+package generalizes that from one run to a fleet: a durable on-disk
+run queue (queue), a worker slot that executes each run as a
+supervised child process (worker), and a scheduler that drains the
+queue through crashes of the runs AND of itself (scheduler).
+
+Guarantees (docs/fleet.md, proven by tests/test_fleet.py):
+
+- **durable**: every queue transition is one fsync'd JSONL journal
+  line (torn-line tolerant — obs.ledger); claims are O_EXCL files;
+  SIGKILLing workers and the scheduler at arbitrary instants loses no
+  run and duplicates no result;
+- **equivalent**: a sweep interrupted anywhere completes on restart
+  with every run's digest chain byte-identical to an uninterrupted
+  reference sweep (the PR 5 claim, lifted to fleets) — and the chains
+  are independent of worker count and scheduling order;
+- **isolated**: a deterministic crasher is retried with exponential
+  backoff and then QUARANTINED with its crash-cause journal, while
+  the rest of the queue keeps draining;
+- **bounded**: admission control caps concurrent simulated hosts /
+  declared RSS, so an oversized scenario waits as "queued" instead of
+  OOMing the box (it runs alone once the box is free);
+- **preemptible**: SIGTERM makes workers checkpoint at the next chunk
+  boundary (engine.sim.Preempted, exit 75) and requeues their runs as
+  resumable — scheduler restart ≡ uninterrupted sweep.
+
+CLI: ``shadow_tpu fleet submit|run|status`` (fleet.cli).
+"""
+
+from .queue import Queue, RunState  # noqa: F401
+from .scheduler import Scheduler    # noqa: F401

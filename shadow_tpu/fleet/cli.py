@@ -1,0 +1,267 @@
+"""``shadow_tpu fleet`` — submit / run / status for sweep queues.
+
+  python -m shadow_tpu fleet submit Q config.xml [opts] [-- child args]
+  python -m shadow_tpu fleet submit Q --cmd [opts] -- prog arg...
+  python -m shadow_tpu fleet run Q [--workers N] [--metrics FILE] ...
+  python -m shadow_tpu fleet status Q [--json]
+
+``submit`` durably enqueues one run (the XML is copied into the
+queue, so temp files are fine). ``run`` drains the queue — restart it
+after any crash or preemption and the sweep completes as if never
+interrupted (docs/fleet.md). ``status`` folds the journal into a
+table.
+
+Exit codes of ``run``: 0 queue drained, every run done; 3 drained but
+some runs quarantined (their crash-cause journals are named in the
+status output); 75 preempted (SIGTERM — children checkpointed and
+were requeued; run again to resume); 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+from xml.etree import ElementTree
+
+
+def _count_hosts(xml_path: str) -> int:
+    """Admission weight from the scenario XML: total expanded hosts.
+    A light direct parse — submit must not pay an engine import."""
+    try:
+        root = ElementTree.parse(xml_path).getroot()
+    except (OSError, ElementTree.ParseError):
+        return 1
+    return max(sum(int(el.attrib.get("quantity", 1) or 1)
+                   for el in root if el.tag in ("host", "node")), 1)
+
+
+def _split_rest(argv: list) -> tuple:
+    """Split the fleet argv at the first ``--``: argparse sees the
+    head, the tail goes verbatim to the child (argparse.REMAINDER is
+    famously greedy around optionals, so the split is manual)."""
+    if "--" in argv:
+        i = argv.index("--")
+        return list(argv[:i]), list(argv[i + 1:])
+    return list(argv), []
+
+
+def _auto_id(queue, stem: str) -> str:
+    taken = set(queue.fold()) if queue.exists() else set()
+    if stem not in taken:
+        return stem
+    i = 2
+    while f"{stem}-{i}" in taken:
+        i += 1
+    return f"{stem}-{i}"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="shadow_tpu fleet",
+        description="crash-safe sweep scheduler (docs/fleet.md)")
+    sub = p.add_subparsers(dest="cmd_name", required=True)
+
+    ps = sub.add_parser("submit", help="durably enqueue one run")
+    ps.add_argument("queue", help="queue directory")
+    ps.add_argument("config", nargs="?",
+                    help="scenario XML (omit with --cmd)")
+    ps.add_argument("--id", default=None,
+                    help="run id (default: config basename, "
+                         "deduplicated)")
+    ps.add_argument("--cmd", action="store_true",
+                    help="raw command mode: everything after -- is "
+                         "the child argv (retries re-run from "
+                         "scratch; no managed checkpoint/digest)")
+    ps.add_argument("--hosts", type=int, default=0,
+                    help="admission weight (default: parsed from the "
+                         "XML; 1 for --cmd)")
+    ps.add_argument("--rss-mb", type=int, default=0,
+                    help="declared peak RSS for admission control")
+    ps.add_argument("--max-retries", type=int, default=3,
+                    help="crashes before quarantine (default 3)")
+    ps.add_argument("--checkpoint-every", type=float, default=10.0,
+                    metavar="SEC",
+                    help="child checkpoint cadence (default 10)")
+    ps.add_argument("--no-digest", action="store_true",
+                    help="skip the per-run determinism digest chain")
+    ps.add_argument("--digest-every", type=int, default=0,
+                    metavar="WINDOWS")
+    ps.add_argument("--perf", nargs="?", const="", default=None,
+                    metavar="LEDGER",
+                    help="append a per-run perf-ledger entry on "
+                         "completion (child --perf; default ledger "
+                         "path unless LEDGER given). Resumed "
+                         "attempts skip the append, as documented in "
+                         "docs/performance.md")
+    ps.add_argument("--env", action="append", default=[],
+                    metavar="K=V", help="child environment override "
+                                        "(repeatable)")
+    ps.epilog = ("everything after `--` goes verbatim to the child: "
+                 "extra CLI args in config mode (--seed, --fault, "
+                 "--engine-caps ...), the command itself in --cmd "
+                 "mode")
+
+    pr = sub.add_parser("run", help="drain the queue (restartable)")
+    pr.add_argument("queue")
+    pr.add_argument("--workers", type=int, default=2)
+    pr.add_argument("--max-hosts", type=int, default=0,
+                    help="admission cap on CONCURRENT simulated "
+                         "hosts (0 = unbounded)")
+    pr.add_argument("--max-rss-mb", type=int, default=0)
+    pr.add_argument("--hang-timeout", type=float, default=900.0,
+                    metavar="SEC",
+                    help="watchdog: SIGKILL a run with no progress "
+                         "signals for this long (default 900 — must "
+                         "exceed the cold XLA compile)")
+    pr.add_argument("--backoff", type=float, default=1.0, metavar="SEC")
+    pr.add_argument("--backoff-cap", type=float, default=60.0,
+                    metavar="SEC")
+    pr.add_argument("--grace", type=float, default=60.0, metavar="SEC",
+                    help="preemption: wall given to children to "
+                         "checkpoint after SIGTERM before SIGKILL")
+    pr.add_argument("--metrics", default=None, metavar="FILE",
+                    help="write fleet.* metrics (obs.metrics) to FILE")
+    pr.add_argument("--python", default=None,
+                    help="interpreter for child runs")
+
+    pt = sub.add_parser("status", help="fold the journal into a table")
+    pt.add_argument("queue")
+    pt.add_argument("--json", action="store_true")
+
+    head, rest = _split_rest(list(argv) if argv is not None
+                             else sys.argv[1:])
+    args = p.parse_args(head)
+    if rest and args.cmd_name != "submit":
+        p.error(f"`{args.cmd_name}` takes no `--` tail")
+    from .queue import Queue, make_spec
+
+    if args.cmd_name == "submit":
+        q = Queue(args.queue)
+        env = {}
+        for kv in args.env:
+            k, eq, v = kv.partition("=")
+            if not eq:
+                p.error(f"--env {kv!r} is not K=V")
+            env[k] = v
+        if args.cmd:
+            if not rest:
+                p.error("--cmd needs a command after --")
+            # durability/perf args are managed for CONFIG runs only;
+            # silently accepting them here would e.g. drop the user's
+            # expected ledger entries without a trace
+            if (args.checkpoint_every != 10.0 or args.no_digest
+                    or args.digest_every or args.perf is not None):
+                p.error("--cmd runs execute the command verbatim: "
+                        "--checkpoint-every/--no-digest/--digest-every"
+                        "/--perf apply to config runs only (put the "
+                        "equivalent flags in the command itself)")
+            if args.config:
+                rest = [args.config] + rest
+            rid = args.id or _auto_id(q, "cmd")
+            spec = make_spec(rid, cmd=rest, env=env,
+                             hosts=args.hosts or 1, rss_mb=args.rss_mb,
+                             max_retries=args.max_retries)
+        else:
+            if not args.config:
+                p.error("submit needs a scenario XML (or --cmd)")
+            # the worker appends the MANAGED durability args after the
+            # tail; argparse last-wins would silently discard any the
+            # user put there — refuse instead
+            managed = {"--checkpoint", "--checkpoint-every",
+                       "--checkpoint-keep", "--digest",
+                       "--digest-every", "--resume", "--perf",
+                       "--until-complete", "--max-retries",
+                       "--retry-backoff"}
+            clash = [a for a in rest
+                     if a in managed
+                     or a.split("=", 1)[0] in managed]
+            if clash:
+                p.error(f"{' '.join(sorted(set(clash)))} in the `--` "
+                        "tail: the fleet manages checkpoint/digest/"
+                        "resume/perf for config runs — use the submit "
+                        "options (--checkpoint-every, --digest-every, "
+                        "--no-digest, --perf) instead")
+            stem = os.path.splitext(os.path.basename(args.config))[0]
+            rid = args.id or _auto_id(q, stem)
+            spec = make_spec(
+                rid, config=args.config, args=rest, env=env,
+                hosts=args.hosts or _count_hosts(args.config),
+                rss_mb=args.rss_mb, max_retries=args.max_retries,
+                checkpoint_every=args.checkpoint_every,
+                digest=not args.no_digest,
+                digest_every=args.digest_every, perf=args.perf)
+        try:
+            q.submit(spec)
+        except (ValueError, OSError) as e:
+            p.error(str(e))
+        print(f"submitted {rid} -> {args.queue}")
+        return 0
+
+    if args.cmd_name == "run":
+        from ..obs import metrics as MT
+        from .scheduler import Scheduler, SchedulerLockError
+        q = Queue(args.queue)
+        if not q.exists():
+            p.error(f"{args.queue!r} holds no queue journal — submit "
+                    "runs first")
+        sched = Scheduler(
+            q, workers=args.workers, max_hosts=args.max_hosts,
+            max_rss_mb=args.max_rss_mb,
+            hang_timeout_s=args.hang_timeout, backoff_s=args.backoff,
+            backoff_cap_s=args.backoff_cap, grace_s=args.grace,
+            python=args.python)
+        # SIGTERM/SIGINT = preempt: children checkpoint + requeue,
+        # we exit 75; the next `fleet run` resumes the sweep
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda s, f: sched.request_preempt())
+        own_mt = False
+        if args.metrics and not MT.ENABLED:
+            MT.install(args.metrics)
+            own_mt = True
+        try:
+            return sched.run()
+        except SchedulerLockError as e:
+            sys.stderr.write(f"shadow_tpu: fleet: {e}\n")
+            return 1
+        finally:
+            if own_mt:
+                MT.finish()
+
+    # status
+    q = Queue(args.queue)
+    states = q.fold()
+    if args.json:
+        print(json.dumps(
+            {rid: {**st.spec, "state": st.state,
+                   "started": st.started, "crashes": st.crashes,
+                   "preemptions": st.preemptions,
+                   "reclaims": st.reclaims,
+                   "last_rc": st.last_rc,
+                   "last_cause": st.last_cause,
+                   "quarantine_cause": st.quarantine_cause}
+             for rid, st in states.items()},
+            indent=1, sort_keys=True))
+        return 0
+    if not states:
+        print(f"{args.queue}: empty queue")
+        return 0
+    wid = max(len(r) for r in states) + 2
+    print(f"{'run':<{wid}}{'state':<13}{'starts':<8}{'crashes':<9}"
+          "cause")
+    for rid, st in states.items():
+        cause = st.quarantine_cause or st.last_cause or ""
+        print(f"{rid:<{wid}}{st.state:<13}{st.started:<8}"
+              f"{st.crashes:<9}{cause}")
+    counts = {}
+    for st in states.values():
+        counts[st.state] = counts.get(st.state, 0) + 1
+    print("total: " + ", ".join(f"{v} {k}"
+                                for k, v in sorted(counts.items())))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,234 @@
+"""Worker slot: one run attempt as a supervised child process.
+
+The single-run supervisor (engine.supervisor) re-execs ONE command
+line until it completes; a fleet slot is the same idea held by the
+scheduler: build the child CLI (managed durability args for config
+runs — per-run checkpoint store, digest chain, ``--resume latest`` on
+re-dispatch), spawn it in its own session (so a takeover can kill the
+whole process group of an orphaned run), stream its stdout to the
+run's log, and watch its wall-clock PROGRESS — checkpoint-pointer /
+digest-chain / log mtimes — so a hung run is diagnosed and SIGKILLed
+instead of wedging the slot (the shim watchdog contract, one level
+up). Every exit is classified (engine.supervisor.classify_exit) and
+appended to the run's crash-cause journal.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from ..engine.supervisor import EXIT_PREEMPTED, CrashLog, classify_exit
+from .queue import Queue
+
+
+# claim-gate wrapper: the spawned process execs the real child ONLY
+# once the claim file names its own pid — so a scheduler SIGKILLed
+# inside the spawn→claim window leaves a gate that times out and
+# exits 75 on its own, never an untracked live orphan racing a
+# re-dispatched attempt over the same run directory. (A SIGSTOP
+# handshake cannot do this: stopping before exec deadlocks Popen's
+# exec-errpipe read in the parent.)
+_CLAIM_GATE = """\
+import json, os, sys, time
+claim, me, end = sys.argv[1], str(os.getpid()), time.time() + 30
+ok = False
+while not ok and time.time() < end:
+    try:
+        ok = str(json.load(open(claim)).get("pid")) == me
+    except Exception:
+        ok = False
+    if not ok:
+        time.sleep(0.01)
+if not ok:
+    sys.exit(75)
+os.execvp(sys.argv[2], sys.argv[2:])
+"""
+
+
+def build_child_argv(queue: Queue, spec: dict, resume: bool,
+                     python: str = None) -> list:
+    """The child command line for one attempt. Config runs get the
+    managed durability args; cmd runs are verbatim (their retries
+    re-run from scratch — the spec chose that mode)."""
+    if spec.get("cmd"):
+        return list(spec["cmd"])
+    rid = spec["id"]
+    argv = ([python or sys.executable, "-m", "shadow_tpu",
+             os.path.abspath(spec["config"])]
+            + list(spec.get("args") or [])
+            + ["--checkpoint", os.path.abspath(queue.store_base(rid)),
+               "--checkpoint-every", str(spec["checkpoint_every"])])
+    if spec.get("digest", True):
+        argv += ["--digest", os.path.abspath(queue.digest_path(rid))]
+        if spec.get("digest_every"):
+            argv += ["--digest-every", str(spec["digest_every"])]
+    if spec.get("perf") is not None:
+        argv += (["--perf", spec["perf"]] if spec["perf"]
+                 else ["--perf"])
+    if resume:
+        argv += ["--resume", "latest"]
+    return argv
+
+
+class Slot:
+    """One executing attempt. The scheduler polls it; it owns the
+    child process, the claim's pid refresh, and the exit record."""
+
+    def __init__(self, queue: Queue, state, python: str = None,
+                 log=None):
+        self.queue = queue
+        self.spec = state.spec
+        self.run_id = state.spec["id"]
+        self.attempt = state.started + 1
+        self.resume = bool(state.resume and state.spec.get("config"))
+        self.log = log or (lambda m: sys.stderr.write(
+            f"shadow_tpu: fleet: {m}\n"))
+        self.hung = False           # watchdog SIGKILLed it
+        self.preempting = False     # we SIGTERMed it (scheduler preempt)
+        self.preempt_killed = False  # grace expired -> SIGKILL
+        self.crash_log = CrashLog(queue.crash_log_path(self.run_id))
+
+        rd = queue.run_dir(self.run_id)
+        os.makedirs(rd, exist_ok=True)
+        argv = build_child_argv(queue, self.spec, self.resume, python)
+        env = dict(os.environ)
+        env.update(self.spec.get("env") or {})
+        env["SHADOW_TPU_FLEET_RUN_DIR"] = os.path.abspath(rd)
+        self._stdout = open(queue.log_path(self.run_id), "ab")
+        self.t0 = time.time()
+        self.last_progress = self.t0
+        # own session (killpg-able takeover), gated behind the claim:
+        # the wrapper execs the real argv only once the claim names
+        # its pid (start() publishes it); exec failures of a bad
+        # executable surface as a crash exit in run.log
+        try:
+            self.proc = subprocess.Popen(
+                [sys.executable, "-c", _CLAIM_GATE,
+                 os.path.abspath(queue.claim_path(self.run_id))] + argv,
+                stdout=self._stdout, stderr=subprocess.STDOUT,
+                env=env, start_new_session=True)
+        except OSError:
+            self._stdout.close()       # no slot survives to close it
+            raise
+        self.argv = argv          # the REAL child argv (claims,
+        #   crash records, recovery cmdline match — post-exec the
+        #   process's /proc cmdline equals exactly this)
+
+    def start(self):
+        """Open the claim gate: publish the claim with the child pid.
+        If the claim cannot be written, kill the gate — it would time
+        out and exit 75 on its own anyway."""
+        try:
+            self.refresh_claim()
+        except OSError:
+            self.kill()
+            raise
+
+    # --- claim pid refresh (recovery needs the CHILD pid) ---
+    def claim_meta(self) -> dict:
+        return {"scheduler_pid": os.getpid(), "pid": self.proc.pid,
+                "attempt": self.attempt, "argv": self.argv}
+
+    def refresh_claim(self):
+        """Re-publish the claim with the child pid (the claim was
+        taken before the pid existed): atomic replace, so a reader
+        always sees a complete claim."""
+        import json
+        path = self.queue.claim_path(self.run_id)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"t": round(time.time(), 3),
+                       **self.claim_meta()}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    # --- progress / watchdog ---
+    def progress_paths(self) -> list:
+        q, rid = self.queue, self.run_id
+        # heartbeat FIRST: checkpoints/digests are sim-paced (a slow
+        # box legitimately goes long wall stretches without either),
+        # but engine.sim touches <run_dir>/heartbeat once per chunk
+        # whenever SHADOW_TPU_FLEET_RUN_DIR is set — the wall-paced
+        # liveness signal the watchdog actually needs
+        return [os.path.join(q.run_dir(rid), "heartbeat"),
+                q.store_base(rid) + ".latest", q.digest_path(rid),
+                q.log_path(rid)]
+
+    def check_progress(self) -> float:
+        """Newest progress timestamp: spawn time or the latest mtime
+        of the run's checkpoint pointer / digest chain / stdout log —
+        the signals a LIVE run refreshes and a hung one cannot."""
+        for p in self.progress_paths():
+            try:
+                m = os.path.getmtime(p)
+            except OSError:
+                continue
+            if m > self.last_progress:
+                self.last_progress = m
+        return self.last_progress
+
+    # --- signals ---
+    def preempt(self):
+        """Cooperative preemption: SIGTERM — a config run checkpoints
+        at its next chunk boundary and exits 75 (engine.sim.Preempted);
+        a cmd run dies and is simply re-run later."""
+        if not self.preempting:
+            self.preempting = True
+            try:
+                os.kill(self.proc.pid, signal.SIGTERM)
+            except OSError:
+                pass
+
+    def kill(self):
+        try:
+            os.killpg(self.proc.pid, signal.SIGKILL)
+        except OSError:
+            try:
+                self.proc.kill()
+            except OSError:
+                pass
+
+    # --- exit ---
+    def classify(self, rc: int) -> tuple:
+        """(kind, cause): kind in done|preempt|crash. Any nonzero
+        exit while WE were preempting is a preemption, not a crash —
+        the scheduler asked for it."""
+        if rc == 0:
+            return "done", "completed"
+        if rc == EXIT_PREEMPTED:
+            return "preempt", "preempted (snapshot saved)"
+        if self.preempting:
+            return "preempt", ("preempted (grace expired; SIGKILLed)"
+                               if self.preempt_killed else
+                               f"preempted ({classify_exit(rc)})")
+        if self.hung:
+            return "crash", ("hung (no progress; SIGKILLed by "
+                             "watchdog)")
+        return "crash", classify_exit(rc)
+
+    def record_exit(self, rc: int, kind: str, cause: str):
+        """Per-attempt crash-cause record (the engine.supervisor
+        journal shape, one per attempt, fsync'd + torn-tolerant)."""
+        self.crash_log.append({
+            "attempt": self.attempt, "exit_status": rc,
+            "kind": kind, "cause": cause,
+            "wall_s": round(time.time() - self.t0, 3),
+            "resumed": self.resume,
+            # drop only a leading interpreter path (config runs); a
+            # cmd run's argv[0] IS the program — the post-mortem
+            # needs it
+            "argv": (self.argv[1:] if self.spec.get("config")
+                     else self.argv),
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        })
+
+    def close(self):
+        try:
+            self._stdout.close()
+        except OSError:
+            pass

@@ -169,7 +169,16 @@ class DigestRecorder:
 
         A trailing torn line (the crash landed mid-write) never
         matters — it is past the kept count; a kept record that does
-        not refold is a corrupted prefix and fails loud."""
+        not refold is a corrupted prefix and fails loud.
+
+        Multi-process meshes: EVERY process runs rewind (all must
+        refold the same prefix and re-arm the same cadence — the
+        per-record state pull is a collective), reading the chain
+        file over the same shared storage the snapshot came from;
+        only the writer (process 0) truncates, via an atomic
+        os.replace, so a peer reading concurrently sees the kept
+        prefix either way. This is what lifted the PR 5
+        resume+digest+multi-process gate."""
         n = max(int(n_records), 0)
         kept = []
         if self.path is not None and os.path.exists(self.path):

@@ -167,26 +167,31 @@ def key_of(e: dict) -> tuple:
     return (e.get("scenario"), e.get("platform"), e.get("fingerprint"))
 
 
-def append(entry: dict, path: str = None) -> str | None:
-    """Append one entry (atomic enough: one write+flush of one line).
-    Resolves `path` through default_path(); returns the path written,
-    or None when the ledger is disabled."""
-    if path is None:
-        path = default_path()
-    if path is None:
-        return None
+def jsonl_append(path: str, obj: dict, fsync: bool = False,
+                 sort_keys: bool = False) -> None:
+    """Append one JSON line. A single write+flush of one line is
+    already atomic enough for same-process readers; ``fsync=True``
+    additionally makes the append DURABLE before returning — the
+    contract crash-cause journals and the fleet run queue need (a
+    SIGKILL after jsonl_append returns can never lose the record,
+    only ever tear a line that was still in flight — which
+    jsonl_read skips). This pair is the repo's one implementation of
+    the crash-tolerant JSONL pattern (perf ledger, digest chain
+    shape, ``<ck>.supervisor.jsonl``, ``fleet/queue.jsonl``)."""
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     with open(path, "a") as f:
-        f.write(json.dumps(entry) + "\n")
+        f.write(json.dumps(obj, sort_keys=sort_keys) + "\n")
         f.flush()
-    return path
+        if fsync:
+            os.fsync(f.fileno())
 
 
-def read(path: str) -> list:
-    """All well-formed entries, file order. A torn/corrupt line (a run
-    killed mid-append) is skipped with a stderr warning, never a
-    crash — the gate must keep working on a crashed round's ledger."""
+def jsonl_read(path: str, label: str = "jsonl") -> list:
+    """All well-formed dict entries, file order. A torn/corrupt line
+    (a writer killed mid-append) is skipped with a stderr warning,
+    never a crash — readers must keep working on a crashed run's
+    file. `label` names the file's role in the warning."""
     out = []
     if not os.path.exists(path):
         return out
@@ -199,9 +204,28 @@ def read(path: str) -> list:
                 e = json.loads(line)
             except json.JSONDecodeError:
                 sys.stderr.write(
-                    f"ledger: {path}:{i}: skipping malformed line "
+                    f"{label}: {path}:{i}: skipping malformed line "
                     "(torn append?)\n")
                 continue
             if isinstance(e, dict):
                 out.append(e)
     return out
+
+
+def append(entry: dict, path: str = None) -> str | None:
+    """Append one ledger entry. Resolves `path` through
+    default_path(); returns the path written, or None when the ledger
+    is disabled."""
+    if path is None:
+        path = default_path()
+    if path is None:
+        return None
+    jsonl_append(path, entry)
+    return path
+
+
+def read(path: str) -> list:
+    """All well-formed ledger entries, file order (torn lines skipped
+    with a warning — the regression gate must keep working on a
+    crashed round's ledger)."""
+    return jsonl_read(path, label="ledger")

@@ -191,6 +191,12 @@ class Registry:
              if k.startswith("shard.")})
         perf = {k[len("perf."):]: v for k, v in gauges.items()
                 if k.startswith("perf.")}
+        # fleet view (shadow_tpu.fleet scheduler): queue depth by
+        # state plus lifetime start/retry/preempt/watchdog counters —
+        # the sweep-health section of a ``fleet run --metrics`` file
+        fleet = {k[len("fleet."):]: v
+                 for src in (gauges, counters)
+                 for k, v in src.items() if k.startswith("fleet.")}
         out = {"sim": sim,
                "shim": {"ops": ops, "op_latency_us": lat},
                "counters": counters, "gauges": gauges,
@@ -203,6 +209,8 @@ class Registry:
             out["shards"] = shards
         if perf:
             out["perf"] = perf
+        if fleet:
+            out["fleet"] = fleet
         return out
 
     def close(self):

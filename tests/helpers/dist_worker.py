@@ -2,7 +2,7 @@
 
 Launched N times by tests/test_distributed.py over loopback TCP:
     python dist_worker.py <coordinator> <num_procs> <proc_id> <out.npy>
-        [--ckpt <path>] [--resume] [--digest <path>]
+        [--ckpt <path>] [--resume] [--digest <path>] [--crash-ns N]
 Each process contributes 2 virtual CPU devices; the global mesh spans
 all processes — the same shape a real multi-host TPU deployment has
 (ICI within a process's slice, DCN between processes).
@@ -12,6 +12,10 @@ all processes — the same shape a real multi-host TPU deployment has
 instead of starting fresh. --digest: record a determinism digest
 chain at cadence 8 (every process pulls the global state — the
 per-record allgather — and process 0 writes the chain file).
+--crash-ns: arm the durability CrashHook — every process SIGKILLs
+itself at the first chunk boundary at/after that simulated time
+(deterministic, so all processes die at the same logical point; no
+fire-once guard — the resume phase simply omits the flag).
 """
 
 import os
@@ -26,6 +30,9 @@ def main():
     pcap = rest[rest.index("--pcap") + 1] if "--pcap" in rest else None
     digest = (rest[rest.index("--digest") + 1]
               if "--digest" in rest else None)
+    if "--crash-ns" in rest:
+        os.environ["SHADOW_TPU_CRASH_SIM_NS"] = (
+            rest[rest.index("--crash-ns") + 1])
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ["JAX_PLATFORMS"] = "cpu"
 

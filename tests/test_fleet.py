@@ -1,0 +1,761 @@
+"""Fleet supervisor tests: queue durability, scheduler machinery, and
+the sweep-level interrupted ≡ uninterrupted proof.
+
+Two tiers:
+
+- the FAST tests drive the queue/claims/fold/backoff/quarantine/
+  admission/watchdog/preemption machinery with throwaway ``cmd``-mode
+  children (plain ``python -c``) — no jax, no compiles, seconds total;
+- the SLOW tests (``-m slow``) put real simulator runs under the
+  scheduler: scheduling-order independence (digest chains must not
+  depend on worker count or queue order) and the acceptance chaos
+  sweep (ISSUE 7) — a ≥12-scenario sweep (modeled + fault-schedule +
+  hosted + one planted poison config) SIGKILLed at random instants
+  (workers AND scheduler) must complete on restart with every run's
+  digest chain byte-identical to an uninterrupted reference sweep,
+  the poison quarantined with its crash-cause journal, and the queue
+  never stalled. Each child CLI pays the cold XLA compile on the CPU
+  dev box — drive these in the background, never inside tier-1.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+sys.path.insert(0, str(REPO))
+
+from shadow_tpu.engine.supervisor import (      # noqa: E402
+    EXIT_PREEMPTED, CrashLog, backoff_delay, classify_exit)
+from shadow_tpu.fleet.queue import Queue, make_spec  # noqa: E402
+from shadow_tpu.fleet.scheduler import (        # noqa: E402
+    EXIT_DRAINED, EXIT_QUARANTINED, Scheduler, SchedulerLockError)
+
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO)
+    env.update(extra or {})
+    return env
+
+
+def quiet_log(_msg):
+    pass
+
+
+def sleeper_cmd(seconds, marker=None):
+    """A fake run: optionally touch `marker`, sleep, exit 0."""
+    body = f"import time; time.sleep({seconds})"
+    if marker:
+        body = (f"open({str(marker)!r}, 'a').write('x'); " + body)
+    return [sys.executable, "-c", body]
+
+
+# ---------------------------------------------------------------------
+# queue durability
+# ---------------------------------------------------------------------
+
+def test_journal_fold_and_torn_line(tmp_path):
+    """The queue state is a fold over the fsync'd journal; a torn
+    final line (writer SIGKILLed mid-append) is skipped, never a
+    crash, and every prior record survives."""
+    q = Queue(str(tmp_path / "q")).ensure()
+    q.submit(make_spec("a", cmd=["true"]))
+    q.submit(make_spec("b", cmd=["true"]))
+    q.append("start", id="a", attempt=1, pid=1234)
+    q.append("exit", id="a", attempt=1, rc=-9, kind="crash",
+             cause="killed by SIGKILL")
+    q.append("start", id="a", attempt=2, pid=1235)
+    q.append("exit", id="a", attempt=2, rc=0, kind="done",
+             cause="completed")
+    with open(q.journal, "a") as f:
+        f.write('{"op": "start", "id": "b", "att')   # torn append
+    st = q.fold()
+    assert st["a"].state == "done" and st["a"].crashes == 1
+    assert st["a"].started == 2
+    assert st["b"].state == "queued" and st["b"].started == 0
+    # records for unknown runs and unknown ops are skipped loudly,
+    # not fatal (an older reader on a newer journal)
+    q.append("exit", id="ghost", rc=0, kind="done", cause="x")
+    q.append("frobnicate", id="a")
+    assert q.fold()["a"].state == "done"
+
+
+def test_duplicate_submit_refused(tmp_path):
+    q = Queue(str(tmp_path / "q")).ensure()
+    q.submit(make_spec("a", cmd=["true"]))
+    with pytest.raises(ValueError, match="already queued"):
+        q.submit(make_spec("a", cmd=["true"]))
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError, match="path-safe"):
+        make_spec("../escape", cmd=["true"])
+    with pytest.raises(ValueError, match="exactly one"):
+        make_spec("x", config="a.xml", cmd=["true"])
+    with pytest.raises(ValueError, match="exactly one"):
+        make_spec("x")
+
+
+def test_claim_atomicity_and_release(tmp_path):
+    q = Queue(str(tmp_path / "q")).ensure()
+    assert q.claim("r1", {"pid": 1}) is True
+    assert q.claim("r1", {"pid": 2}) is False     # O_EXCL holds
+    assert q.read_claim("r1")["pid"] == 1
+    assert q.claimed_ids() == ["r1"]
+    q.release("r1")
+    assert q.read_claim("r1") is None
+    assert q.claim("r1", {"pid": 3}) is True
+
+
+def test_run_store_namespacing(tmp_path):
+    """Per-run checkpoint stores can never collide or escape the
+    runs root (engine.checkpoint.run_store_base)."""
+    from shadow_tpu.engine.checkpoint import run_store_base
+    q = Queue(str(tmp_path / "q"))
+    a = q.store_base("run-a")
+    b = q.store_base("run-b")
+    assert a != b and a.startswith(q.runs_dir)
+    for bad in ("../up", "a/b", "", ".hidden", "x" * 101):
+        with pytest.raises(ValueError):
+            run_store_base(str(tmp_path), bad)
+
+
+def test_crash_log_atomic_and_torn_tolerant(tmp_path):
+    """Satellite: crash-cause journals are fsync'd appends and
+    torn-line-tolerant reads (the obs.ledger pattern) — a kill
+    mid-append can no longer tear the journal the fleet reads."""
+    log = CrashLog(str(tmp_path / "crash.jsonl"))
+    log.append({"attempt": 1, "exit_status": -9,
+                "cause": "killed by SIGKILL"})
+    log.append({"attempt": 2, "exit_status": 0, "cause": "completed"})
+    with open(log.path, "a") as f:
+        f.write('{"attempt": 3, "exit_st')          # torn
+    recs = log.read()
+    assert [r["attempt"] for r in recs] == [1, 2]
+    assert recs[0]["cause"] == "killed by SIGKILL"
+
+
+def test_backoff_and_classify():
+    assert backoff_delay(1.0, 1) == 1.0
+    assert backoff_delay(1.0, 3) == 4.0
+    assert backoff_delay(1.0, 30, cap_s=60.0) == 60.0
+    assert classify_exit(0) == "completed"
+    assert classify_exit(-signal.SIGKILL) == "killed by SIGKILL"
+    assert classify_exit(3) == "exited status=3"
+
+
+# ---------------------------------------------------------------------
+# scheduler machinery (cmd-mode children: no jax, no compiles)
+# ---------------------------------------------------------------------
+
+def test_scheduler_drains_and_quarantines_poison(tmp_path):
+    """A deterministic crasher is retried with backoff, then parked
+    in quarantine with its crash-cause journal — and the rest of the
+    queue drains to completion around it."""
+    q = Queue(str(tmp_path / "q")).ensure()
+    for i in range(3):
+        q.submit(make_spec(
+            f"ok{i}", cmd=sleeper_cmd(0.1, tmp_path / f"done{i}")))
+    q.submit(make_spec("poison",
+                       cmd=[sys.executable, "-c", "raise SystemExit(9)"],
+                       max_retries=2))
+    rc = Scheduler(q, workers=2, backoff_s=0.05, backoff_cap_s=0.1,
+                   log=quiet_log).run()
+    assert rc == EXIT_QUARANTINED
+    st = q.fold()
+    assert all(st[f"ok{i}"].state == "done" for i in range(3))
+    assert all((tmp_path / f"done{i}").exists() for i in range(3))
+    assert st["poison"].state == "quarantined"
+    assert st["poison"].crashes == 3          # 1 + max_retries
+    assert "crashes" in st["poison"].quarantine_cause
+    recs = CrashLog(q.crash_log_path("poison")).read()
+    assert len(recs) == 3
+    assert all(r["cause"] == "exited status=9" for r in recs)
+
+
+def test_scheduler_spawn_failure_is_a_run_crash(tmp_path):
+    """An unspawnable child (bad executable) is a crash of THAT run —
+    retried, then quarantined — never a scheduler death: the rest of
+    the queue keeps draining (the isolation guarantee)."""
+    q = Queue(str(tmp_path / "q")).ensure()
+    q.submit(make_spec("ghost", cmd=["/no/such/executable-xyz"],
+                       max_retries=1))
+    q.submit(make_spec("ok", cmd=sleeper_cmd(0.1, tmp_path / "done")))
+    rc = Scheduler(q, workers=2, backoff_s=0.05, log=quiet_log).run()
+    assert rc == EXIT_QUARANTINED
+    st = q.fold()
+    assert st["ok"].state == "done"
+    assert st["ghost"].state == "quarantined"
+    assert st["ghost"].crashes == 2
+    # the exec failure is journaled per attempt (via the claim-gate
+    # wrapper's crash exit, or _handle_spawn_failure for a Popen-time
+    # OSError) and the cause is in the run's log/crash journal
+    recs = CrashLog(q.crash_log_path("ghost")).read()
+    assert len(recs) == 2, recs
+    log_text = Path(q.log_path("ghost")).read_text(errors="replace")
+    assert ("No such file" in log_text
+            or any("spawn failed" in r["cause"] for r in recs))
+    assert q.claimed_ids() == []     # no claim leaked
+
+
+def test_scheduler_spontaneous_75_is_capped(tmp_path):
+    """A child that always exits 75 (EX_TEMPFAIL) without any
+    scheduler preemption is requeued with backoff and CAPPED — it
+    must not livelock the drain loop."""
+    q = Queue(str(tmp_path / "q")).ensure()
+    q.submit(make_spec("tempfail",
+                       cmd=[sys.executable, "-c",
+                            "raise SystemExit(75)"]))
+    rc = Scheduler(q, workers=1, backoff_s=0.02, backoff_cap_s=0.05,
+                   max_spont_preempts=2, log=quiet_log).run()
+    assert rc == EXIT_QUARANTINED
+    st = q.fold()["tempfail"]
+    assert st.state == "quarantined"
+    assert st.preemptions == 3           # cap + the final one
+    assert st.crashes == 0               # never miscounted as crashes
+    assert "livelock" in st.quarantine_cause
+
+
+def test_to_xml_refuses_inexpressible_bandwidth():
+    """Sub-KiB / non-KiB-multiple bandwidths cannot round-trip
+    through the whole-KiB XML schema — to_xml must fail loud instead
+    of silently simulating different bandwidths in the fleet's XML
+    copy."""
+    from shadow_tpu.core.config import HostSpec, Scenario
+    scen = Scenario(stop_time=10**9, topology_path="t.graphml",
+                    hosts=[HostSpec(id="a", bandwidth_down=1500)])
+    with pytest.raises(ValueError, match="whole-KiB"):
+        scen.to_xml()
+    scen.hosts[0].bandwidth_down = 2048
+    assert 'bandwidthdown="2"' in scen.to_xml()
+
+
+def test_to_xml_roundtrips_cpu_model():
+    """Scenario-level CPU-model overrides must survive the XML copy
+    the fleet queue runs (silently reverting to defaults would make
+    the fleet run simulate a different machine); the CLI only
+    overrides them when its flags depart from their defaults."""
+    from shadow_tpu.core.config import HostSpec, Scenario, load_xml
+    scen = Scenario(stop_time=10**9, topology_path="t.graphml",
+                    hosts=[HostSpec(id="a")],
+                    cpu_event_cost_ns=50_000, cpu_precision_ns=500,
+                    cpu_threshold_ns=2_000_000,
+                    cpu_raw_frequency_khz=1_000_000)
+    back = load_xml(scen.to_xml())
+    assert back.cpu_event_cost_ns == 50_000
+    assert back.cpu_precision_ns == 500
+    assert back.cpu_threshold_ns == 2_000_000
+    assert back.cpu_raw_frequency_khz == 1_000_000
+    # defaults stay implicit: a default scenario emits none of the
+    # extension attributes (reference-style files stay reference-style)
+    plain = Scenario(stop_time=10**9, topology_path="t.graphml",
+                     hosts=[HostSpec(id="a")])
+    assert "cpueventcostns" not in plain.to_xml()
+
+
+def test_scheduler_usage_error_quarantines_immediately(tmp_path):
+    """rc=2 is a deterministic usage error: retrying reproduces the
+    same message max_retries times over — quarantine on sight (the
+    engine.supervisor rule, fleet-side)."""
+    q = Queue(str(tmp_path / "q")).ensure()
+    q.submit(make_spec("usage",
+                       cmd=[sys.executable, "-c", "raise SystemExit(2)"],
+                       max_retries=5))
+    rc = Scheduler(q, workers=1, backoff_s=0.05, log=quiet_log).run()
+    assert rc == EXIT_QUARANTINED
+    st = q.fold()["usage"]
+    assert st.state == "quarantined" and st.crashes == 1
+    assert "usage error" in st.quarantine_cause
+
+
+def test_scheduler_admission_bounds_concurrency(tmp_path):
+    """Admission control: concurrent host-weight never exceeds the
+    budget, an oversized run degrades to 'queued' while the box is
+    busy — and still runs (alone) once it is free."""
+    q = Queue(str(tmp_path / "q")).ensure()
+    trace = tmp_path / "trace"
+
+    def tracked(rid, hosts):
+        body = (f"import time; f=open({str(trace)!r},'a'); "
+                f"f.write('+{hosts}\\n'); f.flush(); time.sleep(0.4); "
+                f"f.write('-{hosts}\\n'); f.flush()")
+        q.submit(make_spec(rid, cmd=[sys.executable, "-c", body],
+                           hosts=hosts))
+
+    tracked("small1", 4)
+    tracked("small2", 4)
+    tracked("oversized", 50)     # alone exceeds the budget
+    tracked("small3", 4)
+    rc = Scheduler(q, workers=3, max_hosts=10, backoff_s=0.05,
+                   log=quiet_log).run()
+    assert rc == EXIT_DRAINED
+    assert all(s.state == "done" for s in q.fold().values())
+    load = peak = 0
+    peaks = []
+    for line in trace.read_text().splitlines():
+        load += int(line) if line[0] == "+" else int(line)
+        peak = max(peak, load)
+        peaks.append(load)
+    # two smalls may overlap (8 <= 10); the oversized one must have
+    # run with nothing else on the box
+    assert peak <= 50, peaks
+    lines = trace.read_text().splitlines()
+    start50 = lines.index("+50")
+    assert sum(int(l) for l in lines[:start50]) == 0, (
+        "oversized run started while something else was running")
+    assert "-50" == lines[start50 + 1], (
+        "another run started while the oversized one was running")
+
+
+def test_scheduler_watchdog_kills_hung_run(tmp_path):
+    """A run with no progress signals is diagnosed hung and
+    SIGKILLed instead of wedging its slot forever."""
+    q = Queue(str(tmp_path / "q")).ensure()
+    q.submit(make_spec("hung", cmd=sleeper_cmd(60), max_retries=0))
+    q.submit(make_spec("ok", cmd=sleeper_cmd(0.1, tmp_path / "done")))
+    t0 = time.time()
+    rc = Scheduler(q, workers=2, hang_timeout_s=1.0, backoff_s=0.05,
+                   log=quiet_log).run()
+    assert time.time() - t0 < 30, "watchdog never fired"
+    assert rc == EXIT_QUARANTINED
+    st = q.fold()
+    assert st["ok"].state == "done"
+    assert st["hung"].state == "quarantined"
+    assert "hung" in st["hung"].last_cause
+    recs = CrashLog(q.crash_log_path("hung")).read()
+    assert any("watchdog" in r["cause"] for r in recs)
+
+
+def test_scheduler_preempt_requeues_and_resumes(tmp_path):
+    """SIGTERM-driven preemption: running children are stopped, their
+    runs requeued (never counted as crashes), the scheduler exits 75
+    — and a fresh scheduler completes the sweep."""
+    q = Queue(str(tmp_path / "q")).ensure()
+    marker = tmp_path / "attempt2"
+    # first attempt sleeps forever; after the marker exists (second
+    # attempt) it completes instantly — distinguishes re-dispatch
+    body = (f"import os, time, sys; "
+            f"sys.exit(0) if os.path.exists({str(marker)!r}) else None; "
+            f"open({str(marker)!r}, 'w').write('x'); time.sleep(60)")
+    q.submit(make_spec("r", cmd=[sys.executable, "-c", body]))
+    sched = Scheduler(q, workers=1, grace_s=2.0, backoff_s=0.05,
+                      log=quiet_log)
+    timer = threading.Timer(1.0, sched.request_preempt)
+    timer.start()
+    rc = sched.run()
+    timer.cancel()
+    assert rc == EXIT_PREEMPTED
+    st = q.fold()["r"]
+    assert st.state == "queued" and st.crashes == 0
+    assert st.preemptions == 1
+    rc = Scheduler(q, workers=1, backoff_s=0.05, log=quiet_log).run()
+    assert rc == EXIT_DRAINED
+    assert q.fold()["r"].state == "done"
+
+
+def test_scheduler_lock_excludes_second_scheduler(tmp_path):
+    q = Queue(str(tmp_path / "q")).ensure()
+    q.submit(make_spec("r", cmd=["true"]))
+    s1 = Scheduler(q, log=quiet_log)
+    s1._acquire_lock()            # we are the live "first" scheduler
+    try:
+        with pytest.raises(SchedulerLockError, match="one scheduler"):
+            Scheduler(q, log=quiet_log).run()
+    finally:
+        s1._release_lock()
+
+
+def test_scheduler_sigkill_recovery_cli(tmp_path):
+    """Crash-safety of the scheduler itself, end to end through the
+    CLI: SIGKILL `fleet run` mid-sweep, restart it, and the sweep
+    completes — in-flight runs are reclaimed (NOT counted as
+    crashes) via their stale claims, orphans killed."""
+    qdir = tmp_path / "q"
+
+    def fleet(*args, **kw):
+        return subprocess.run(
+            [sys.executable, "-m", "shadow_tpu", "fleet"] + list(args),
+            env=_env(), capture_output=True, text=True, **kw)
+
+    for i in range(3):
+        r = fleet("submit", str(qdir), "--cmd", "--id", f"s{i}", "--",
+                  sys.executable, "-c",
+                  "import time, sys; time.sleep(1.5); "
+                  f"open({str(tmp_path / f'done{i}')!r}, 'w')")
+        assert r.returncode == 0, r.stderr
+    p = subprocess.Popen(
+        [sys.executable, "-m", "shadow_tpu", "fleet", "run",
+         str(qdir), "--workers", "1", "--backoff", "0.05"],
+        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    q = Queue(str(qdir))
+    deadline = time.time() + 60
+    # wait for a JOURNALED start (a claim alone can precede it): the
+    # kill must interrupt a run the journal believes is running for
+    # the restart to exercise the reclaim path
+    while time.time() < deadline and not any(
+            st.state == "running" for st in q.fold().values()):
+        time.sleep(0.05)
+    assert any(st.state == "running" for st in q.fold().values()), (
+        "no run ever started")
+    os.kill(p.pid, signal.SIGKILL)
+    p.wait(timeout=30)
+    r = fleet("run", str(qdir), "--workers", "2", "--backoff", "0.05",
+              timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    st = q.fold()
+    assert all(st[f"s{i}"].state == "done" for i in range(3))
+    assert all(st[f"s{i}"].crashes == 0 for i in range(3)), (
+        "a reclaimed in-flight run was miscounted as a crash")
+    assert sum(st[f"s{i}"].reclaims for i in range(3)) >= 1
+    assert all((tmp_path / f"done{i}").exists() for i in range(3))
+
+
+def test_fleet_cli_status_and_xml_roundtrip(tmp_path):
+    """submit parses host counts from the XML for admission weights;
+    status folds; Scenario.to_xml round-trips through load_xml (the
+    fleet's self-contained-queue contract)."""
+    from shadow_tpu.core.config import load_xml
+    from shadow_tpu.fleet.cli import _count_hosts, main as fleet_main
+    xml = tmp_path / "scen.xml"
+    xml.write_text("""<shadow stoptime="6">
+  <topology path="nope.graphml"/>
+  <host id="a" quantity="5"><process plugin="phold" starttime="1"/></host>
+  <host id="b"><process plugin="phold" starttime="1"/></host>
+</shadow>""")
+    assert _count_hosts(str(xml)) == 6
+    qdir = str(tmp_path / "q")
+    assert fleet_main(["submit", qdir, str(xml), "--",
+                       "--seed", "9"]) == 0
+    st = Queue(qdir).fold()["scen"]
+    assert st.spec["hosts"] == 6
+    assert st.spec["args"] == ["--seed", "9"]
+    # the queue stored its own ABSOLUTE copy — the submitted file can
+    # vanish, and a later `fleet run` may start from a different cwd
+    assert st.spec["config"] != str(xml)
+    assert os.path.isabs(st.spec["config"])
+    assert os.path.exists(st.spec["config"])
+    assert fleet_main(["status", qdir]) == 0
+    # cmd-mode refuses the managed durability/perf flags instead of
+    # silently dropping them
+    with pytest.raises(SystemExit):
+        fleet_main(["submit", qdir, "--cmd", "--perf", "--", "true"])
+    # ...and config-mode refuses managed flags smuggled into the `--`
+    # tail (the worker's appended args would silently override them)
+    with pytest.raises(SystemExit):
+        fleet_main(["submit", qdir, str(xml), "--id", "clash", "--",
+                    "--digest", "/my/chain.jsonl"])
+
+    # to_xml round-trip on a representative scenario (faults, args,
+    # quantities, buffers)
+    scen = load_xml(str(xml))
+    scen2 = load_xml(scen.to_xml())
+    assert scen2.stop_time == scen.stop_time
+    assert [(h.id, h.quantity) for h in scen2.hosts] == [
+        ("a", 5), ("b", 1)]
+    from shadow_tpu.core.config import FaultSpec
+    scen.faults.append(FaultSpec(kind="loss", at=2 * 10**9,
+                                 until=4 * 10**9, rate=0.25,
+                                 src="a", dst="b"))
+    scen.hosts[0].processes[0].arguments = "port=9000 mean=300ms"
+    scen.hosts[0].socket_recv_buffer = 4096
+    scen3 = load_xml(scen.to_xml())
+    f = scen3.faults[0]
+    assert (f.kind, f.at, f.until, f.rate, f.src, f.dst) == (
+        "loss", 2 * 10**9, 4 * 10**9, 0.25, "a", "b")
+    assert scen3.hosts[0].processes[0].arguments == "port=9000 mean=300ms"
+    assert scen3.hosts[0].socket_recv_buffer == 4096
+
+
+# ---------------------------------------------------------------------
+# slow tier: real simulator runs under the scheduler
+# ---------------------------------------------------------------------
+
+TOPO = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="d7"/>
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d9"/>
+  <key attr.name="packetloss" attr.type="double" for="node" id="d0"/>
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d4"/>
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d3"/>
+  <graph edgedefault="undirected">
+    <node id="poi"><data key="d0">0.0</data>
+      <data key="d3">10240</data><data key="d4">10240</data></node>
+    <edge source="poi" target="poi"><data key="d7">25.0</data>
+      <data key="d9">0.0</data></edge>
+  </graph>
+</graphml>"""
+
+PHOLD_XML = f"""<shadow stoptime="6">
+  <topology><![CDATA[{TOPO}]]></topology>
+  <host id="node" quantity="8">
+    <process plugin="phold" starttime="1"
+             arguments="port=9000 mean=300ms size=64 init=1"/>
+  </host>
+</shadow>"""
+
+PHOLD_CAPS = "qcap=16,scap=4,obcap=8,incap=16,chunk=8"
+
+UPLOADER_SRC = """\
+import socket, time
+s = socket.create_connection(("server", 8080))
+for i in range(40):
+    s.send(b"x" * 4000)
+    time.sleep(0.25)
+s.close()
+print("done")
+"""
+
+HOSTED_CAPS = "qcap=32,scap=8,obcap=16,incap=32,hostedcap=16"
+
+FAULT_ARGS = ["--fault",
+              "kind=loss,at=2s,until=4s,rate=0.3,src=node1,dst=node2",
+              "--fault",
+              "kind=latency,at=4.5s,until=5.5s,extra=10ms,"
+              "src=node1,dst=node2"]
+
+
+def hosted_xml(tmp_path, tag):
+    script = tmp_path / "upload.py"
+    if not script.exists():
+        script.write_text(UPLOADER_SRC)
+    out = tmp_path / f"upload-{tag}.out"
+    xml = tmp_path / f"hosted-{tag}.xml"
+    xml.write_text(f"""<shadow stoptime="14">
+  <topology><![CDATA[{TOPO}]]></topology>
+  <host id="server">
+    <process plugin="bulkserver" starttime="1" arguments="port=8080"/>
+  </host>
+  <host id="client">
+    <process plugin="hosted:shim" starttime="2"
+             arguments="out={out} cmd={sys.executable} {script}"/>
+  </host>
+</shadow>""")
+    return xml, out
+
+
+def run_cli(args, extra_env=None, timeout=900):
+    p = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu"] + args,
+        env=_env(extra_env), cwd=str(REPO),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        timeout=timeout)
+    text = p.stdout.decode(errors="replace")
+    assert p.returncode == 0, f"CLI rc={p.returncode}:\n{text[-4000:]}"
+    return text
+
+
+def sweep_scenarios(tmp_path, tag, n_modeled=3, n_fault=1, n_hosted=1):
+    """(run_id, xml_path, extra_args, env) per scenario. `tag` keeps
+    the hosted out= files of two sweeps distinct (digest chains carry
+    no paths, so chains stay comparable)."""
+    phold = tmp_path / "phold.xml"
+    if not phold.exists():
+        phold.write_text(PHOLD_XML)
+    runs = []
+    for i in range(n_modeled):
+        runs.append((f"m{i}", phold,
+                     ["--seed", str(7 + i),
+                      "--engine-caps", PHOLD_CAPS], {}))
+    for i in range(n_fault):
+        runs.append((f"f{i}", phold,
+                     ["--seed", str(7 + i), "--engine-caps",
+                      PHOLD_CAPS] + FAULT_ARGS, {}))
+    for i in range(n_hosted):
+        xml, _out = hosted_xml(tmp_path, f"{tag}-{i}")
+        runs.append((f"h{i}", xml,
+                     ["--seed", str(7 + i),
+                      "--engine-caps", HOSTED_CAPS], {}))
+    return runs
+
+
+def reference_chains(tmp_path, runs):
+    """Uninterrupted single-CLI reference chain per scenario."""
+    chains = {}
+    for rid, xml, args, env in runs:
+        dg = tmp_path / f"ref-{rid}.jsonl"
+        run_cli([str(xml), "--digest", str(dg), "--digest-every", "8"]
+                + args, extra_env=env)
+        chains[rid] = dg.read_bytes()
+        assert chains[rid], f"reference {rid} recorded nothing"
+    return chains
+
+
+def submit_sweep(qdir, runs, order=None, max_retries=5):
+    q = Queue(str(qdir)).ensure()
+    items = [runs[i] for i in order] if order else runs
+    for rid, xml, args, env in items:
+        q.submit(make_spec(rid, config=str(xml), args=list(args),
+                           env=dict(env), checkpoint_every=1.0,
+                           digest_every=8, max_retries=max_retries))
+    return q
+
+
+def assert_chains_match(q, runs, reference):
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import divergence
+    finally:
+        sys.path.pop(0)
+    for rid, _xml, _args, _env in runs:
+        got = Path(q.digest_path(rid)).read_bytes()
+        assert got == reference[rid], (
+            f"run {rid}: sweep digest chain diverges from the "
+            "uninterrupted reference (tools/divergence.py the two "
+            "files)")
+        # and the structured verdict agrees (exit 0)
+        ref = Path(q.run_dir(rid)) / "_ref.jsonl"
+        ref.write_bytes(reference[rid])
+        assert divergence.main([str(ref), q.digest_path(rid)]) == 0
+
+
+@pytest.mark.slow
+def test_fleet_scheduling_order_independence(tmp_path):
+    """The same submitted sweep, shuffled queue order and different
+    worker counts, yields byte-identical per-run digest chains —
+    scheduling must not leak into results."""
+    runs = sweep_scenarios(tmp_path, "a", n_modeled=2, n_fault=1,
+                           n_hosted=0)
+    reference = reference_chains(tmp_path, runs)
+
+    q1 = submit_sweep(tmp_path / "q1", runs)
+    rc = Scheduler(q1, workers=1, backoff_s=0.1,
+                   log=quiet_log).run()
+    assert rc == EXIT_DRAINED
+    assert_chains_match(q1, runs, reference)
+
+    runs_b = sweep_scenarios(tmp_path, "b", n_modeled=2, n_fault=1,
+                             n_hosted=0)
+    q2 = submit_sweep(tmp_path / "q2", runs_b, order=[2, 0, 1])
+    rc = Scheduler(q2, workers=2, backoff_s=0.1,
+                   log=quiet_log).run()
+    assert rc == EXIT_DRAINED
+    assert_chains_match(q2, runs_b, reference)
+
+
+def _fleet_run_proc(qdir, workers=2):
+    # scheduler output to a FILE: an undrained PIPE would deadlock a
+    # long chaos drain against the 64 KiB pipe buffer
+    with open(str(qdir) + ".sched.log", "ab") as lf:
+        return subprocess.Popen(
+            [sys.executable, "-m", "shadow_tpu", "fleet", "run",
+             str(qdir), "--workers", str(workers), "--backoff", "0.2",
+             "--hang-timeout", "900"],
+            env=_env(), cwd=str(REPO),
+            stdout=lf, stderr=subprocess.STDOUT)
+
+
+def _wait_any_progress(q, exclude, timeout=900):
+    """Block until some claimed run (not in `exclude`) has digest
+    records — a kill landing then is guaranteed mid-run."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        for rid in q.claimed_ids():
+            if rid in exclude:
+                continue
+            try:
+                if os.path.getsize(q.digest_path(rid)) > 0:
+                    return rid
+            except OSError:
+                continue
+        time.sleep(0.2)
+    raise AssertionError("no claimed run ever made digest progress")
+
+
+@pytest.mark.slow
+def test_fleet_chaos_sweep_equivalence(tmp_path):
+    """ISSUE 7 acceptance: a ≥12-scenario sweep (modeled +
+    fault-schedule + hosted mix) under random worker and scheduler
+    SIGKILLs completes after restarts with every run's digest chain
+    byte-identical to an uninterrupted reference sweep; one planted
+    always-crashing scenario ends quarantined after max retries with
+    its crash-cause journaled, and the other runs all complete.
+
+    ~12 child compiles + the reference sweep: background-only on the
+    CPU dev box (SHADOW_TPU_FLEET_CHAOS_SMALL=1 shrinks it for
+    iterating on the harness itself)."""
+    import random
+    rnd = random.Random(7)
+    small = os.environ.get("SHADOW_TPU_FLEET_CHAOS_SMALL") == "1"
+    runs = sweep_scenarios(
+        tmp_path, "chaos",
+        n_modeled=2 if small else 6,
+        n_fault=1 if small else 3,
+        n_hosted=1 if small else 2)
+    reference = reference_chains(tmp_path, runs)
+
+    qdir = tmp_path / "q"
+    q = submit_sweep(qdir, runs, max_retries=5)
+    # the planted poison: a deterministic crasher (the durability
+    # CrashHook with no fire-once guard SIGKILLs it every attempt)
+    phold = tmp_path / "phold.xml"
+    q.submit(make_spec(
+        "poison", config=str(phold),
+        args=["--seed", "7", "--engine-caps", PHOLD_CAPS],
+        env={"SHADOW_TPU_CRASH_SIM_NS": "2000000000"},
+        checkpoint_every=1.0, digest_every=8, max_retries=1))
+
+    kills = {"worker": 1 if small else 3,
+             "scheduler": 1 if small else 2}
+    proc = _fleet_run_proc(qdir)
+    killed_pids = set()
+    while True:
+        rc = proc.poll()
+        if rc is not None:
+            states = q.fold()
+            live = [s for s in states.values()
+                    if s.state not in ("done", "quarantined")]
+            if not live:
+                break
+            assert rc != 0, "scheduler claimed success with live runs"
+            # scheduler died (we killed it): restart — the sweep must
+            # resume exactly where it stopped
+            proc = _fleet_run_proc(qdir)
+            continue
+        if kills["worker"] > 0:
+            rid = _wait_any_progress(q, exclude={"poison"})
+            claim = q.read_claim(rid) or {}
+            pid = claim.get("pid")
+            if pid and pid not in killed_pids:
+                time.sleep(rnd.uniform(0.0, 2.0))
+                try:
+                    os.kill(int(pid), signal.SIGKILL)
+                    killed_pids.add(pid)
+                    kills["worker"] -= 1
+                except OSError:
+                    pass
+            continue
+        if kills["scheduler"] > 0:
+            _wait_any_progress(q, exclude={"poison"})
+            time.sleep(rnd.uniform(0.0, 2.0))
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=30)
+            kills["scheduler"] -= 1
+            continue
+        time.sleep(0.5)
+    # final drain may end 3 (poison quarantined)
+    states = q.fold()
+    for rid, _xml, _args, _env in runs:
+        assert states[rid].state == "done", (
+            rid, states[rid].last_cause)
+    assert states["poison"].state == "quarantined", (
+        states["poison"].state, states["poison"].last_cause)
+    assert states["poison"].crashes == 2      # 1 + max_retries
+    recs = CrashLog(q.crash_log_path("poison")).read()
+    assert recs and all("SIGKILL" in r["cause"] for r in recs), recs
+    assert_chains_match(q, runs, reference)
+    # hosted children really re-ran to completion
+    for rid, xml, _args, _env in runs:
+        if rid.startswith("h"):
+            outs = list(tmp_path.glob(f"upload-chaos-*.out"))
+            assert outs and all("done" in o.read_text()
+                                for o in outs), outs

@@ -263,12 +263,34 @@ def run_config(name, n=None, stop=60, heartbeat=0.0, verbose=False,
     return out
 
 
+def emit_xml(name, path, n=None, stop=60):
+    """Write the named config as a self-contained shadow.config.xml
+    (core.config.Scenario.to_xml) and return the matching
+    ``--engine-caps`` string — how a baseline config becomes a fleet
+    run (``shadow_tpu fleet submit Q tor.xml -- --engine-caps ...``,
+    docs/fleet.md). The XML embeds the topology, so the file is
+    submittable from anywhere."""
+    builder, capf, n_default = CONFIGS[name]
+    n = n or n_default
+    scen = builder(n, stop)
+    cfg = capf(n)
+    with open(path, "w") as f:
+        f.write(scen.to_xml())
+    return (f"qcap={cfg.qcap},scap={cfg.scap},obcap={cfg.obcap},"
+            f"incap={cfg.incap},txqcap={cfg.txqcap},"
+            f"chunk={cfg.chunk_windows}")
+
+
 def main(argv):
     import argparse
     ap = argparse.ArgumentParser()
     ap.add_argument("config", choices=sorted(CONFIGS))
     ap.add_argument("--n", type=int, default=None)
     ap.add_argument("--stop", type=int, default=60)
+    ap.add_argument("--emit-xml", default=None, metavar="PATH",
+                    help="write the config as shadow.config.xml and "
+                         "print the matching --engine-caps string "
+                         "instead of running it (fleet submission)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the virtual CPU mesh platform")
     ap.add_argument("--verbose", action="store_true",
@@ -286,6 +308,12 @@ def main(argv):
                          "pass (A/B the pass-count batching; 1 = "
                          "one event per pass)")
     args = ap.parse_args(argv)
+    if args.emit_xml:
+        caps = emit_xml(args.config, args.emit_xml, n=args.n,
+                        stop=args.stop)
+        print(json.dumps({"config": args.config, "xml": args.emit_xml,
+                          "engine_caps": caps}))
+        return
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax

@@ -1,0 +1,143 @@
+#!/usr/bin/env python3
+"""Fleet chaos smoke (the verify skill's round-10 gate): submit a
+small sweep with a planted always-crashing config, SIGKILL one worker
+child AND the scheduler mid-flight, restart ``fleet run``, and assert
+
+- the sweep completes (exit 3: drained, poison quarantined),
+- the surviving runs' digest chains match an uninterrupted reference
+  (tools/divergence.py exit 0),
+- the poison ended quarantined with its crash-cause journal, without
+  stalling the queue.
+
+~6 CLI child processes, each paying the cold XLA compile on a CPU
+box (≈10-15 min there; minutes on chip). Usage:
+
+    python tools/fleet_smoke.py [workdir]    # default /tmp/fleet_smoke
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TOPO = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="d7"/>
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d9"/>
+  <key attr.name="packetloss" attr.type="double" for="node" id="d0"/>
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d4"/>
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d3"/>
+  <graph edgedefault="undirected">
+    <node id="poi"><data key="d0">0.0</data>
+      <data key="d3">10240</data><data key="d4">10240</data></node>
+    <edge source="poi" target="poi"><data key="d7">25.0</data>
+      <data key="d9">0.0</data></edge>
+  </graph></graphml>"""
+
+CAPS = "qcap=16,scap=4,obcap=8,incap=16,chunk=8"
+
+
+def main():
+    d = sys.argv[1] if len(sys.argv) > 1 else "/tmp/fleet_smoke"
+    os.makedirs(d, exist_ok=True)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    xml = os.path.join(d, "phold.xml")
+    with open(xml, "w") as f:
+        f.write(f"""<shadow stoptime="6">
+  <topology><![CDATA[{TOPO}]]></topology>
+  <host id="node" quantity="8">
+    <process plugin="phold" starttime="1"
+             arguments="port=9000 mean=300ms size=64 init=1"/>
+  </host>
+</shadow>""")
+
+    def sh(*a, **kw):
+        return subprocess.run(
+            [sys.executable, "-m", "shadow_tpu"] + list(a), env=env,
+            cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, **kw)
+
+    ref = os.path.join(d, "ref.jsonl")
+    r = sh(xml, "--seed", "7", "--engine-caps", CAPS,
+           "--digest", ref, "--digest-every", "8")
+    assert r.returncode == 0, r.stdout.decode()[-2000:]
+    print("reference done", flush=True)
+
+    q = os.path.join(d, "q")
+    for s in ("7", "8"):
+        r = sh("fleet", "submit", q, xml, "--id", f"m{s}",
+               "--checkpoint-every", "1", "--digest-every", "8",
+               "--", "--seed", s, "--engine-caps", CAPS)
+        assert r.returncode == 0, r.stdout.decode()
+    r = sh("fleet", "submit", q, xml, "--id", "poison",
+           "--max-retries", "1", "--checkpoint-every", "1",
+           "--env", "SHADOW_TPU_CRASH_SIM_NS=2000000000",
+           "--", "--seed", "7", "--engine-caps", CAPS)
+    assert r.returncode == 0, r.stdout.decode()
+
+    sched_log = os.path.join(d, "sched.log")
+
+    def fleet_run():
+        # scheduler output goes to a FILE, not a PIPE nobody drains —
+        # a long drain's log would fill the 64 KiB pipe buffer and
+        # deadlock the scheduler against our wait()
+        with open(sched_log, "ab") as lf:
+            return subprocess.Popen(
+                [sys.executable, "-m", "shadow_tpu", "fleet", "run",
+                 q, "--workers", "2", "--backoff", "0.2"], env=env,
+                cwd=REPO, stdout=lf, stderr=subprocess.STDOUT)
+
+    claims = os.path.join(q, "claims")
+
+    def wait_progress(deadline_s=900):
+        end = time.time() + deadline_s
+        while time.time() < end:
+            for fn in (os.listdir(claims)
+                       if os.path.isdir(claims) else []):
+                rid = fn[:-len(".claim")]
+                if rid == "poison":
+                    continue
+                dg = os.path.join(q, "runs", rid, "digest.jsonl")
+                if os.path.exists(dg) and os.path.getsize(dg) > 0:
+                    return rid
+            time.sleep(0.2)
+        raise AssertionError("no run made digest progress in time")
+
+    p = fleet_run()
+    rid = wait_progress()            # a real run is mid-flight now
+    with open(os.path.join(claims, rid + ".claim")) as f:
+        pid = json.load(f)["pid"]
+    os.kill(pid, signal.SIGKILL)
+    print(f"killed worker {rid} (pid {pid})", flush=True)
+    wait_progress()
+    os.kill(p.pid, signal.SIGKILL)
+    p.wait()
+    print("killed scheduler", flush=True)
+
+    p = fleet_run()                  # restart completes the sweep
+    rc = p.wait()
+    with open(sched_log, "rb") as f:
+        out = f.read().decode(errors="replace")
+    assert rc == 3, f"fleet run rc={rc} (want 3):\n{out[-3000:]}"
+
+    js = json.loads(sh("fleet", "status", q, "--json").stdout)
+    assert js["m7"]["state"] == "done", js["m7"]
+    assert js["m8"]["state"] == "done", js["m8"]
+    assert js["poison"]["state"] == "quarantined", js["poison"]
+    crash_log = os.path.join(q, "runs", "poison", "crash.jsonl")
+    assert os.path.getsize(crash_log) > 0, "no crash causes journaled"
+    drc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "divergence.py"),
+         ref, os.path.join(q, "runs", "m7", "digest.jsonl")],
+        env=env).returncode
+    assert drc == 0, f"divergence exit {drc} for m7"
+    print("FLEET-CHAOS-SMOKE-OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
