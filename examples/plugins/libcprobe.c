@@ -10,9 +10,12 @@
  * 2. sleeps sleep_ms via nanosleep + usleep + sleep (one third each)
  *    and reports the clock delta — under the sim the delta is SIM
  *    time (the process never burns wallclock);
- * 3. draws nrandom bytes from getrandom() AND /dev/urandom and prints
- *    them as hex — under the sim these come from the host's
- *    deterministic PRNG, so two runs print IDENTICAL lines;
+ * 3. draws nrandom bytes from getrandom() AND /dev/urandom (raw
+ *    open/read AND stdio fopen/fread — glibc's fopen bypasses the
+ *    open() interposition via an internal open, so the shim backs it
+ *    with fopencookie; ADVICE r5) and prints them as hex — under the
+ *    sim these come from the host's deterministic PRNG, so two runs
+ *    print IDENTICAL lines;
  * 4. tries pthread_create — under the sim it must FAIL (EAGAIN), not
  *    silently spawn a real thread;
  * 5. write()s to /dev/urandom — under the sim this must fail cleanly
@@ -25,6 +28,7 @@
  *   clocks mono=<s> real=<s> tod=<s> time=<s>
  *   slept requested=<s> measured=<s>
  *   entropy getrandom=<hex> urandom=<hex>
+ *   fentropy fopen=<hex>
  *   threads pthread_create=<rc>
  *   urandomwrite rc=<rc> errno=<errno>
  *   pollsleep requested=<s> measured=<s>
@@ -92,6 +96,19 @@ int main(int argc, char **argv) {
     hex(gr, nrand, grh);
     hex(ur, nrand, urh);
     printf("entropy getrandom=%s urandom=%s\n", grh, urh);
+
+    /* the stdio path: glibc's fopen never reaches the open()
+     * interposition (internal __open), so this is the one entropy
+     * route only the fopen/fopen64 interposition covers */
+    unsigned char fe[64];
+    char feh[129];
+    memset(fe, 0, sizeof fe);
+    FILE *sf = fopen("/dev/urandom", "r");
+    if (!sf || fread(fe, 1, (size_t)nrand, sf) != (size_t)nrand)
+        perror("fopen urandom");
+    if (sf) fclose(sf);
+    hex(fe, nrand, feh);
+    printf("fentropy fopen=%s\n", feh);
 
     pthread_t th;
     int rc = pthread_create(&th, NULL, thread_main, NULL);

@@ -187,14 +187,14 @@ class Supervisor:
             attempt += 1
             argv = self._child_argv(attempt)
             resumed = attempt > 1
-            t0 = time.perf_counter()
+            t0 = time.perf_counter()  # simlint: ok DET101 -- attempt wall for the crash journal, not sim time
             _s0 = TR.TRACER.now() if TR.ENABLED else None
             try:
                 rc = subprocess.call(argv)
             except KeyboardInterrupt:
                 # the operator killed US: do not respawn under them
                 raise
-            wall = time.perf_counter() - t0
+            wall = time.perf_counter() - t0  # simlint: ok DET101 -- attempt wall for the crash journal, not sim time
             cause = classify_exit(rc)
             if TR.ENABLED:
                 TR.TRACER.complete(

@@ -659,11 +659,14 @@ class ShimApp(HostedApp):
                 # per-op protocol metrics: count + HANDLER latency (a
                 # call that parks is counted when it arrives; the
                 # sim-time it stays parked is not wall cost)
+                # simlint: ok DET101 -- op-handler latency metric (wall-side)
                 _t0 = _time.perf_counter_ns() if _MT.ENABLED else None
                 self._handle(os, *req)
                 if _t0 is not None:
-                    _MT.shim_op(OP_NAMES.get(req[0], str(req[0])),
-                                _time.perf_counter_ns() - _t0)
+                    _MT.shim_op(
+                        OP_NAMES.get(req[0], str(req[0])),
+                        # simlint: ok DET101 -- op latency metric (wall)
+                        _time.perf_counter_ns() - _t0)
         except ShimHang as e:
             self._supervise_kill(os, f"hung: {e}")
         except ShimProtocolError as e:

@@ -1,0 +1,35 @@
+"""simlint: determinism & tracing-hazard static analysis.
+
+Shadow's value proposition is bit-deterministic simulation, and every
+regression class this repo has actually hit is *statically
+detectable*: wallclock leaking into sim code, entropy bypassing the
+interposer, the C/Python shim opcode tables drifting apart, and
+trace-time Python leaking host state into compiled windows. The
+reference enforces these invariants by convention inside one C
+codebase; our split Python/JAX + C-preload design enforces them with
+this machine-checked gate instead (tests/test_lint.py runs it in
+tier-1, .github/workflows/ci.yml on every push).
+
+Three check families (docs/static-analysis.md has the rule catalog):
+
+- ``determinism``  (DET1xx): wallclock, unseeded RNG, os.urandom,
+  PYTHONHASHSEED-sensitive ``hash()``, unordered set iteration — over
+  ``engine/``, ``net/``, ``core/``, ``obs/``, ``hosting/``.
+- ``tracing``      (TRC1xx): JAX tracing hazards inside jit-reachable
+  code (``.item()``, trace-time ``int()``/``float()``, host-numpy
+  materialization, ``if`` on arrays, closures over mutable module
+  globals, unhashable static_argnums) — over ``engine/``, ``net/``,
+  ``parallel/``, ``core/``.
+- ``shimproto``    (SHIM2xx): C<->Python shim protocol conformance
+  (``hosting/shim_preload.c`` vs ``hosting/shim.py``: OP_* names,
+  values, struct layouts, payload-framing agreement).
+
+This package deliberately imports NOTHING outside the stdlib (no jax,
+no numpy): ``python -m tools.simlint`` must stay a sub-second gate.
+The ``tools.simlint`` wrapper loads it without triggering the
+``shadow_tpu`` package __init__ (which imports jax).
+"""
+
+from .core import (  # noqa: F401
+    RULES, Violation, load_baseline, write_baseline)
+from .cli import main, run_lint  # noqa: F401

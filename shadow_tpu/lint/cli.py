@@ -1,0 +1,172 @@
+"""simlint CLI: run the suite, report, gate against the baseline.
+
+Usage (from the repo root)::
+
+    python -m tools.simlint                  # the tier-1/CI gate
+    python -m tools.simlint --list-rules     # rule catalog
+    python -m tools.simlint --fix-baseline   # pin current violations
+    python -m tools.simlint --json           # machine-readable report
+
+Exit codes: 0 clean (every violation fixed, suppressed with
+justification, or baselined), 1 violations (new findings OR stale
+baseline entries), 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import determinism, shimproto, tracing
+from .core import (RULES, SourceCache, apply_allowlist,
+                   apply_suppressions, diff_baseline, fill_snippets,
+                   load_baseline, write_baseline)
+
+DEFAULT_BASELINE = "tools/simlint/baseline.json"
+
+
+def find_root(start: str = None) -> str:
+    """Locate the repo root: the nearest ancestor holding
+    shadow_tpu/."""
+    d = os.path.abspath(start or os.getcwd())
+    while True:
+        if os.path.isdir(os.path.join(d, "shadow_tpu")):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            print("simlint: cannot locate the repo root (no "
+                  "shadow_tpu/ above the working directory); pass "
+                  "--root", file=sys.stderr)
+            raise SystemExit(2)
+        d = parent
+
+
+def collect(cache: SourceCache) -> list:
+    """All three families, raw (pre-suppression/baseline)."""
+    out = []
+    out.extend(determinism.check(cache))
+    out.extend(tracing.check(cache))
+    out.extend(shimproto.check(cache))
+    return out
+
+
+def run_lint(root: str, baseline_path: str = None,
+             fix_baseline: bool = False) -> dict:
+    """Run the full suite. Returns a report dict (see keys below);
+    `exit_code` is the gate verdict."""
+    cache = SourceCache(root)
+    scanned = (cache.py_files(determinism.SCOPE)
+               + cache.py_files(tracing.SCOPE))
+    if not scanned:
+        # an empty scan would pass VACUOUSLY — a wrong --root or a
+        # renamed scope must be an error, never a green gate
+        print(f"simlint: nothing to scan under {root!r} (no Python "
+              "files in the lint scopes); wrong --root?",
+              file=sys.stderr)
+        raise SystemExit(2)
+    raw = collect(cache)
+    fill_snippets(raw, cache.lines)
+    active, suppressed, unjustified = apply_suppressions(
+        raw, cache.lines)
+    active, allowed = apply_allowlist(active)
+    active.extend(unjustified)
+
+    if baseline_path is None:
+        baseline_path = os.path.join(root, DEFAULT_BASELINE)
+    baseline = load_baseline(baseline_path)
+
+    if fix_baseline:
+        n = write_baseline(baseline_path, active, baseline)
+        return {"exit_code": 0, "fixed_baseline": n,
+                "baseline_path": baseline_path, "new": [],
+                "stale": [], "baselined": len(active),
+                "suppressed": suppressed, "allowed": allowed,
+                "total": len(active)}
+
+    new, baselined, stale = diff_baseline(active, baseline)
+    new.sort(key=lambda v: (v.file, v.line, v.rule))
+    stale.sort(key=lambda v: (v.file, v.snippet))
+    return {"exit_code": 1 if (new or stale) else 0,
+            "baseline_path": baseline_path,
+            "new": new, "stale": stale, "baselined": baselined,
+            "suppressed": suppressed, "allowed": allowed,
+            "total": len(active)}
+
+
+def _print_report(report: dict, as_json: bool):
+    if as_json:
+        out = {k: ([dataclasses_asdict(v) for v in report[k]]
+                   if k in ("new", "stale") else report[k])
+               for k in report}
+        print(json.dumps(out, indent=1))
+        return
+    if "fixed_baseline" in report:
+        print(f"simlint: baseline rewritten with "
+              f"{report['fixed_baseline']} entries "
+              f"({report['baseline_path']})")
+        return
+    for v in report["new"]:
+        print(v.render())
+    for v in report["stale"]:
+        print(v.render())
+    status = "FAIL" if report["exit_code"] else "clean"
+    print(f"simlint: {status} — {len(report['new'])} new, "
+          f"{len(report['stale'])} stale baseline entries "
+          f"({report['baselined']} baselined, "
+          f"{report['suppressed']} suppressed inline, "
+          f"{report['allowed']} allowlisted)")
+
+
+def dataclasses_asdict(v):
+    return {"rule": v.rule, "file": v.file, "line": v.line,
+            "message": v.message, "snippet": v.snippet}
+
+
+def _list_rules():
+    for rid in sorted(RULES):
+        r = RULES[rid]
+        print(f"{rid}  {r['summary']}")
+        print(f"        fix: {r['hint']}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="simlint",
+        description="shadow-tpu determinism & tracing-hazard static "
+                    "analysis (docs/static-analysis.md)")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: auto-detect upward)")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline path (default: {DEFAULT_BASELINE})")
+    p.add_argument("--fix-baseline", action="store_true",
+                   help="pin every current violation into the "
+                        "baseline and exit 0 (one-command adoption)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    try:
+        args = p.parse_args(argv)
+    except SystemExit as e:
+        return 0 if e.code in (0, None) else 2
+    if args.list_rules:
+        _list_rules()
+        return 0
+    try:
+        root = args.root or find_root()
+        report = run_lint(root, baseline_path=args.baseline,
+                          fix_baseline=args.fix_baseline)
+    except SystemExit:
+        raise
+    except Exception as e:  # internal error: distinct exit code
+        print(f"simlint: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    _print_report(report, args.json)
+    return report["exit_code"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

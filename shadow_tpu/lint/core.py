@@ -1,0 +1,303 @@
+"""simlint core: rule registry, violations, suppressions, baseline.
+
+Shared mechanics for the three check families. A violation is keyed by
+``(rule, file, snippet)`` — the stripped source line, NOT the line
+number — so unrelated edits above a baselined site do not churn the
+baseline (tools/simlint/baseline.json), while any edit to the flagged
+line itself surfaces the violation again for a fresh look.
+
+Suppression: a violation is silenced by an inline comment on the same
+line (or the line directly above)::
+
+    t0 = time.perf_counter()  # simlint: ok DET101 -- wall attribution
+
+The justification after ``--`` (or an em dash, or parentheses) is
+REQUIRED: a bare ``simlint: ok`` is itself a violation (LNT001). The
+allowlist below covers whole files whose *purpose* is the flagged
+behavior (wall-clock observability), so their every line doesn't need
+a comment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+
+# --- rule registry (docs/static-analysis.md mirrors this catalog) ----
+
+RULES: dict[str, dict] = {}
+
+
+def rule(rid: str, summary: str, hint: str) -> str:
+    RULES[rid] = {"summary": summary, "hint": hint}
+    return rid
+
+
+LNT001 = rule(
+    "LNT001", "simlint suppression without a justification",
+    "write `# simlint: ok <RULE> -- <why this site is legitimate>`")
+LNT002 = rule(
+    "LNT002", "stale baseline entry (violation no longer present)",
+    "the underlying violation was fixed — remove the entry from "
+    "tools/simlint/baseline.json (or run --fix-baseline)")
+
+# Whole-file allowlist: rule -> {repo-relative posix path: why}. These
+# files' PURPOSE is the flagged behavior; per-line suppressions would
+# be noise. Anything else must suppress inline or baseline.
+ALLOW: dict[str, dict[str, str]] = {
+    "DET101": {
+        "shadow_tpu/obs/trace.py":
+            "wall-clock span recorder: perf_counter IS the product",
+        "shadow_tpu/obs/metrics.py":
+            "wall-clock latency histograms: timing IS the product",
+        "shadow_tpu/obs/perf.py":
+            "wall-clock phase attribution: timing IS the product",
+        "shadow_tpu/obs/tracker.py":
+            "heartbeat wall/realtime-ratio reporting",
+        "shadow_tpu/obs/logger.py":
+            "wall-clock progress log timestamps",
+        "shadow_tpu/obs/ledger.py":
+            "perf ledger stamps wall times of finished runs",
+    },
+}
+
+
+@dataclasses.dataclass
+class Violation:
+    rule: str
+    file: str          # repo-relative posix path
+    line: int
+    message: str
+    snippet: str = ""  # stripped source line at `line` (baseline key)
+    hint: str = ""
+
+    @property
+    def key(self):
+        return (self.rule, self.file, self.snippet)
+
+    def render(self) -> str:
+        hint = self.hint or RULES.get(self.rule, {}).get("hint", "")
+        tail = f"  [fix: {hint}]" if hint else ""
+        return f"{self.file}:{self.line}: {self.rule} {self.message}{tail}"
+
+
+def fill_snippets(violations, lines_of):
+    """Stamp each violation's snippet from its source line. `lines_of`
+    maps repo-relative path -> list of line strings (or None).
+
+    Violations without a source line (line 0 — the SHIM2xx conformance
+    family) key by their MESSAGE instead: an empty snippet would
+    collapse every such violation in a file to one baseline key, and a
+    pinned entry would then silently absorb any later, *different*
+    drift of the same rule."""
+    for v in violations:
+        if v.snippet:
+            continue
+        lines = lines_of(v.file)
+        if lines and 1 <= v.line <= len(lines):
+            v.snippet = lines[v.line - 1].strip()[:200]
+        else:
+            v.snippet = v.message[:200]
+
+
+# --- inline suppressions ---------------------------------------------
+
+# `# simlint: ok DET101` / `ok DET101,TRC103 -- reason` / `(reason)`
+_SUPPRESS_RE = re.compile(
+    r"simlint:\s*ok\s+(?P<rules>[A-Z]{3,4}\d{3}(?:\s*,\s*[A-Z]{3,4}\d{3})*)"
+    r"\s*(?:(?:--|—|–|[(])\s*(?P<why>[^)]*))?")
+
+
+def _suppressions_at(lines, lineno):
+    """Suppression directives covering `lineno`: the line itself or
+    the line directly above. -> (set of rule ids, has_justification)"""
+    rules, justified = set(), True
+    for ln in (lineno, lineno - 1):
+        if not (1 <= ln <= len(lines)):
+            continue
+        m = _SUPPRESS_RE.search(lines[ln - 1])
+        if m:
+            rules |= {r.strip() for r in m.group("rules").split(",")}
+            if not (m.group("why") or "").strip():
+                justified = False
+    return rules, justified
+
+
+def apply_suppressions(violations, lines_of):
+    """Filter inline-suppressed violations. Returns (active,
+    suppressed_count, extra) where extra holds LNT001 violations for
+    suppressions missing a justification."""
+    active, extra, suppressed = [], [], 0
+    flagged_unjustified = set()
+    for v in violations:
+        lines = lines_of(v.file)
+        if not lines:
+            active.append(v)
+            continue
+        rules, justified = _suppressions_at(lines, v.line)
+        if v.rule in rules:
+            if justified:
+                suppressed += 1
+            else:
+                sup_key = (v.file, v.line)
+                if sup_key not in flagged_unjustified:
+                    flagged_unjustified.add(sup_key)
+                    extra.append(Violation(
+                        "LNT001", v.file, v.line,
+                        f"suppression of {v.rule} has no justification",
+                        snippet=lines[v.line - 1].strip()[:200]))
+                suppressed += 1
+        else:
+            active.append(v)
+    return active, suppressed, extra
+
+
+def apply_allowlist(violations):
+    """Drop violations covered by the whole-file ALLOW map."""
+    kept, allowed = [], 0
+    for v in violations:
+        if v.file in ALLOW.get(v.rule, {}):
+            allowed += 1
+        else:
+            kept.append(v)
+    return kept, allowed
+
+
+# --- baseline --------------------------------------------------------
+
+def load_baseline(path: str) -> dict:
+    """baseline.json -> {key: entry}. Missing file = empty baseline."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    out = {}
+    for e in data.get("entries", []):
+        key = (e["rule"], e["file"], e.get("snippet", ""))
+        if key in out:
+            out[key]["count"] += int(e.get("count", 1))
+        else:
+            out[key] = {"count": int(e.get("count", 1)),
+                        "justification": e.get("justification", "")}
+    return out
+
+
+def diff_baseline(violations, baseline):
+    """Split current violations against the pinned baseline.
+
+    -> (new_violations, baselined_count, stale) where stale is a list
+    of LNT002 violations: baseline entries whose violation count
+    DROPPED (fixed ones must be removed from the baseline, so the
+    pinned debt only ever shrinks deliberately)."""
+    by_key: dict[tuple, list] = {}
+    for v in violations:
+        by_key.setdefault(v.key, []).append(v)
+    new, baselined = [], 0
+    for key, vs in by_key.items():
+        allowed = baseline.get(key, {}).get("count", 0)
+        vs_sorted = sorted(vs, key=lambda v: v.line)
+        baselined += min(allowed, len(vs))
+        new.extend(vs_sorted[allowed:])
+    stale = []
+    for key, entry in baseline.items():
+        have = len(by_key.get(key, ()))
+        if have < entry["count"]:
+            rid, file, snippet = key
+            stale.append(Violation(
+                "LNT002", file, 0,
+                f"baselined {rid} x{entry['count']} but only {have} "
+                f"remain (snippet: {snippet[:60]!r})",
+                snippet=snippet))
+    return new, baselined, stale
+
+
+def write_baseline(path: str, violations, old_baseline) -> int:
+    """--fix-baseline: pin the CURRENT violation set. Justifications of
+    surviving entries are preserved; new entries get a placeholder that
+    a reviewer is expected to replace. Returns the entry count.
+
+    LNT meta-violations are never pinned: baselining an LNT001
+    (suppression without justification) would permanently defeat the
+    justification requirement through the one-command adoption path."""
+    by_key: dict[tuple, int] = {}
+    for v in violations:
+        if v.rule.startswith("LNT"):
+            continue
+        by_key[v.key] = by_key.get(v.key, 0) + 1
+    entries = []
+    for (rid, file, snippet), count in sorted(by_key.items()):
+        just = old_baseline.get((rid, file, snippet), {}).get(
+            "justification") or ("pre-existing violation pinned by "
+                                 "--fix-baseline; justify or fix")
+        entries.append({"rule": rid, "file": file, "snippet": snippet,
+                        "count": count, "justification": just})
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"version": 1, "entries": entries}, f, indent=1)
+        f.write("\n")
+    return len(entries)
+
+
+# --- source cache ----------------------------------------------------
+
+class SourceCache:
+    """Read-once cache of repo files: text, split lines, parsed AST."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._text: dict[str, str | None] = {}
+        self._lines: dict[str, list | None] = {}
+        self._tree: dict[str, object] = {}
+
+    def rel(self, path: str) -> str:
+        return os.path.relpath(os.path.abspath(path),
+                               self.root).replace(os.sep, "/")
+
+    def text(self, relpath: str):
+        if relpath not in self._text:
+            full = os.path.join(self.root, relpath)
+            try:
+                with open(full, encoding="utf-8",
+                          errors="replace") as f:
+                    self._text[relpath] = f.read()
+            except OSError:
+                self._text[relpath] = None
+        return self._text[relpath]
+
+    def lines(self, relpath: str):
+        if relpath not in self._lines:
+            text = self.text(relpath)
+            self._lines[relpath] = (None if text is None
+                                    else text.splitlines())
+        return self._lines[relpath]
+
+    def tree(self, relpath: str):
+        """Parsed AST of a Python source, or a SyntaxError instance,
+        cached (both check families scan overlapping scopes)."""
+        if relpath not in self._tree:
+            import ast
+            text = self.text(relpath)
+            if text is None:
+                self._tree[relpath] = None
+            else:
+                try:
+                    self._tree[relpath] = ast.parse(text)
+                except SyntaxError as e:
+                    self._tree[relpath] = e
+        return self._tree[relpath]
+
+    def py_files(self, subdirs) -> list:
+        """Repo-relative .py paths under the given subdirectories,
+        sorted for deterministic report order."""
+        out = []
+        for sub in subdirs:
+            base = os.path.join(self.root, sub)
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d != "__pycache__")
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(self.rel(os.path.join(dirpath, fn)))
+        return out

@@ -1,0 +1,218 @@
+"""Determinism lints (DET1xx): host nondeterminism leaking into sim.
+
+Every rule here corresponds to a regression class the repo (or the
+reference) has actually hit — see docs/static-analysis.md for the
+catalog with examples. Scope: ``engine/``, ``net/``, ``core/``,
+``obs/``, ``hosting/`` (bench/fleet/tools intentionally excluded:
+wall-clock scheduling and reporting is their job).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Violation, rule
+from .names import AliasMap
+
+DET100 = rule(
+    "DET100", "unparseable Python source in a linted scope",
+    "fix the syntax error; an unscannable file is an unverified file")
+DET101 = rule(
+    "DET101", "wallclock read in sim code",
+    "sim code must read simulated time (HostOS.now / sim_ns); wall "
+    "reads belong in obs/ reporting — suppress with justification if "
+    "this is genuinely wall-side")
+DET102 = rule(
+    "DET102", "unseeded / module-global RNG",
+    "draw from the seeded per-host stream (core.rng / "
+    "np.random.default_rng(seed)); the module-global RNG is shared "
+    "mutable state whose draw order is a determinism hazard")
+DET103 = rule(
+    "DET103", "OS entropy bypasses the deterministic PRNG",
+    "os.urandom/secrets/uuid4/SystemRandom read kernel entropy; use "
+    "the seeded PRNG (core.rng, HostOS.random_bytes)")
+DET104 = rule(
+    "DET104", "builtin hash() feeds state (PYTHONHASHSEED hazard)",
+    "hash(str/bytes) differs per process unless PYTHONHASHSEED is "
+    "pinned; use hashlib (blake2b) for anything stored, compared or "
+    "ordered")
+DET105 = rule(
+    "DET105", "iteration over an unordered set",
+    "set iteration order depends on PYTHONHASHSEED for str elements; "
+    "wrap in sorted(...) before anything order-sensitive (event "
+    "ordering, digest input, emitted records)")
+
+# scan scope, repo-relative
+SCOPE = ("shadow_tpu/engine", "shadow_tpu/net", "shadow_tpu/core",
+         "shadow_tpu/obs", "shadow_tpu/hosting")
+
+_WALLCLOCK = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.clock_gettime",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+}
+
+# module-global `random.*` draws (anything on the module RNG); the
+# class constructors are fine WITH a seed argument
+_RANDOM_OK = {"random.Random", "random.getstate", "random.setstate"}
+_NP_RANDOM_SEEDED_OK = {"numpy.random.default_rng",
+                        "numpy.random.RandomState",
+                        "numpy.random.Generator",
+                        "numpy.random.SeedSequence",
+                        "numpy.random.PCG64", "numpy.random.Philox"}
+
+_ENTROPY = {"os.urandom", "os.getrandom", "random.SystemRandom",
+            "uuid.uuid4", "uuid.uuid1"}
+
+
+def _is_number(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float, complex, bool))
+    if isinstance(node, ast.UnaryOp):
+        return _is_number(node.operand)
+    if isinstance(node, ast.Tuple):
+        return all(_is_number(e) for e in node.elts)
+    return False
+
+
+class _DetVisitor(ast.NodeVisitor):
+    def __init__(self, relpath: str, tree: ast.AST):
+        self.relpath = relpath
+        self.aliases = AliasMap(tree, relpath)
+        self.violations: list[Violation] = []
+        # statement-expression hash() calls are hashability PROBES
+        # (result discarded, e.g. core/jitcache.py) — not state
+        self._discarded: set[int] = {
+            id(n.value) for n in ast.walk(tree)
+            if isinstance(n, ast.Expr)}
+        # per-function names assigned a set expression (DET105)
+        self._set_locals: list[set] = [set()]
+
+    def _emit(self, rid, node, message):
+        self.violations.append(
+            Violation(rid, self.relpath, node.lineno, message))
+
+    # --- calls -------------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        dotted = self.aliases.resolve(node.func)
+        if dotted:
+            self._check_call(node, dotted)
+        self.generic_visit(node)
+
+    def _check_call(self, node: ast.Call, dotted: str):
+        if dotted in _WALLCLOCK:
+            self._emit(DET101, node, f"`{dotted}()` reads the wall "
+                       "clock in sim code")
+            return
+        if dotted in _ENTROPY:
+            self._emit(DET103, node, f"`{dotted}` draws OS entropy, "
+                       "bypassing the deterministic PRNG")
+            return
+        if dotted.startswith("random.") and dotted not in _RANDOM_OK:
+            if dotted == "random.seed":
+                self._emit(DET102, node, "`random.seed` configures the "
+                           "process-global RNG; use an owned "
+                           "random.Random(seed) instance")
+            else:
+                self._emit(DET102, node, f"`{dotted}()` draws from the "
+                           "module-global RNG")
+            return
+        if dotted == "random.Random" and not node.args:
+            self._emit(DET102, node, "`random.Random()` without a seed")
+            return
+        if dotted.startswith("numpy.random."):
+            if dotted in _NP_RANDOM_SEEDED_OK:
+                if not node.args and not node.keywords:
+                    self._emit(DET102, node, f"`{dotted}()` without a "
+                               "seed draws OS entropy")
+            else:
+                self._emit(DET102, node, f"`{dotted}()` uses numpy's "
+                           "module-global RNG")
+            return
+        if dotted == "hash" and id(node) not in self._discarded:
+            arg = node.args[0] if node.args else None
+            if arg is not None and not _is_number(arg):
+                self._emit(DET104, node, "builtin `hash()` result is "
+                           "used; str/bytes hashes vary per process "
+                           "(PYTHONHASHSEED)")
+
+    # --- set iteration (DET105) --------------------------------------
+    def _is_set_expr(self, node: ast.AST) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call):
+            dotted = self.aliases.resolve(node.func)
+            if dotted in ("set", "frozenset"):
+                return True
+        if (isinstance(node, ast.Name)
+                and node.id in self._set_locals[-1]):
+            return True
+        if isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.BitOr, ast.BitAnd, ast.Sub)):
+            # set algebra: s1 | s2, s & t, s - t on known sets
+            return (self._is_set_expr(node.left)
+                    or self._is_set_expr(node.right))
+        return False
+
+    def _check_iter(self, iter_node: ast.AST):
+        if self._is_set_expr(iter_node):
+            self._emit(DET105, iter_node, "iterating an unordered set")
+
+    def visit_For(self, node: ast.For):
+        self._check_iter(node.iter)
+        self.generic_visit(node)
+
+    def _visit_comp(self, node):
+        for gen in node.generators:
+            self._check_iter(gen.iter)
+        self.generic_visit(node)
+
+    visit_ListComp = visit_SetComp = visit_GeneratorExp = _visit_comp
+
+    def visit_DictComp(self, node):
+        self._visit_comp(node)
+
+    # --- local set tracking ------------------------------------------
+    def visit_Assign(self, node: ast.Assign):
+        if (len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            name = node.targets[0].id
+            if self._is_set_expr(node.value):
+                self._set_locals[-1].add(name)
+            else:
+                self._set_locals[-1].discard(name)
+        self.generic_visit(node)
+
+    def _visit_func(self, node):
+        self._set_locals.append(set())
+        self.generic_visit(node)
+        self._set_locals.pop()
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _visit_func
+
+
+def check_source(relpath: str, text: str, tree=None) -> list:
+    """Lint one Python source for determinism hazards."""
+    if tree is None:
+        try:
+            tree = ast.parse(text)
+        except SyntaxError as e:
+            tree = e
+    if isinstance(tree, SyntaxError):
+        return [Violation("DET100", relpath, tree.lineno or 0,
+                          f"unparseable source: {tree.msg}")]
+    v = _DetVisitor(relpath, tree)
+    v.visit(tree)
+    return v.violations
+
+
+def check(cache) -> list:
+    """Run the determinism family over its scope. `cache` is a
+    core.SourceCache rooted at the repo."""
+    out = []
+    for rel in cache.py_files(SCOPE):
+        tree = cache.tree(rel)
+        if tree is not None:
+            out.extend(check_source(rel, cache.text(rel), tree))
+    return out

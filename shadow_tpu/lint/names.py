@@ -1,0 +1,101 @@
+"""Dotted-name resolution over one module's AST.
+
+Both check families need to answer "what does this call expression
+actually refer to?" through import aliases::
+
+    import time as _time          _time.perf_counter() -> time.perf_counter
+    import numpy as np            np.random.rand()     -> numpy.random.rand
+    from datetime import datetime datetime.now()       -> datetime.datetime.now
+    from ..core.jitcache import AotJit   AotJit(f)     -> shadow_tpu.core.jitcache.AotJit
+
+Resolution is purely lexical (no execution): aliases are collected
+from EVERY import statement in the file (module or function level —
+this codebase imports lazily inside functions a lot), which
+over-approximates scoping but is exactly right for lint purposes.
+"""
+
+from __future__ import annotations
+
+import ast
+
+
+def module_name_of(relpath: str) -> str:
+    """Repo-relative path -> dotted module name
+    (shadow_tpu/engine/window.py -> shadow_tpu.engine.window)."""
+    mod = relpath[:-3] if relpath.endswith(".py") else relpath
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def _resolve_relative(module: str | None, level: int,
+                      pkg: str) -> str | None:
+    """`from ..core import x` inside package `pkg` -> absolute module."""
+    if level == 0:
+        return module
+    parts = pkg.split(".")
+    if level > len(parts):
+        return None
+    base = parts[: len(parts) - (level - 1)]
+    if module:
+        base.append(module)
+    return ".".join(base)
+
+
+class AliasMap:
+    """local name -> absolute dotted target for one module."""
+
+    def __init__(self, tree: ast.AST, relpath: str):
+        self.module = module_name_of(relpath)
+        # the package this module's relative imports resolve against
+        self.package = (self.module if relpath.endswith("__init__.py")
+                        else self.module.rsplit(".", 1)[0]
+                        if "." in self.module else self.module)
+        self.aliases: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    # `import a.b.c` binds `a`; `import a.b as x` binds
+                    # x -> a.b
+                    self.aliases[local] = (a.name if a.asname
+                                           else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                src = _resolve_relative(node.module, node.level,
+                                        self.package)
+                if src is None:
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    local = a.asname or a.name
+                    self.aliases[local] = f"{src}.{a.name}"
+
+    def resolve(self, node: ast.AST) -> str | None:
+        """Expression -> absolute dotted name, or None. Handles Name
+        and Attribute chains rooted at an imported alias; a bare Name
+        that is not an import alias resolves to itself (builtins,
+        locals) so callers can match e.g. `hash`."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        parts.reverse()
+        head = self.aliases.get(parts[0], parts[0])
+        return ".".join([head] + parts[1:])
+
+
+def call_name(alias_map: AliasMap, call: ast.Call) -> str | None:
+    return alias_map.resolve(call.func)
+
+
+def first_arg_names(call: ast.Call):
+    """Names referenced anywhere in a call's first positional arg."""
+    if not call.args:
+        return set()
+    return {n.id for n in ast.walk(call.args[0])
+            if isinstance(n, ast.Name)}

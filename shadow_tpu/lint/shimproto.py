@@ -1,0 +1,352 @@
+"""Shim protocol conformance (SHIM2xx): C preload <-> Python bridge.
+
+``hosting/shim_preload.c`` and ``hosting/shim.py`` implement the two
+ends of one lockstep wire protocol. Nothing at runtime checks they
+agree — a one-sided edit (renumbering an ``OP_*``, adding an opcode to
+one table, changing which ops attach trailing payload) silently
+corrupts the framing of a hosted run. This checker makes that a BUILD
+failure instead, by parsing both sides and cross-checking:
+
+- the ``OP_*`` enum in the C file vs the ``OP_*`` constants in the
+  Python file: same names, same values (SHIM201/SHIM202);
+- the wire struct layouts: C ``struct req/rsp/evpair`` member types
+  vs the Python ``struct.Struct`` format strings REQ/RSP/EVPAIR
+  (SHIM210);
+- the payload-framing contracts: both sides document, next to their
+  protocol code, which opcodes attach trailing request payload,
+  trailing response payload, or trailing (fd, events) pairs — the C
+  comment block between the enum and ``call2`` and the "Protocol"
+  section of the Python module docstring. The claims are extracted
+  per-opcode and must agree (SHIM211); any ``<fmt>`` struct token the
+  Python docstring cites must be a declared Struct format (SHIM212).
+
+The comment blocks ARE the conformance surface on purpose: the
+protocol's framing rules live in prose beside the code that implements
+them, and this check makes that prose load-bearing — editing the
+behavior without the contract (or one side without the other) fails
+the gate.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Violation, rule
+
+SHIM200 = rule(
+    "SHIM200", "shim protocol source unparseable",
+    "the conformance checker could not locate the enum/constants — "
+    "keep the OP_* tables in their canonical form")
+SHIM201 = rule(
+    "SHIM201", "opcode present on one side only",
+    "add the opcode to BOTH hosting/shim_preload.c (enum) and "
+    "hosting/shim.py (OP_* constant), same name and value")
+SHIM202 = rule(
+    "SHIM202", "opcode value mismatch between C and Python",
+    "renumbering one side desyncs every hosted run: make the values "
+    "identical (and never reuse a retired number)")
+SHIM210 = rule(
+    "SHIM210", "wire struct layout mismatch",
+    "the C struct members and the Python struct.Struct format must "
+    "describe the same bytes")
+SHIM211 = rule(
+    "SHIM211", "payload-framing contract mismatch",
+    "the framing comments beside the protocol code disagree on "
+    "whether this opcode attaches trailing data; fix the side that "
+    "no longer matches the implementation")
+SHIM212 = rule(
+    "SHIM212", "framing text cites an undeclared struct format",
+    "every <fmt> token in the protocol docstring must match a "
+    "declared struct.Struct format (REQ/RSP/EVPAIR)")
+
+C_PATH = "shadow_tpu/hosting/shim_preload.c"
+PY_PATH = "shadow_tpu/hosting/shim.py"
+
+# C scalar type -> struct format char (little-endian wire)
+_CTYPE_FMT = {
+    "int8_t": "b", "uint8_t": "B", "int16_t": "h", "uint16_t": "H",
+    "int32_t": "i", "uint32_t": "I", "int64_t": "q", "uint64_t": "Q",
+    "float": "f", "double": "d", "char": "s",
+}
+
+# C struct name -> Python Struct constant name
+_STRUCT_MAP = {"req": "REQ", "rsp": "RSP", "evpair": "EVPAIR"}
+
+
+# --- C side ----------------------------------------------------------
+
+def parse_c_ops(text: str):
+    """The OP_* enum -> ({name: value}, {name: lineno}). C enum
+    semantics: explicit `= N` sets, bare names increment."""
+    m = re.search(r"enum\s*\{(.*?)\};", text, re.S)
+    if not m or "OP_" not in m.group(1):
+        return None, None
+    body = m.group(1)
+    # strip comments inside the enum body
+    body_clean = re.sub(r"/\*.*?\*/", "", body, flags=re.S)
+    ops, linenos = {}, {}
+    value = -1
+    base = text[: m.start(1)].count("\n") + 1
+    for entry in body_clean.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if "=" in entry:
+            name, _, rhs = entry.partition("=")
+            name = name.strip()
+            try:
+                value = int(rhs.strip(), 0)
+            except ValueError:
+                continue
+        else:
+            name = entry
+            value += 1
+        if name.startswith("OP_"):
+            ops[name] = value
+            # line of the name within the original text
+            off = body.find(name)
+            linenos[name] = (base + body[:off].count("\n")
+                             if off >= 0 else base)
+    return ops, linenos
+
+
+def parse_c_structs(text: str):
+    """struct req/rsp/evpair member layouts -> {name: (fmt, lineno)}
+    with fmt in struct-module notation (no byte-order prefix)."""
+    out = {}
+    for m in re.finditer(
+            r"struct\s+(\w+)\s*\{([^}]*)\}\s*;", text):
+        name, body = m.group(1), m.group(2)
+        if name not in _STRUCT_MAP:
+            continue
+        body = re.sub(r"/\*.*?\*/", "", body, flags=re.S)
+        fmt = ""
+        ok = True
+        for decl in body.split(";"):
+            decl = decl.strip()
+            if not decl:
+                continue
+            dm = re.match(
+                r"(?:unsigned\s+|signed\s+)?(\w+)\s+(\w+)\s*"
+                r"(?:\[\s*(\d+)\s*\])?$", decl)
+            if not dm:
+                ok = False
+                break
+            ctype, _mname, arr = dm.groups()
+            ch = _CTYPE_FMT.get(ctype)
+            if ch is None:
+                ok = False
+                break
+            if arr:
+                if ch == "s":
+                    fmt += f"{arr}s"
+                else:
+                    fmt += ch * int(arr)
+            else:
+                fmt += ch
+        if ok:
+            out[name] = (fmt, text[: m.start()].count("\n") + 1)
+    return out
+
+
+def c_framing_region(text: str) -> str:
+    """Comment text of the framing contract: every block comment
+    between the OP enum and the call2 definition (covers the evpair
+    trailing-pairs note and the 'Payload framing' block)."""
+    start = text.find("enum {")
+    end = text.find("static struct rsp call2")
+    if start < 0 or end < 0 or end <= start:
+        return ""
+    region = text[start:end]
+    chunks = re.findall(r"/\*(.*?)\*/", region, re.S)
+    cleaned = []
+    for c in chunks:
+        c = re.sub(r"^\s*\*", "", c, flags=re.M)
+        cleaned.append(" ".join(c.split()))
+    return ". ".join(cleaned)
+
+
+# --- Python side -----------------------------------------------------
+
+def parse_py(text: str):
+    """shim.py -> (ops {name: value}, linenos, structs {PYNAME:
+    (fmt, lineno)}, docstring, doc_lineno)."""
+    tree = ast.parse(text)
+    ops, linenos, structs = {}, {}, {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if name.startswith("OP_") and isinstance(
+                    node.value, ast.Constant) and isinstance(
+                    node.value.value, int):
+                ops[name] = node.value.value
+                linenos[name] = node.lineno
+            elif (name in _STRUCT_MAP.values()
+                  and isinstance(node.value, ast.Call)
+                  and node.value.args
+                  and isinstance(node.value.args[0], ast.Constant)):
+                structs[name] = (str(node.value.args[0].value),
+                                 node.lineno)
+    doc = ast.get_docstring(tree) or ""
+    return ops, linenos, structs, doc
+
+
+def py_framing_region(doc: str) -> str:
+    """The docstring's Protocol section (framing contract)."""
+    i = doc.find("Protocol")
+    return " ".join(doc[i:].split()) if i >= 0 else ""
+
+
+# --- framing-claim extraction ---------------------------------------
+
+_POSITIVE = re.compile(
+    r"followed by|(?<!never )carr(?:y|ies)|attach(?:es)?\s+(?!nothing)")
+_NEGATIVE = re.compile(r"never\s+carr|attach(?:es)?\s+nothing")
+
+
+def framing_claims(region_text: str) -> dict:
+    """Extract per-opcode framing claims from contract prose.
+
+    -> {opcode: {"req_payload": bool, "rsp_payload": bool,
+    "rsp_pairs": bool}} — an aspect key is present iff the text makes
+    a claim about it; conflicting claims (stream sends attach, dgram
+    sends attach nothing) resolve to True (CAN attach)."""
+    claims: dict[str, dict] = {}
+    for sentence in re.split(r"[.;](?:\s|$)", region_text):
+        ops = re.findall(r"OP_[A-Z_]+", sentence)
+        if not ops:
+            continue
+        pos = bool(_POSITIVE.search(sentence))
+        neg = bool(_NEGATIVE.search(sentence))
+        if not pos and not neg:
+            continue
+        low = sentence.lower()
+        # the side is the noun directly following the opcode list
+        # ("OP_SEND requests ...", "OP_RECV / OP_RANDOM responses
+        # ..."), NOT a sentence-wide keyword — framing sentences often
+        # mention the other side's vocabulary in passing
+        m = re.search(
+            r"op_[a-z_]+(?:\s*(?:/|,|and)\s*op_[a-z_]+)*\s+"
+            r"(requests?|responses?)", low)
+        side = m.group(1)[:3] if m else "req"
+        if side == "res" and ("pair" in low or "evpair" in low):
+            aspect = "rsp_pairs"
+        elif side == "res":
+            aspect = "rsp_payload"
+        else:
+            # request side, and subject-less claims ("Datagram
+            # OP_SEND ... attach nothing") default to it
+            aspect = "req_payload"
+        for op in ops:
+            d = claims.setdefault(op, {})
+            d[aspect] = d.get(aspect, False) or pos
+    return claims
+
+
+_FMT_TOKEN = re.compile(r"<([a-zA-Z0-9]+)>")
+
+
+# --- the cross-check -------------------------------------------------
+
+def check_texts(c_text: str, py_text: str,
+                c_path: str = C_PATH, py_path: str = PY_PATH) -> list:
+    """Full conformance check over raw file contents (separated from
+    path handling so fixtures can feed edited copies)."""
+    out = []
+    c_ops, c_lines = parse_c_ops(c_text)
+    if c_ops is None:
+        return [Violation(SHIM200, c_path, 0,
+                          "no OP_* enum found in the C shim")]
+    try:
+        py_ops, py_lines, py_structs, py_doc = parse_py(py_text)
+    except SyntaxError as e:
+        return [Violation(SHIM200, py_path, e.lineno or 0,
+                          f"shim.py unparseable: {e.msg}")]
+    if not py_ops:
+        return [Violation(SHIM200, py_path, 0,
+                          "no OP_* constants found in shim.py")]
+
+    # 1. names + values + count
+    for name in sorted(c_ops.keys() - py_ops.keys()):
+        out.append(Violation(
+            SHIM201, py_path, 0,
+            f"{name} (= {c_ops[name]}) exists in the C enum but has "
+            "no Python constant"))
+    for name in sorted(py_ops.keys() - c_ops.keys()):
+        out.append(Violation(
+            SHIM201, c_path, 0,
+            f"{name} (= {py_ops[name]}) exists in shim.py but not in "
+            "the C enum"))
+    for name in sorted(c_ops.keys() & py_ops.keys()):
+        if c_ops[name] != py_ops[name]:
+            out.append(Violation(
+                SHIM202, py_path, py_lines.get(name, 0),
+                f"{name}: C says {c_ops[name]}, Python says "
+                f"{py_ops[name]}"))
+
+    # 2. wire struct layouts
+    c_structs = parse_c_structs(c_text)
+    for cname, pyname in _STRUCT_MAP.items():
+        cs = c_structs.get(cname)
+        ps = py_structs.get(pyname)
+        if cs is None or ps is None:
+            out.append(Violation(
+                SHIM210, c_path if cs is None else py_path, 0,
+                f"wire struct `{cname}`/`{pyname}` missing on "
+                f"{'C' if cs is None else 'Python'} side"))
+            continue
+        c_fmt, _c_ln = cs
+        p_fmt, p_ln = ps
+        if p_fmt.lstrip("<=!>@") != c_fmt:
+            out.append(Violation(
+                SHIM210, py_path, p_ln,
+                f"{pyname} format {p_fmt!r} != C struct {cname} "
+                f"layout {'<' + c_fmt!r}"))
+
+    # 3. payload-framing agreement
+    c_claims = framing_claims(c_framing_region(c_text))
+    p_claims = framing_claims(py_framing_region(py_doc))
+    aspects = (("req_payload", "trailing request payload"),
+               ("rsp_payload", "trailing response payload"),
+               ("rsp_pairs", "trailing response (fd, events) pairs"))
+    for op in sorted(set(c_claims) | set(p_claims)):
+        cc, pc = c_claims.get(op, {}), p_claims.get(op, {})
+        for aspect, desc in aspects:
+            cv, pv = cc.get(aspect, False), pc.get(aspect, False)
+            if cv != pv:
+                side_has = "C" if cv else "Python"
+                side_not = "Python" if cv else "C"
+                out.append(Violation(
+                    SHIM211, py_path if cv else c_path, 0,
+                    f"{op}: {side_has} framing contract says it "
+                    f"attaches {desc}, {side_not} says it does not"))
+
+    # 4. struct format tokens cited in the protocol docstring
+    declared = {fmt.lstrip("<=!>@") for fmt, _ in py_structs.values()}
+    for tok in set(_FMT_TOKEN.findall(py_framing_region(py_doc))):
+        if tok not in declared:
+            out.append(Violation(
+                SHIM212, py_path, 0,
+                f"protocol docstring cites <{tok}> which matches no "
+                f"declared Struct format ({sorted(declared)})"))
+    return out
+
+
+def check(cache) -> list:
+    """Conformance over the repo's canonical shim pair."""
+    c_text = cache.text(C_PATH)
+    py_text = cache.text(PY_PATH)
+    missing = []
+    if c_text is None:
+        missing.append(Violation(SHIM200, C_PATH, 0,
+                                 "C shim source missing"))
+    if py_text is None:
+        missing.append(Violation(SHIM200, PY_PATH, 0,
+                                 "Python shim source missing"))
+    if missing:
+        # BOTH missing = not a hosting-capable tree (fixture repos);
+        # one missing = a real conformance failure
+        return [] if len(missing) == 2 else missing
+    return check_texts(c_text, py_text)

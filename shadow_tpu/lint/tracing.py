@@ -1,0 +1,536 @@
+"""JAX tracing-hazard lints (TRC1xx): host Python leaking into traced
+code.
+
+The compiled window program is the engine's hot path; a `.item()` or
+host-numpy call inside it forces a device sync per call (the
+overhead-bound TCP tier's enemy, ROADMAP item 1), a Python `if` on a
+traced array fails at trace time, a closure over a mutable module
+global silently captures stale state at trace time, and unhashable
+static_argnums cause retrace storms.
+
+These hazards only matter in code that actually runs UNDER a trace, so
+the family first builds a jit-reachability set:
+
+1. roots: functions wrapped by ``jax.jit`` / ``jax.shard_map`` /
+   ``core.jitcache.AotJit`` / ``jax.pmap`` (as decorator or call,
+   through ``functools.partial`` and simple local ``body = ...``
+   assignments), plus lambdas passed to those wrappers;
+2. propagation: any project-defined function REFERENCED by name inside
+   a reachable body is reachable (inside traced code, referencing a
+   function — as a call, a ``lax.cond`` branch, a ``vmap`` target —
+   means it traces), resolved through imports across the scanned
+   modules.
+
+Scope: ``engine/``, ``net/``, ``parallel/``, ``core/`` (reachability
+is computed over all of ``shadow_tpu/`` so cross-module edges through
+``apps/`` etc. still propagate; violations are only REPORTED in
+scope).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Violation, rule
+from .names import AliasMap, module_name_of
+
+TRC101 = rule(
+    "TRC101", ".item()/.tolist() inside jit-reachable code",
+    "forces a device->host sync per call; keep the value on device "
+    "(jnp ops / lax.cond) or hoist the read out of the traced region")
+TRC102 = rule(
+    "TRC102", "trace-time int()/float()/bool() on a traced value",
+    "concretizes a tracer (TracerConversionError at trace time, or a "
+    "silent host sync); use astype/jnp casts or restructure so the "
+    "value is static")
+TRC103 = rule(
+    "TRC103", "host-numpy materialization in jit-reachable code",
+    "np.asarray/np.array on a traced value forces transfer, and "
+    "numpy scalar constructors are strong-typed (dtype-widening "
+    "under x64); use jnp equivalents with an explicit dtype")
+TRC104 = rule(
+    "TRC104", "Python branch on an array value in traced code",
+    "`if jnp.any(...)` needs the concrete value at trace time; use "
+    "lax.cond / jnp.where")
+TRC105 = rule(
+    "TRC105", "jit-reachable closure over a mutable module global",
+    "the traced value is captured at FIRST trace and silently never "
+    "refreshed (stale capture), and rebinding retraces; pass it as an "
+    "argument or freeze it")
+TRC106 = rule(
+    "TRC106", "static_argnums/static_argnames on an unhashable default",
+    "unhashable statics (list/dict/set) fail at call time or retrace "
+    "per call; use tuples / hashable config objects")
+
+# report scope (repo-relative); the call graph spans all of shadow_tpu
+SCOPE = ("shadow_tpu/engine", "shadow_tpu/net", "shadow_tpu/parallel",
+         "shadow_tpu/core")
+GRAPH_SCOPE = ("shadow_tpu",)
+
+_JIT_WRAPPERS = {
+    "jax.jit", "jax.pmap", "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "shadow_tpu.core.jitcache.AotJit",
+}
+
+# parameters conventionally holding STATIC config in this codebase —
+# int()/float() on them is trace-time-constant work, not a hazard
+_STATIC_PARAMS = {"cfg", "lcfg", "config", "self", "mesh", "cls"}
+
+
+def _param_names(node) -> set:
+    a = node.args
+    names = [p.arg for p in
+             (a.posonlyargs + a.args + a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+class _Func:
+    __slots__ = ("module", "qual", "node", "relpath", "parent")
+
+    def __init__(self, module, qual, node, relpath, parent):
+        self.module = module      # dotted module name
+        self.qual = qual          # dotted qualname within the module
+        self.node = node          # FunctionDef | Lambda
+        self.relpath = relpath
+        self.parent = parent      # enclosing _Func or None
+
+    @property
+    def fqn(self):
+        return f"{self.module}.{self.qual}"
+
+
+class _ModuleInfo:
+    def __init__(self, relpath: str, tree: ast.AST):
+        self.relpath = relpath
+        self.name = module_name_of(relpath)
+        self.tree = tree
+        self.aliases = AliasMap(tree, relpath)
+        self.functions: dict[str, _Func] = {}   # qual -> _Func
+        self.mutable_globals: dict[str, int] = {}
+        self._scope_cache = None
+        self._collect_functions()
+        self._collect_mutable_globals()
+
+    def _collect_functions(self):
+        mod = self
+
+        class Collector(ast.NodeVisitor):
+            def __init__(self):
+                self.stack: list[_Func] = []
+
+            def _add(self, name, node):
+                parent = self.stack[-1] if self.stack else None
+                qual = (f"{parent.qual}.{name}" if parent else name)
+                fn = _Func(mod.name, qual, node, mod.relpath, parent)
+                mod.functions[qual] = fn
+                return fn
+
+            def visit_FunctionDef(self, node):
+                fn = self._add(node.name, node)
+                self.stack.append(fn)
+                self.generic_visit(node)
+                self.stack.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Lambda(self, node):
+                fn = self._add(f"<lambda@{node.lineno}>", node)
+                self.stack.append(fn)
+                self.generic_visit(node)
+                self.stack.pop()
+
+        Collector().visit(self.tree)
+
+    def _collect_mutable_globals(self):
+        """Module-level names bound to mutable containers (or rebound
+        more than once at module level). ALL_CAPS singly-assigned
+        immutables are constants, not hazards."""
+        counts: dict[str, int] = {}
+        for stmt in self.tree.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = [t for t in stmt.targets
+                           if isinstance(t, ast.Name)]
+                value = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name):
+                targets, value = [stmt.target], stmt.value
+            else:
+                continue
+            for t in targets:
+                counts[t.id] = counts.get(t.id, 0) + 1
+                if self._is_mutable(value):
+                    self.mutable_globals.setdefault(t.id, t.lineno)
+        for name, n in counts.items():
+            if n > 1:
+                self.mutable_globals.setdefault(name, 0)
+
+    def _is_mutable(self, value) -> bool:
+        if value is None:
+            return False
+        if isinstance(value, (ast.Dict, ast.List, ast.Set,
+                              ast.DictComp, ast.ListComp, ast.SetComp)):
+            return True
+        if isinstance(value, ast.Call):
+            dotted = self.aliases.resolve(value.func)
+            return dotted in ("dict", "list", "set", "bytearray",
+                              "collections.defaultdict",
+                              "collections.deque",
+                              "collections.OrderedDict")
+        return False
+
+
+class _Project:
+    """All scanned modules + the jit-reachability fixpoint."""
+
+    def __init__(self, cache):
+        self.cache = cache
+        self.modules: dict[str, _ModuleInfo] = {}
+        for rel in cache.py_files(GRAPH_SCOPE):
+            tree = cache.tree(rel)
+            if tree is None or isinstance(tree, SyntaxError):
+                continue
+            info = _ModuleInfo(rel, tree)
+            self.modules[info.name] = info
+        self.reachable: set[_Func] = set()
+        self._compute_reachability()
+
+    # --- function resolution -----------------------------------------
+    def _lookup(self, module: _ModuleInfo, scope: _Func | None,
+                name: str) -> _Func | None:
+        """Resolve a bare name referenced inside `scope` to a project
+        function: innermost enclosing nested def, then module level,
+        then imports."""
+        s = scope
+        while s is not None:
+            cand = module.functions.get(f"{s.qual}.{name}")
+            if cand is not None:
+                return cand
+            s = s.parent
+        cand = module.functions.get(name)
+        if cand is not None:
+            return cand
+        dotted = module.aliases.aliases.get(name)
+        if dotted:
+            return self._by_dotted(dotted)
+        return None
+
+    def _by_dotted(self, dotted: str) -> _Func | None:
+        mod, _, attr = dotted.rpartition(".")
+        info = self.modules.get(mod)
+        if info is not None and attr in info.functions:
+            return info.functions[attr]
+        return None
+
+    def _resolve_wrapped(self, module, scope, node) -> list:
+        """The function(s) a jit-wrapper call actually wraps: unwraps
+        Lambda, Name (through simple local `name = ...` assignments),
+        and functools.partial chains."""
+        if isinstance(node, ast.Lambda):
+            qual = (f"{scope.qual}.<lambda@{node.lineno}>" if scope
+                    else f"<lambda@{node.lineno}>")
+            fn = module.functions.get(qual)
+            return [fn] if fn else []
+        if isinstance(node, ast.Call):
+            dotted = module.aliases.resolve(node.func)
+            if dotted in ("functools.partial", "partial") and node.args:
+                return self._resolve_wrapped(module, scope,
+                                             node.args[0])
+            return []
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if isinstance(node, ast.Name):
+                # chase one level of simple local assignment
+                # (`body = partial(f, ...)` then `shard_map(body)`)
+                assigned = self._local_assignment(scope, node.id)
+                if assigned is not None:
+                    return self._resolve_wrapped(module, scope,
+                                                 assigned)
+                fn = self._lookup(module, scope, node.id)
+                return [fn] if fn else []
+            dotted = module.aliases.resolve(node)
+            if dotted:
+                fn = self._by_dotted(dotted)
+                return [fn] if fn else []
+        return []
+
+    @staticmethod
+    def _local_assignment(scope: _Func | None, name: str):
+        """Last `name = <expr>` statement in the enclosing function
+        body (shallow; good enough for the wrapper-arg idiom)."""
+        if scope is None or isinstance(scope.node, ast.Lambda):
+            return None
+        found = None
+        for stmt in ast.walk(scope.node):
+            if (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == name
+                    and not isinstance(stmt.value, ast.Name)):
+                found = stmt.value
+        return found
+
+    # --- reachability ------------------------------------------------
+    def _compute_reachability(self):
+        roots: list[_Func] = []
+        for info in self.modules.values():
+            # decorator roots
+            for fn in info.functions.values():
+                node = fn.node
+                if isinstance(node, ast.Lambda):
+                    continue
+                for dec in node.decorator_list:
+                    d = dec.func if isinstance(dec, ast.Call) else dec
+                    dotted = info.aliases.resolve(d)
+                    if dotted in _JIT_WRAPPERS or (
+                            isinstance(dec, ast.Call)
+                            and info.aliases.resolve(dec.func)
+                            in ("functools.partial", "partial")
+                            and dec.args
+                            and info.aliases.resolve(dec.args[0])
+                            in _JIT_WRAPPERS):
+                        roots.append(fn)
+            # call-wrapper roots: jax.jit(f) / AotJit(f) / shard_map(f)
+            scope_of = self._scope_index(info)
+            for node in ast.walk(info.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = info.aliases.resolve(node.func)
+                if dotted not in _JIT_WRAPPERS or not node.args:
+                    continue
+                scope = scope_of.get(id(node))
+                roots.extend(self._resolve_wrapped(info, scope,
+                                                   node.args[0]))
+        # fixpoint: references inside reachable bodies
+        work = [r for r in roots if r is not None]
+        self.reachable = set(work)
+        while work:
+            fn = work.pop()
+            info = self.modules[fn.module]
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Name) and isinstance(
+                        node.ctx, ast.Load):
+                    target = self._lookup(info, fn, node.id)
+                    if target is not None and target not in \
+                            self.reachable:
+                        self.reachable.add(target)
+                        work.append(target)
+                elif isinstance(node, ast.Attribute):
+                    dotted = info.aliases.resolve(node)
+                    if dotted:
+                        target = self._by_dotted(dotted)
+                        if target is not None and target not in \
+                                self.reachable:
+                            self.reachable.add(target)
+                            work.append(target)
+
+    def _scope_index(self, info: _ModuleInfo) -> dict:
+        """id(ast node) -> innermost enclosing _Func, for locating
+        wrapper calls made inside functions (cached per module)."""
+        if info._scope_cache is not None:
+            return info._scope_cache
+        index: dict[int, _Func] = {}
+
+        def mark(fn: _Func):
+            for sub in ast.walk(fn.node):
+                index.setdefault(id(sub), fn)
+
+        # deeper functions first so setdefault keeps the innermost
+        for qual in sorted(info.functions,
+                           key=lambda q: -q.count(".")):
+            mark(info.functions[qual])
+        info._scope_cache = index
+        return index
+
+
+class _HazardVisitor(ast.NodeVisitor):
+    """Scan one reachable function body (not descending into nested
+    defs/lambdas — they are scanned separately iff reachable)."""
+
+    def __init__(self, project: _Project, fn: _Func):
+        self.project = project
+        self.fn = fn
+        self.info = project.modules[fn.module]
+        self.aliases = self.info.aliases
+        self.violations: list[Violation] = []
+        node = fn.node
+        self.params = _param_names(node)
+        self.traced_params = self.params - _STATIC_PARAMS
+        # locals bound inside the body shadow module globals
+        self.locals = set(self.params)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and isinstance(
+                    sub.ctx, (ast.Store, ast.Del)):
+                self.locals.add(sub.id)
+            elif isinstance(sub, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                if sub is not node:
+                    self.locals.add(sub.name)
+        self._root = node
+
+    def _emit(self, rid, node, message):
+        self.violations.append(Violation(
+            rid, self.fn.relpath, node.lineno,
+            f"{message} (in jit-reachable `{self.fn.qual}`)"))
+
+    def _skip_nested(self, node):
+        if node is self._root:
+            self.generic_visit(node)
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _skip_nested
+    visit_Lambda = _skip_nested
+
+    def _mentions_traced(self, node) -> bool:
+        return any(isinstance(n, ast.Name) and n.id in
+                   self.traced_params for n in ast.walk(node))
+
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in (
+                "item", "tolist") and not node.args:
+            self._emit(TRC101, node,
+                       f"`.{func.attr}()` syncs device->host")
+        dotted = self.aliases.resolve(func)
+        if dotted in ("float", "int", "bool") and len(node.args) == 1:
+            if self._mentions_traced(node.args[0]):
+                self._emit(TRC102, node, f"`{dotted}()` on a value "
+                           "derived from a traced argument")
+        elif dotted and dotted.startswith("numpy."):
+            attr = dotted.split(".", 1)[1]
+            if attr in ("asarray", "array", "frombuffer", "copy",
+                        "ascontiguousarray"):
+                if self._mentions_traced(node):
+                    self._emit(TRC103, node, f"`np.{attr}` on a "
+                               "traced value transfers to host")
+            elif attr in ("float16", "float32", "float64", "int8",
+                          "int16", "int32", "int64", "uint8",
+                          "uint16", "uint32", "uint64"):
+                self._emit(TRC103, node, f"`np.{attr}(...)` builds a "
+                           "strong-typed numpy scalar (dtype "
+                           "widening under x64)")
+        self.generic_visit(node)
+
+    # --- if/while on arrays ------------------------------------------
+    def _arrayish_test(self, test) -> bool:
+        for n in ast.walk(test):
+            if isinstance(n, ast.Call):
+                dotted = self.aliases.resolve(n.func)
+                if dotted and (dotted.startswith("jax.numpy.")
+                               or dotted.startswith("jax.lax.")):
+                    return True
+                if (isinstance(n.func, ast.Attribute)
+                        and n.func.attr in ("any", "all", "sum")
+                        and self._mentions_traced(n.func.value)):
+                    return True
+        return False
+
+    def visit_If(self, node: ast.If):
+        if self._arrayish_test(node.test):
+            self._emit(TRC104, node, "Python `if` on an array-valued "
+                       "test")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While):
+        if self._arrayish_test(node.test):
+            self._emit(TRC104, node, "Python `while` on an "
+                       "array-valued test")
+        self.generic_visit(node)
+
+    # --- mutable-global closure --------------------------------------
+    def visit_Name(self, node: ast.Name):
+        if (isinstance(node.ctx, ast.Load)
+                and node.id not in self.locals
+                and node.id in self.info.mutable_globals):
+            self._emit(TRC105, node, f"reads mutable module global "
+                       f"`{node.id}`")
+        self.generic_visit(node)
+
+
+def _static_arg_violations(project: _Project) -> list:
+    """TRC106 over every jit-wrapper CALL SITE in scope (the call
+    sites live in host-side caller code, outside the reachable set)."""
+    out = []
+    for info in project.modules.values():
+        if not info.relpath.startswith(SCOPE):
+            continue
+        scope_of = project._scope_index(info)
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = info.aliases.resolve(node.func)
+            if dotted not in _JIT_WRAPPERS:
+                continue
+            kw = {k.arg: k.value for k in node.keywords if k.arg}
+            if not ("static_argnums" in kw
+                    or "static_argnames" in kw) or not node.args:
+                continue
+            scope = scope_of.get(id(node))
+            for fn in project._resolve_wrapped(info, scope,
+                                               node.args[0]):
+                if fn is None or isinstance(fn.node, ast.Lambda):
+                    continue
+                for pname in _unhashable_statics(fn.node, kw):
+                    out.append(Violation(
+                        TRC106, info.relpath, node.lineno,
+                        f"static arg `{pname}` of `{fn.qual}` "
+                        "defaults to an unhashable container"))
+    return out
+
+
+def _unhashable_statics(fnode, kw):
+    """Parameter names marked static whose default is an unhashable
+    container literal."""
+    a = fnode.args
+    params = a.posonlyargs + a.args
+    defaults = [None] * (len(params) - len(a.defaults)) \
+        + list(a.defaults)
+    marked = []
+    sa = kw.get("static_argnums")
+    by_index = dict(enumerate(zip(params, defaults)))
+    if isinstance(sa, ast.Constant) and isinstance(sa.value, int):
+        marked.append(by_index.get(sa.value))
+    elif isinstance(sa, (ast.Tuple, ast.List)):
+        for el in sa.elts:
+            if isinstance(el, ast.Constant):
+                marked.append(by_index.get(el.value))
+    names = kw.get("static_argnames")
+    wanted = set()
+    if isinstance(names, (ast.Tuple, ast.List)):
+        wanted = {el.value for el in names.elts
+                  if isinstance(el, ast.Constant)}
+    elif isinstance(names, ast.Constant):
+        wanted = {names.value}
+    for p, d in zip(params, defaults):
+        if p.arg in wanted:
+            marked.append((p, d))
+    for entry in marked:
+        if entry is None:
+            continue
+        p, d = entry
+        if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+            yield p.arg
+
+
+def check(cache) -> list:
+    """Run the tracing family: build the reachability set, then scan
+    every reachable function that lives in the report scope."""
+    project = _Project(cache)
+    out = []
+    seen = set()
+    for fn in project.reachable:
+        if not fn.relpath.startswith(SCOPE):
+            continue
+        hv = _HazardVisitor(project, fn)
+        hv.generic_visit(fn.node)
+        for v in hv.violations:
+            key = (v.rule, v.file, v.line)
+            if key not in seen:
+                seen.add(key)
+                out.append(v)
+    out.extend(_static_arg_violations(project))
+    out.sort(key=lambda v: (v.file, v.line, v.rule))
+    return out

@@ -1,0 +1,381 @@
+"""simlint: the determinism / tracing-hazard / shim-conformance gate.
+
+Tier-1 runs the full suite over the repo (the machine-checked
+replacement for the reference's by-convention determinism discipline)
+plus fixture tests proving each check family actually FIRES: a
+wallclock call, a tracer `.item()`, a renumbered OP_* and a framing
+edit must each fail the gate with exactly the named rule.
+
+Deliberately jax-free: the linter is pure stdlib AST analysis, and the
+tools.simlint loader imports it without touching the shadow_tpu
+package __init__ (which imports jax).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.simlint import load  # noqa: E402
+
+lint = load()
+core = sys.modules["shadow_tpu.lint.core"]
+determinism = sys.modules["shadow_tpu.lint.determinism"]
+tracing = sys.modules["shadow_tpu.lint.tracing"]
+shimproto = sys.modules["shadow_tpu.lint.shimproto"]
+
+C_SHIM = os.path.join(REPO, "shadow_tpu/hosting/shim_preload.c")
+PY_SHIM = os.path.join(REPO, "shadow_tpu/hosting/shim.py")
+
+
+def _read(path):
+    with open(path) as f:
+        return f.read()
+
+
+def make_repo(tmp_path, files):
+    """Materialize a fixture repo: {relpath: content}."""
+    for rel, content in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(content)
+    return str(tmp_path)
+
+
+def run_cli(root, *extra):
+    """python -m tools.simlint --root <root> from the real repo."""
+    return subprocess.run(
+        [sys.executable, "-m", "tools.simlint", "--root", str(root),
+         *extra],
+        cwd=REPO, capture_output=True, text=True)
+
+
+# --- the gate: the repo itself is clean ------------------------------
+
+def test_repo_is_clean_via_cli():
+    """Acceptance: `python -m tools.simlint` exits 0 on the repo —
+    every violation fixed, suppressed with justification, or
+    baselined."""
+    r = run_cli(REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+
+def test_reachability_graph_is_alive():
+    """Guard the call-graph machinery itself: if root detection or
+    propagation silently broke, the repo scan would pass vacuously.
+    The jitted core (window step, TCP kernels, app handlers) must be
+    in the reachable set."""
+    cache = core.SourceCache(REPO)
+    project = tracing._Project(cache)
+    fqns = {f.fqn for f in project.reachable}
+    assert len(fqns) > 100, len(fqns)
+    for expected in (
+            "shadow_tpu.engine.window.step_window_pass",
+            "shadow_tpu.engine.window.exchange",
+            "shadow_tpu.parallel.shard._windows_body",
+            "shadow_tpu.core.rowops.rget"):
+        assert expected in fqns, expected
+    assert any(f.startswith("shadow_tpu.net.tcp.") for f in fqns)
+
+
+# --- fixture violations must FAIL the gate (acceptance) --------------
+
+BAD_ENGINE = """\
+import time
+import os
+
+def schedule(now):
+    return now + time.time()
+
+def key_of(h):
+    return os.urandom(8)
+"""
+
+BAD_TRACED = """\
+import jax
+import jax.numpy as jnp
+
+def helper(x):
+    return x.item() + 1
+
+def cold_helper(x):
+    return x.item() + 2
+
+@jax.jit
+def step(x):
+    return helper(x)
+"""
+
+
+def test_fixture_violations_fail_cli(tmp_path):
+    """One fixture repo carrying all three acceptance violations: a
+    wallclock call, a tracer .item() in jit-reachable code, and a
+    renumbered OP_* in the shim pair -> exit 1 naming each rule."""
+    py_shim = _read(PY_SHIM).replace("OP_GETNAME = 20",
+                                     "OP_GETNAME = 23")
+    assert "OP_GETNAME = 23" in py_shim
+    root = make_repo(tmp_path, {
+        "shadow_tpu/engine/bad.py": BAD_ENGINE,
+        "shadow_tpu/engine/traced.py": BAD_TRACED,
+        "shadow_tpu/hosting/shim_preload.c": _read(C_SHIM),
+        "shadow_tpu/hosting/shim.py": py_shim,
+    })
+    r = run_cli(root)
+    assert r.returncode == 1, r.stdout + r.stderr
+    for rid in ("DET101", "DET103", "TRC101", "SHIM202"):
+        assert rid in r.stdout, (rid, r.stdout)
+    # reachability is selective: the unreferenced helper is not traced
+    assert "cold_helper" not in r.stdout
+
+
+def test_tracing_reachability_is_selective(tmp_path):
+    root = make_repo(tmp_path,
+                     {"shadow_tpu/engine/traced.py": BAD_TRACED})
+    report = lint.run_lint(root)
+    trc = [v for v in report["new"] if v.rule == "TRC101"]
+    assert len(trc) == 1, report["new"]
+    assert trc[0].line == 5  # helper, not cold_helper
+
+
+# --- determinism rules (unit level) ----------------------------------
+
+def det(src):
+    return determinism.check_source("shadow_tpu/engine/x.py", src)
+
+
+def test_det_wallclock_and_datetime():
+    vs = det("import time\nfrom datetime import datetime\n"
+             "def f():\n    a = time.monotonic()\n"
+             "    b = datetime.now()\n    return a, b\n")
+    assert [v.rule for v in vs] == ["DET101", "DET101"]
+
+
+def test_det_unseeded_rng():
+    vs = det("import random\nimport numpy as np\n"
+             "def f():\n    a = random.random()\n"
+             "    rng = np.random.default_rng()\n"
+             "    b = np.random.rand(3)\n    return a, rng, b\n")
+    assert [v.rule for v in vs] == ["DET102"] * 3
+
+
+def test_det_seeded_rng_ok():
+    vs = det("import numpy as np\nimport random\n"
+             "def f(seed):\n    rng = np.random.default_rng(seed)\n"
+             "    r = random.Random(seed)\n"
+             "    s = np.random.RandomState(seed ^ 7)\n"
+             "    return rng, r, s\n")
+    assert vs == []
+
+
+def test_det_hash_used_vs_probe():
+    # result used -> DET104; bare-statement hashability probe -> ok
+    vs = det("def f(k):\n    return hash(k) % 8\n")
+    assert [v.rule for v in vs] == ["DET104"]
+    vs = det("def probe(sh):\n    try:\n        hash(sh)\n"
+             "    except TypeError:\n        sh = None\n"
+             "    return sh\n")
+    assert vs == []
+    assert det("def f(n):\n    return hash(3)\n") == []
+
+
+def test_det_set_iteration():
+    vs = det("def f(xs):\n    s = set(xs)\n"
+             "    for x in s:\n        yield x\n")
+    assert [v.rule for v in vs] == ["DET105"]
+    assert det("def f(xs):\n    s = set(xs)\n"
+               "    for x in sorted(s):\n        yield x\n") == []
+    vs = det("def f(a, b):\n    return [x for x in set(a) | set(b)]\n")
+    assert [v.rule for v in vs] == ["DET105"]
+
+
+# --- tracing rules beyond TRC101 (unit level) ------------------------
+
+TRC_PANEL = """\
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+GLOBAL_TABLE = {}
+
+def helper(x):
+    if jnp.any(x > 0):
+        x = x + GLOBAL_TABLE.get("k", 0)
+    y = float(x)
+    z = np.asarray(x)
+    return y, z
+
+def mk(x, opts=[1, 2]):
+    return x
+
+@jax.jit
+def step(x):
+    return helper(x)
+
+fast = jax.jit(mk, static_argnums=1)
+"""
+
+
+def test_tracing_rule_panel(tmp_path):
+    root = make_repo(tmp_path,
+                     {"shadow_tpu/engine/panel.py": TRC_PANEL})
+    report = lint.run_lint(root)
+    rules = sorted(v.rule for v in report["new"])
+    assert rules == ["TRC102", "TRC103", "TRC104", "TRC105",
+                     "TRC106"], report["new"]
+
+
+# --- suppression & baseline workflow ---------------------------------
+
+def test_inline_suppression_requires_justification(tmp_path):
+    ok = ("import os\n\ndef f():\n"
+          "    return os.urandom(8)  # simlint: ok DET103 -- fixture\n")
+    root = make_repo(tmp_path, {"shadow_tpu/engine/a.py": ok})
+    report = lint.run_lint(root)
+    assert report["exit_code"] == 0 and report["suppressed"] == 1
+
+    bare = ("import os\n\ndef f():\n"
+            "    return os.urandom(8)  # simlint: ok DET103\n")
+    root2 = make_repo(tmp_path / "b", {"shadow_tpu/engine/a.py": bare})
+    report = lint.run_lint(root2)
+    assert report["exit_code"] == 1
+    assert [v.rule for v in report["new"]] == ["LNT001"]
+
+    # --fix-baseline must NOT pin the LNT001 away: the justification
+    # requirement survives the one-command adoption path
+    lint.run_lint(root2, fix_baseline=True)
+    report = lint.run_lint(root2)
+    assert report["exit_code"] == 1
+    assert [v.rule for v in report["new"]] == ["LNT001"]
+
+
+def test_baseline_pins_and_goes_stale(tmp_path):
+    src = "import os\n\ndef f():\n    return os.urandom(8)\n"
+    root = make_repo(tmp_path, {"shadow_tpu/engine/a.py": src})
+    baseline = os.path.join(root, "tools/simlint/baseline.json")
+
+    report = lint.run_lint(root)
+    assert report["exit_code"] == 1
+    assert [v.rule for v in report["new"]] == ["DET103"]
+
+    # --fix-baseline adopts the debt in one command...
+    report = lint.run_lint(root, fix_baseline=True)
+    assert report["exit_code"] == 0
+    entries = json.load(open(baseline))["entries"]
+    assert len(entries) == 1 and entries[0]["rule"] == "DET103"
+
+    # ...after which the gate is clean
+    report = lint.run_lint(root)
+    assert report["exit_code"] == 0 and report["baselined"] == 1
+
+    # a SECOND violation of the same shape still fails (counts pin)
+    src2 = src + "\ndef g():\n    return os.urandom(8)\n"
+    (tmp_path / "shadow_tpu/engine/a.py").write_text(src2)
+    report = lint.run_lint(root)
+    assert report["exit_code"] == 1 and len(report["new"]) == 1
+
+    # fixing the violation makes the baseline entry STALE -> fail
+    (tmp_path / "shadow_tpu/engine/a.py").write_text(
+        "def f():\n    return b'\\x00' * 8\n")
+    report = lint.run_lint(root)
+    assert report["exit_code"] == 1
+    assert [v.rule for v in report["stale"]] == ["LNT002"]
+
+
+def test_baseline_distinguishes_line0_violations(tmp_path):
+    """SHIM2xx violations carry no source line; they must key by
+    message so a pinned conformance finding cannot silently absorb a
+    later, DIFFERENT drift of the same rule."""
+    c = _read(C_SHIM)
+    root = make_repo(tmp_path, {
+        "shadow_tpu/hosting/shim_preload.c":
+            c.replace(" OP_GETNAME, OP_VIOLATION,", " OP_GETNAME,", 1),
+        "shadow_tpu/hosting/shim.py": _read(PY_SHIM),
+    })
+    lint.run_lint(root, fix_baseline=True)
+    assert lint.run_lint(root)["exit_code"] == 0
+    # a different missing opcode is NOT covered by the pinned one
+    (tmp_path / "shadow_tpu/hosting/shim_preload.c").write_text(
+        c.replace(" OP_RANDOM, OP_GETNAME,", " OP_GETNAME,", 1))
+    report = lint.run_lint(root)
+    assert report["exit_code"] == 1
+    assert any(v.rule == "SHIM201" and "OP_RANDOM" in v.message
+               for v in report["new"]), report["new"]
+    assert report["stale"], "old pin must go stale"
+
+
+# --- shim protocol conformance (the satellite fixtures) --------------
+
+@pytest.fixture(scope="module")
+def shim_texts():
+    return _read(C_SHIM), _read(PY_SHIM)
+
+
+def test_conformance_clean_on_repo(shim_texts):
+    c, py = shim_texts
+    assert shimproto.check_texts(c, py) == []
+
+
+def test_conformance_renumbered_opcode(shim_texts):
+    c, py = shim_texts
+    bad = py.replace("OP_GETNAME = 20", "OP_GETNAME = 23")
+    assert bad != py
+    vs = shimproto.check_texts(c, bad)
+    assert len(vs) == 1 and vs[0].rule == "SHIM202", vs
+    assert "OP_GETNAME" in vs[0].message
+
+
+def test_conformance_missing_opcode(shim_texts):
+    c, py = shim_texts
+    bad_c = c.replace(" OP_GETNAME, OP_VIOLATION,",
+                      " OP_GETNAME,", 1)
+    assert bad_c != c
+    vs = shimproto.check_texts(bad_c, py)
+    assert len(vs) == 1 and vs[0].rule == "SHIM201", vs
+    assert "OP_VIOLATION" in vs[0].message
+
+
+def test_conformance_framing_mismatch(shim_texts):
+    c, py = shim_texts
+    bad = py.replace("OP_RECVFROM\n  responses never carry payload",
+                     "OP_RECVFROM\n  responses carry r0 trailing "
+                     "payload bytes")
+    assert bad != py
+    vs = shimproto.check_texts(c, bad)
+    assert len(vs) == 1 and vs[0].rule == "SHIM211", vs
+    assert "OP_RECVFROM" in vs[0].message
+
+
+def test_conformance_struct_layout(shim_texts):
+    c, py = shim_texts
+    bad_c = c.replace("struct req { int32_t op; int32_t a; "
+                      "int64_t b; int64_t c;",
+                      "struct req { int32_t op; int32_t a; "
+                      "int64_t b; int32_t c;")
+    assert bad_c != c
+    vs = shimproto.check_texts(bad_c, py)
+    assert len(vs) == 1 and vs[0].rule == "SHIM210", vs
+    assert "REQ" in vs[0].message
+
+
+def test_conformance_doc_fmt_token(shim_texts):
+    c, py = shim_texts
+    bad = py.replace("<qq> (fd, events) pairs",
+                     "<qqq8s> (fd, events) pairs")
+    assert bad != py
+    vs = shimproto.check_texts(c, bad)
+    assert any(v.rule == "SHIM212" for v in vs), vs
+
+
+# --- rule catalog stays documented -----------------------------------
+
+def test_rules_have_docs_and_catalog_entry():
+    doc = _read(os.path.join(REPO, "docs/static-analysis.md"))
+    for rid in core.RULES:
+        assert rid in doc, f"{rid} missing from docs/static-analysis.md"

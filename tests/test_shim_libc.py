@@ -154,6 +154,13 @@ def test_entropy_determinism_dual_run(libcprobe_bin, tmp_path,
     assert d["entropy.getrandom"] != "00" * 16, out1
     assert d["entropy.urandom"] != "00" * 16, out1
     assert d["entropy.getrandom"] != d["entropy.urandom"]
+    # the stdio route (fopen/fread): glibc's fopen calls an INTERNAL
+    # open, so only the fopen/fopen64 -> fopencookie interposition
+    # keeps it deterministic (ADVICE r5); real PRNG bytes, advancing
+    # the same host stream as the other draws, identical across the
+    # dual run (out1 == out2 above covers the fentropy line too)
+    assert d["fentropy.fopen"] != "00" * 16, out1
+    assert d["fentropy.fopen"] != d["entropy.urandom"], out1
 
     out3, _ = _run_probe(libcprobe_bin, str(tmp_path / "p3.out"),
                          simple_topology_xml, seed=8)

@@ -1,0 +1,38 @@
+"""`python -m tools.simlint` — the simlint static-analysis gate.
+
+The implementation lives in shadow_tpu/lint/ (determinism lints, JAX
+tracing-hazard lints, shim-protocol conformance; see
+docs/static-analysis.md). This wrapper loads that package WITHOUT
+importing the `shadow_tpu` package itself: shadow_tpu/__init__.py
+imports jax (seconds of startup and an accelerator-config side
+effect), and a lint gate must stay sub-second and dependency-free.
+"""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+_LINT_DIR = Path(__file__).resolve().parents[2] / "shadow_tpu" / "lint"
+
+
+def load():
+    """Import shadow_tpu.lint standalone (no parent-package import).
+
+    Registering the module under its real dotted name keeps relative
+    imports inside the package working; Python only consults
+    sys.modules for the PARENT of a submodule import, so `shadow_tpu`
+    itself is never touched.
+    """
+    name = "shadow_tpu.lint"
+    if name not in sys.modules:
+        spec = importlib.util.spec_from_file_location(
+            name, _LINT_DIR / "__init__.py",
+            submodule_search_locations=[str(_LINT_DIR)])
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        try:
+            spec.loader.exec_module(mod)
+        except BaseException:
+            del sys.modules[name]
+            raise
+    return sys.modules[name]

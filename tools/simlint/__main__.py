@@ -1,0 +1,5 @@
+import sys
+
+from . import load
+
+sys.exit(load().main())
