@@ -153,11 +153,40 @@ STATE_SECTIONS = (
 )
 
 
-def section_of(field: str) -> str:
+def section_of(field: str, *, strict: bool = False) -> str:
+    """Digest section for a Hosts field. With strict=True an unmapped
+    field raises instead of landing in the "other" bucket — digest and
+    checkpoint attribution silently degrade there, so simlint STF301
+    (and tests/test_stateflow.py) require every field to be sectioned;
+    the default stays lenient for forward-compat readers of old
+    digest chains."""
     for prefix, section in STATE_SECTIONS:
         if field.startswith(prefix):
             return section
+    if strict:
+        raise KeyError(
+            f"Hosts field {field!r} matches no STATE_SECTIONS prefix; "
+            "add a (prefix, section) entry next to the field")
     return "other"
+
+
+# Hot/cold column contract for the ROADMAP item-1 socket-table split:
+# a COLD column is one the lockstep drain's per-pass compute never
+# touches — it is only read/written at window boundaries (exchange,
+# cap-peak sampling, window advance) or by host-side consumers (pcap
+# drain, reports). The stateflow analyzer (lint/stateflow.py, STF303)
+# verifies this against the drain-pass subgraph on every simlint run,
+# so a cold column cannot creep back into the drain working set
+# unnoticed; tools/state_matrix.py prints the measured matrix this set
+# was derived from. Grow this set as the split progresses (the sk_*
+# cold candidates — SACK bookkeeping, config — first need the drain's
+# TCP handlers restructured; see docs/static-analysis.md).
+COLD_FIELDS = frozenset({
+    "ob_next",      # written by the exchange carry, read by advance
+    "tr_time", "tr_pkt", "tr_dir", "tr_cnt", "tr_drop",  # pcap ring:
+    #   exchange-side appends, host-side drain
+    "cap_peaks",    # window-boundary sampling only
+})
 
 
 @chex.dataclass

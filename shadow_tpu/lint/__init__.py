@@ -10,11 +10,12 @@ codebase; our split Python/JAX + C-preload design enforces them with
 this machine-checked gate instead (tests/test_lint.py runs it in
 tier-1, .github/workflows/ci.yml on every push).
 
-Three check families (docs/static-analysis.md has the rule catalog):
+Four check families (docs/static-analysis.md has the rule catalog):
 
 - ``determinism``  (DET1xx): wallclock, unseeded RNG, os.urandom,
   PYTHONHASHSEED-sensitive ``hash()``, unordered set iteration — over
-  ``engine/``, ``net/``, ``core/``, ``obs/``, ``hosting/``.
+  ``engine/``, ``net/``, ``core/``, ``obs/``, ``hosting/``,
+  ``fleet/`` and ``lint/`` itself.
 - ``tracing``      (TRC1xx): JAX tracing hazards inside jit-reachable
   code (``.item()``, trace-time ``int()``/``float()``, host-numpy
   materialization, ``if`` on arrays, closures over mutable module
@@ -23,6 +24,12 @@ Three check families (docs/static-analysis.md has the rule catalog):
 - ``shimproto``    (SHIM2xx): C<->Python shim protocol conformance
   (``hosting/shim_preload.c`` vs ``hosting/shim.py``: OP_* names,
   values, struct layouts, payload-framing agreement).
+- ``stateflow``    (STF3xx/STF4xx): the per-pass Hosts-field access
+  matrix and its contracts — every field sectioned, no dead columns,
+  COLD_FIELDS out of the drain subgraph — plus dtype-flow rules
+  (unwidened i32 into i64 ns arithmetic, f32 cwnd vs i64 compares,
+  SIMTIME_MAX vs non-i64). ``python -m tools.state_matrix`` prints
+  the measured matrix.
 
 This package deliberately imports NOTHING outside the stdlib (no jax,
 no numpy): ``python -m tools.simlint`` must stay a sub-second gate.

@@ -19,8 +19,23 @@ import json
 import os
 import sys
 
-from . import determinism, shimproto, tracing
-from .core import (RULES, SourceCache, apply_allowlist,
+import importlib
+
+# NOT `from . import determinism, ...`: that statement's fromlist
+# handling re-imports each submodule through the C-level
+# builtins.__import__ with a plain dotted name, which walks to and
+# returns the ROOT package — under the standalone tools.simlint
+# loader `shadow_tpu` itself is deliberately absent from sys.modules,
+# so the walk executes shadow_tpu/__init__.py and imports jax
+# (2s of the "sub-second" gate; a hard crash on a jax-free CI box).
+# import_module resolves the leaf directly and never touches the
+# root. Pinned by tests/test_lint.py::test_gate_runs_without_jax.
+determinism = importlib.import_module(f"{__package__}.determinism")
+shimproto = importlib.import_module(f"{__package__}.shimproto")
+stateflow = importlib.import_module(f"{__package__}.stateflow")
+tracing = importlib.import_module(f"{__package__}.tracing")
+
+from .core import (RULES, SourceCache, apply_allowlist,  # noqa: E402
                    apply_suppressions, diff_baseline, fill_snippets,
                    load_baseline, write_baseline)
 
@@ -44,11 +59,15 @@ def find_root(start: str = None) -> str:
 
 
 def collect(cache: SourceCache) -> list:
-    """All three families, raw (pre-suppression/baseline)."""
+    """All four families, raw (pre-suppression/baseline). The tracing
+    module index (~1.5s to build) is shared between the two families
+    that need it."""
+    project = tracing._Project(cache)
     out = []
     out.extend(determinism.check(cache))
-    out.extend(tracing.check(cache))
+    out.extend(tracing.check(cache, project=project))
     out.extend(shimproto.check(cache))
+    out.extend(stateflow.check(cache, project=project))
     return out
 
 

@@ -60,6 +60,21 @@ ALLOW: dict[str, dict[str, str]] = {
             "wall-clock progress log timestamps",
         "shadow_tpu/obs/ledger.py":
             "perf ledger stamps wall times of finished runs",
+        # fleet/ (in scope since PR 11): host-side sweep orchestration.
+        # Wall time here schedules WORKERS, never simulations — run
+        # determinism is carried by the per-run digest chains, which
+        # the fleet chaos tests prove byte-identical under arbitrary
+        # scheduling (tests/test_fleet.py). The other DET rules still
+        # apply: the queue journal fold must stay order-deterministic.
+        "shadow_tpu/fleet/queue.py":
+            "journal lines stamp wall timestamps; claims use wall "
+            "mtimes (durable-queue bookkeeping, not sim state)",
+        "shadow_tpu/fleet/scheduler.py":
+            "backoff arithmetic, lock takeover and reap timing are "
+            "wall-clock scheduling — the scheduler's purpose",
+        "shadow_tpu/fleet/worker.py":
+            "progress watchdog compares wall mtimes of run artifacts "
+            "(hung-run detection IS the product)",
     },
 }
 

@@ -3,8 +3,12 @@
 Every rule here corresponds to a regression class the repo (or the
 reference) has actually hit — see docs/static-analysis.md for the
 catalog with examples. Scope: ``engine/``, ``net/``, ``core/``,
-``obs/``, ``hosting/`` (bench/fleet/tools intentionally excluded:
-wall-clock scheduling and reporting is their job).
+``obs/``, ``hosting/``, plus — since PR 11 — ``fleet/`` (its
+wall-clock scheduling is legitimate and allowlisted per file; its
+QUEUE/journal layer must stay deterministic) and ``lint/`` itself
+(a linter whose own report order depends on PYTHONHASHSEED cannot
+pin baselines). ``bench.py`` and ``tools/`` stay excluded:
+wall-clock reporting is their whole job.
 """
 
 from __future__ import annotations
@@ -44,7 +48,8 @@ DET105 = rule(
 
 # scan scope, repo-relative
 SCOPE = ("shadow_tpu/engine", "shadow_tpu/net", "shadow_tpu/core",
-         "shadow_tpu/obs", "shadow_tpu/hosting")
+         "shadow_tpu/obs", "shadow_tpu/hosting", "shadow_tpu/fleet",
+         "shadow_tpu/lint")
 
 _WALLCLOCK = {
     "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",

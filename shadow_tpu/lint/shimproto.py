@@ -325,7 +325,7 @@ def check_texts(c_text: str, py_text: str,
 
     # 4. struct format tokens cited in the protocol docstring
     declared = {fmt.lstrip("<=!>@") for fmt, _ in py_structs.values()}
-    for tok in set(_FMT_TOKEN.findall(py_framing_region(py_doc))):
+    for tok in sorted(set(_FMT_TOKEN.findall(py_framing_region(py_doc)))):
         if tok not in declared:
             out.append(Violation(
                 SHIM212, py_path, 0,

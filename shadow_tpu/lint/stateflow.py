@@ -1,0 +1,1307 @@
+"""State-access & dtype-flow analysis (STF3xx/STF4xx): the machine-
+checked contract behind the hot/cold socket-table split.
+
+ROADMAP item 1 wants the ~45-column ``sk_*`` socket table split into
+hot rows vs cold columns so the lockstep drain's per-pass gather
+touches a hot working set only. That split is only safe — and only
+STAYS safe — if something can say which ``Hosts`` columns each jitted
+pass actually reads and writes. This module computes exactly that: a
+pure-stdlib abstract interpretation over the project AST (reusing the
+``tracing`` module index and name resolution) that follows
+``Hosts``/``HostParams``/``Shared`` pytree values through attribute
+access, ``.replace(...)`` kwargs (including the ``**{f: ... for f in
+_FIELDS}`` idiom), ``getattr`` field names, tuple unpacking, closures,
+``jax.lax`` combinators (cond/switch/while_loop/fori_loop), ``vmap``/
+``functools.partial`` wrappers and helper-function boundaries — and
+produces a per-entry pass x field **access matrix** (read / written /
+shape-only / untouched), with every access pinned to its source site.
+
+On top of the matrix, two gated rule families:
+
+- **STF3xx access contracts**: every ``Hosts`` field must map to a
+  declared ``STATE_SECTIONS`` section (``section_of`` returning
+  ``"other"`` silently mis-buckets digests/checkpoints); dead and
+  write-only columns are flagged; and the declarative
+  ``engine/state.py`` ``COLD_FIELDS`` annotation is enforced — a
+  cold-marked column read or written inside the drain-pass subgraph
+  fails the build, so a cold column cannot creep back into the
+  per-pass working set unnoticed.
+- **STF4xx dtype flow**: i32 column values flowing into i64 ns
+  arithmetic without explicit widening, f32 congestion-window values
+  compared against i64 byte quantities (f32 holds 24 mantissa bits —
+  silently lossy past 16 MiB), and SIMTIME_MAX-sentinel comparisons
+  against non-i64 operands (the reference's ``guint64`` ns clock is
+  the invariant being protected).
+
+The machine-readable matrix is exported by ``tools/state_matrix.py``
+(--json/--markdown), so the actual split PR starts from ground truth
+and stays gated afterwards. Branches on static config (``cfg.*``) are
+all traversed: the matrix is the UNION over engine configurations.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Violation, rule
+from .tracing import _Project
+
+STF300 = rule(
+    "STF300", "stateflow analysis integrity failure",
+    "the analyzer could not build a trustworthy matrix (state.py "
+    "unparseable, entry passes renamed, or a vacuous drain scan); fix "
+    "the wiring — never baseline this rule")
+STF301 = rule(
+    "STF301", "Hosts field maps to no STATE_SECTIONS section",
+    "add a (prefix, section) entry in engine/state.py STATE_SECTIONS "
+    "next to the new field; `other` silently mis-buckets digest and "
+    "divergence attribution")
+STF302 = rule(
+    "STF302", "dead or write-only Hosts column",
+    "no analyzed pass reads this field and it is not declared "
+    "host-consumed (stateflow.HOST_CONSUMED); delete the column or "
+    "declare its host-side consumer")
+STF303 = rule(
+    "STF303", "cold-marked column touched in the drain-pass subgraph",
+    "engine/state.py COLD_FIELDS promises this column stays out of "
+    "the lockstep drain's working set; move the access to a window-"
+    "boundary phase or un-mark the column (docs/static-analysis.md)")
+STF401 = rule(
+    "STF401", "i32 column flows into i64 arithmetic without widening",
+    "add .astype(jnp.int64) at the source; implicit promotion hides "
+    "intent and an i32 intermediate overflows silently at 2^31")
+STF402 = rule(
+    "STF402", "f32 congestion value compared against an i64 quantity",
+    "widen with .astype(jnp.int64) first (tcp._win_bytes does); an "
+    "f32 operand quantizes i64 byte offsets above 2^24")
+STF403 = rule(
+    "STF403", "SIMTIME_MAX sentinel compared against a non-i64 operand",
+    "SIMTIME_MAX is the i64 ns clock's infinity; comparing it against "
+    "an i32/f32 value can never be true (or truncates) — widen the "
+    "operand")
+
+STATE_PATH = "shadow_tpu/engine/state.py"
+
+# ---------------------------------------------------------------------
+# The analyzed entry passes. One matrix column per entry: the
+# coarse window phases (drain / exchange / cap-peak sampling) plus the
+# individually-testable event-handler passes. Param names map to the
+# pytree kind they carry. The `drain` entry's subgraph — everything the
+# lockstep pass loop reaches, handlers and TCP/NIC/SACK machinery
+# included — is what the STF303 cold-column contract gates.
+
+HOSTS, HP, SH = "hosts", "hp", "sh"
+
+ENTRIES = (
+    # (entry, fqn, {param: kind}, in_drain_subgraph)
+    ("drain", "shadow_tpu.engine.window.drain_window",
+     {"hosts": HOSTS, "hp": HP, "sh": SH}, True),
+    ("exchange", "shadow_tpu.engine.window.exchange",
+     {"hosts": HOSTS, "hp": HP, "sh": SH}, False),
+    ("exchange.sharded", "shadow_tpu.parallel.shard.exchange_sharded",
+     {"hosts": HOSTS, "hp": HP, "sh": SH}, False),
+    ("cap_peaks", "shadow_tpu.engine.window.update_cap_peaks",
+     {"hosts": HOSTS}, False),
+    ("advance", "shadow_tpu.engine.window.next_wakeup",
+     {"hosts": HOSTS}, False),
+    ("nic.tx", "shadow_tpu.net.nic.on_tx",
+     {"row": HOSTS, "hp": HP, "sh": SH}, False),
+    ("nic.rx_admit", "shadow_tpu.net.nic.rx_admit",
+     {"row": HOSTS, "hp": HP}, False),
+    ("tcp.rx", "shadow_tpu.net.tcp.tcp_rx",
+     {"row": HOSTS, "hp": HP, "sh": SH}, False),
+    ("tcp.timer", "shadow_tpu.net.tcp.on_tcp_timer",
+     {"row": HOSTS, "hp": HP, "sh": SH}, False),
+    ("udp.deliver", "shadow_tpu.net.udp.udp_deliver",
+     {"row": HOSTS, "hp": HP, "sh": SH}, False),
+    ("channel.write", "shadow_tpu.net.channel.pipe_write",
+     {"row": HOSTS}, False),
+)
+
+# Hosts columns whose READER is host-side Python, not a jitted pass —
+# each with the consumer that justifies it. STF302 treats these as
+# read. Everything else written-but-never-read is a dead column.
+HOST_CONSUMED = {
+    "stats": "SimReport stat table (engine/sim.py summary)",
+    "cap_peaks": "end-of-run capacity report (sim.py; ObjectCounter "
+                 "analogue)",
+    "tr_time": "pcap drain (obs/pcap.py reads the ring per chunk)",
+    "tr_pkt": "pcap drain (obs/pcap.py)",
+    "tr_dir": "pcap drain (obs/pcap.py)",
+    "tr_drop": "trace-ring overflow accounting (sim.py report)",
+    "hw_time": "hosted-wake drain (hosting/runtime.py per chunk)",
+    "hw_pkt": "hosted-wake drain (hosting/runtime.py)",
+    "hw_drop": "hosted-wake overflow accounting (sim.py report)",
+}
+
+_DT = {"int8": "i8", "int16": "i16", "int32": "i32", "int64": "i64",
+       "uint8": "u8", "uint16": "u16", "uint32": "u32", "uint64": "u64",
+       "float16": "f16", "float32": "f32", "float64": "f64",
+       "bool_": "bool", "bool": "bool"}
+
+_COMMENT_DT = re.compile(r"\b(i32|i64|u32|f32|f64|bool)\b")
+
+
+# --- the state model: fields, dtypes, sections, cold set -------------
+# Parsed from engine/state.py's AST (never imported: chex pulls jax),
+# so the model re-syncs with the source on every run.
+
+class StateModel:
+    def __init__(self):
+        self.fields = {HOSTS: {}, HP: {}, SH: {}}  # name -> dtype
+        self.linenos = {}          # Hosts field -> state.py line
+        self.sections = []         # [(prefix, section)]
+        self.cold = set()          # COLD_FIELDS
+        self.errors = []           # human-readable parse failures
+        self.missing = False       # no state.py at all (fixture repo)
+
+    def section_of(self, field: str):
+        for prefix, section in self.sections:
+            if field.startswith(prefix):
+                return section
+        return None
+
+    def dtype_of(self, kind: str, field: str) -> str:
+        return self.fields.get(kind, {}).get(field, "?")
+
+
+_CLASS_KINDS = {"Hosts": HOSTS, "HostParams": HP, "Shared": SH}
+
+
+def _dtype_from_node(node) -> str | None:
+    """`jnp.int64` / `np.float32`-style attribute -> short dtype."""
+    if isinstance(node, ast.Attribute):
+        return _DT.get(node.attr)
+    return None
+
+
+def load_state_model(cache) -> StateModel:
+    m = StateModel()
+    tree = cache.tree(STATE_PATH)
+    lines = cache.lines(STATE_PATH) or []
+    if tree is None:
+        # no state.py at all: a fixture repo exercising another
+        # family — skip, like shimproto's both-sides-missing rule
+        # (the real repo's presence is pinned by test_stateflow)
+        m.missing = True
+        return m
+    if isinstance(tree, SyntaxError):
+        m.errors.append(f"{STATE_PATH} unparseable: {tree.msg}")
+        return m
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name in _CLASS_KINDS:
+            kind = _CLASS_KINDS[node.name]
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                        stmt.target, ast.Name):
+                    name = stmt.target.id
+                    # dtype from the same-line annotation comment
+                    # (authoritative for HostParams; Hosts/Shared get
+                    # overridden from the constructors below)
+                    dt = "?"
+                    if 1 <= stmt.lineno <= len(lines):
+                        _, _, comment = lines[stmt.lineno - 1].partition(
+                            "#")
+                        hit = _COMMENT_DT.search(comment)
+                        if hit:
+                            dt = hit.group(1)
+                    m.fields[kind][name] = dt
+                    if kind == HOSTS:
+                        m.linenos[name] = stmt.lineno
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            tname = node.targets[0].id
+            if tname == "STATE_SECTIONS":
+                try:
+                    m.sections = [tuple(e) for e in
+                                  ast.literal_eval(node.value)]
+                except (ValueError, TypeError):
+                    m.errors.append("STATE_SECTIONS not a literal "
+                                    "tuple of (prefix, section) pairs")
+            elif tname == "COLD_FIELDS":
+                val = node.value
+                if isinstance(val, ast.Call) and val.args:
+                    val = val.args[0]    # frozenset({...})
+                try:
+                    m.cold = set(ast.literal_eval(val))
+                except (ValueError, TypeError):
+                    m.errors.append("COLD_FIELDS not a literal set "
+                                    "of field names")
+        elif isinstance(node, ast.FunctionDef) and node.name in (
+                "alloc_hosts", "make_shared"):
+            kind = HOSTS if node.name == "alloc_hosts" else SH
+            _harvest_ctor_dtypes(m, kind, node)
+    if not m.fields[HOSTS]:
+        m.errors.append("no Hosts fields found in state.py")
+    return m
+
+
+def _harvest_ctor_dtypes(m: StateModel, kind: str, fnode):
+    """Authoritative dtypes from the constructor calls:
+    alloc_hosts' `full(shape, val, jnp.i64)` kwargs / make_shared's
+    `jnp.asarray(x, dtype=jnp.i64)` and `jnp.i64(x)` kwargs."""
+    for node in ast.walk(fnode):
+        if not (isinstance(node, ast.Return)
+                and isinstance(node.value, ast.Call)):
+            continue
+        for kw in node.value.keywords:
+            if kw.arg is None or kw.arg not in m.fields[kind]:
+                continue
+            v = kw.value
+            dt = None
+            if isinstance(v, ast.Call):
+                if isinstance(v.func, ast.Name):       # full(s, v, dt)
+                    if len(v.args) >= 3:
+                        dt = _dtype_from_node(v.args[2])
+                else:                                   # jnp.xxx(...)
+                    dt = _dtype_from_node(v.func)
+                    if dt is None:                      # asarray(dtype=)
+                        for vkw in v.keywords:
+                            if vkw.arg == "dtype":
+                                dt = _dtype_from_node(vkw.value)
+            if dt:
+                m.fields[kind][kw.arg] = dt
+
+
+# --- abstract values -------------------------------------------------
+
+class Tree:
+    __slots__ = ("kind",)
+
+    def __init__(self, kind):
+        self.kind = kind
+
+
+class Arr:
+    """An array value: dtype, the state field it derives from (for
+    rule messages and the widening requirement), and whether an
+    explicit cast has been applied on the path."""
+    __slots__ = ("dtype", "origin", "widened")
+
+    def __init__(self, dtype, origin=None, widened=False):
+        self.dtype = dtype
+        self.origin = origin
+        self.widened = widened
+
+
+class Tup:
+    __slots__ = ("items",)
+
+    def __init__(self, items):
+        self.items = items
+
+
+class Func:
+    """A project function as a value; `env` snapshots the defining
+    scope for nested defs/lambdas (closure capture)."""
+    __slots__ = ("fn", "env")
+
+    def __init__(self, fn, env=None):
+        self.fn = fn
+        self.env = env
+
+
+class FuncList:
+    """One of several functions (lax.switch branch tables, the app
+    registry): calls conservatively traverse every member."""
+    __slots__ = ("items",)
+
+    def __init__(self, items):
+        self.items = items
+
+
+class Partial:
+    __slots__ = ("target", "args", "kwargs")
+
+    def __init__(self, target, args, kwargs):
+        self.target = target
+        self.args = args
+        self.kwargs = kwargs
+
+
+class Bound:
+    """`recv.name` method access pending its call (`.replace`,
+    `.astype`, `.at[...]`, reductions)."""
+    __slots__ = ("recv", "name")
+
+    def __init__(self, recv, name):
+        self.recv = recv
+        self.name = name
+
+
+class StrSet:
+    """A comprehension variable ranging over a literal string tuple
+    (the `**{f: ... for f in _MERGE_FIELDS}` idiom)."""
+    __slots__ = ("values",)
+
+    def __init__(self, values):
+        self.values = tuple(values)
+
+
+class KwDict:
+    """A `**kwargs` parameter with its call-site bindings — the
+    `_set(row, slot, sk_state=...)` write-helper idiom funnels field
+    writes through `kw.items()`, and losing those would blank the
+    whole TCP column of the matrix."""
+    __slots__ = ("entries",)
+
+    def __init__(self, entries):
+        self.entries = dict(entries)
+
+
+class Sym:
+    __slots__ = ("name",)
+
+    def __init__(self, name):
+        self.name = name
+
+
+TOP = None
+
+_INT_RANK = {"bool": 0, "i8": 1, "u8": 1, "i16": 2, "u16": 2,
+             "i32": 3, "u32": 3, "i64": 4, "u64": 4}
+
+
+def _promote(a: str, b: str) -> str:
+    if a == b:
+        return a
+    if a == "?" or b == "?":
+        return "?"
+    fa, fb = a.startswith("f"), b.startswith("f")
+    if fa or fb:
+        if fa and fb:
+            return a if a >= b else b
+        return a if fa else b
+    ra, rb = _INT_RANK.get(a, -1), _INT_RANK.get(b, -1)
+    if ra < 0 or rb < 0:
+        return "?"
+    return a if ra >= rb else b
+
+
+def _merge(a, b):
+    """Join of two abstract values (branch results, loop carries)."""
+    if a is TOP:
+        return b
+    if b is TOP:
+        return a
+    if isinstance(a, Tree) and isinstance(b, Tree) and a.kind == b.kind:
+        return a
+    if isinstance(a, Tup) and isinstance(b, Tup) \
+            and len(a.items) == len(b.items):
+        return Tup([_merge(x, y) for x, y in zip(a.items, b.items)])
+    if isinstance(a, Arr) and isinstance(b, Arr):
+        return Arr(_promote(a.dtype, b.dtype),
+                   a.origin if a.origin == b.origin else None,
+                   a.widened and b.widened)
+    if isinstance(a, FuncList) or isinstance(b, FuncList) \
+            or isinstance(a, Func) or isinstance(b, Func):
+        items = []
+        for v in (a, b):
+            items.extend(v.items if isinstance(v, FuncList) else [v])
+        return FuncList(items)
+    return TOP
+
+
+# --- per-entry access record -----------------------------------------
+
+class Access:
+    def __init__(self):
+        # kind -> field -> (file, line) of the first access site
+        self.reads = {HOSTS: {}, HP: {}, SH: {}}
+        self.writes = {HOSTS: {}, HP: {}, SH: {}}
+        self.meta = {HOSTS: {}, HP: {}, SH: {}}   # shape/dtype only
+        self.bulk = []   # (tag, file, line): whole-tree ops (tree.map)
+
+    def record(self, table, kind, field, site):
+        table[kind].setdefault(field, site)
+
+
+_META_ATTRS = ("shape", "dtype", "ndim", "size")
+
+_JNP_CASTS = {"int8", "int16", "int32", "int64", "uint8", "uint16",
+              "uint32", "uint64", "float16", "float32", "float64",
+              "bool_"}
+_JNP_PROMOTING = {"where", "minimum", "maximum", "clip", "add",
+                  "multiply", "mod", "floor_divide", "power", "abs",
+                  "negative", "sign", "cbrt", "sqrt"}
+_JNP_BOOL = {"any", "all", "logical_and", "logical_or", "logical_not",
+             "isin", "equal", "not_equal"}
+_JNP_REDUCE = {"sum", "min", "max", "prod", "cumsum"}
+_ROWOPS = {
+    "shadow_tpu.core.rowops.rget": 0,
+    "shadow_tpu.core.rowops.rset": 0,
+    "shadow_tpu.core.rowops.radd": 0,
+    "shadow_tpu.core.rowops.rset_where": 0,
+}
+_ARITH_OPS = (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv,
+              ast.Mod)
+
+_MAX_DEPTH = 60
+
+
+class _Frame:
+    __slots__ = ("info", "fn")
+
+    def __init__(self, info, fn):
+        self.info = info   # _ModuleInfo
+        self.fn = fn       # _Func or None (module level)
+
+    @property
+    def relpath(self):
+        return self.info.relpath
+
+
+class _EntryInterp:
+    """Abstract interpretation of one entry pass. Flow-insensitive
+    inside a function (both branches of every `if` execute against a
+    shared env; loops run once) — an over-approximation that is exact
+    for access PRESENCE, which is what the matrix states."""
+
+    def __init__(self, project: _Project, model: StateModel,
+                 violations: list, vseen: set):
+        self.project = project
+        self.model = model
+        self.access = Access()
+        self.violations = violations   # shared across entries
+        self.vseen = vseen             # (rule, file, line) dedup
+        self.memo = {}                 # (fqn, bindkey) -> ret abstract
+        self.stack = set()
+        self.depth = 0
+
+    # --- plumbing ----------------------------------------------------
+    def _emit(self, rid, frame, node, message):
+        key = (rid, frame.relpath, node.lineno)
+        if key not in self.vseen:
+            self.vseen.add(key)
+            self.violations.append(Violation(
+                rid, frame.relpath, node.lineno, message))
+
+    def _site(self, frame, node):
+        return (frame.relpath, node.lineno)
+
+    def _read(self, kind, field, frame, node):
+        self.access.record(self.access.reads, kind, field,
+                           self._site(frame, node))
+
+    def _write(self, kind, field, frame, node):
+        self.access.record(self.access.writes, kind, field,
+                           self._site(frame, node))
+
+    def _resolve(self, frame, node):
+        """Dotted name of an expression, chasing module-level
+        `_I64 = jnp.int64`-style aliases one step."""
+        dotted = frame.info.aliases.resolve(node)
+        if dotted and "." not in dotted and isinstance(node, ast.Name):
+            target = _module_alias(frame.info, dotted)
+            if target:
+                return target
+        return dotted
+
+    # --- entry -------------------------------------------------------
+    def run_entry(self, fn, binding: dict):
+        env = {}
+        for pname, kind in binding.items():
+            env[pname] = Tree(kind)
+        frame = _Frame(self.project.modules[fn.module], fn)
+        self._exec_body(fn.node.body, env, frame)
+
+    # --- function calls ----------------------------------------------
+    def _call_fn(self, funcabs, args, kwargs, frame, node):
+        if isinstance(funcabs, Partial):
+            return self._call_fn(funcabs.target,
+                                 list(funcabs.args) + list(args),
+                                 {**funcabs.kwargs, **kwargs},
+                                 frame, node)
+        if isinstance(funcabs, FuncList):
+            ret = TOP
+            for item in funcabs.items:
+                ret = _merge(ret, self._call_fn(item, args, kwargs,
+                                                frame, node))
+            return ret
+        if not isinstance(funcabs, Func):
+            return TOP
+        fn = funcabs.fn
+        key = None
+        if funcabs.env is None:
+            key = (fn.fqn, _bindkey(args, kwargs))
+            if key in self.memo:
+                return self.memo[key]
+        if (fn.fqn in self.stack and funcabs.env is None) \
+                or self.depth >= _MAX_DEPTH:
+            return TOP
+        env = dict(funcabs.env) if funcabs.env else {}
+        _bind_params(fn.node, args, kwargs, env)
+        callee_frame = _Frame(self.project.modules[fn.module], fn)
+        self.stack.add(fn.fqn)
+        self.depth += 1
+        try:
+            if isinstance(fn.node, ast.Lambda):
+                ret = self._ev(fn.node.body, env, callee_frame)
+            else:
+                ret = self._exec_body(fn.node.body, env, callee_frame)
+        finally:
+            self.depth -= 1
+            self.stack.discard(fn.fqn)
+        if key is not None:
+            self.memo[key] = ret
+        return ret
+
+    # --- statements --------------------------------------------------
+    def _exec_body(self, body, env, frame):
+        returns = TOP
+        for stmt in body:
+            r = self._exec(stmt, env, frame)
+            if r is not _NO_RETURN:
+                returns = _merge(returns, r)
+        return returns
+
+    def _exec(self, stmt, env, frame):
+        if isinstance(stmt, ast.Return):
+            return self._ev(stmt.value, env, frame) \
+                if stmt.value is not None else TOP
+        if isinstance(stmt, ast.Assign):
+            val = self._ev(stmt.value, env, frame)
+            for t in stmt.targets:
+                _assign(t, val, env)
+            return _NO_RETURN
+        if isinstance(stmt, ast.AnnAssign):
+            val = self._ev(stmt.value, env, frame) \
+                if stmt.value is not None else TOP
+            _assign(stmt.target, val, env)
+            return _NO_RETURN
+        if isinstance(stmt, ast.AugAssign):
+            self._ev(stmt.value, env, frame)
+            if isinstance(stmt.target, ast.Name):
+                env[stmt.target.id] = TOP
+            return _NO_RETURN
+        if isinstance(stmt, ast.Expr):
+            self._ev(stmt.value, env, frame)
+            return _NO_RETURN
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._ev(stmt.test, env, frame)
+            r = self._exec_body(stmt.body, env, frame)
+            if stmt.orelse:
+                r = _merge(r, self._exec_body(stmt.orelse, env, frame))
+            return r
+        if isinstance(stmt, ast.For):
+            self._ev(stmt.iter, env, frame)
+            _assign(stmt.target, TOP, env)
+            r = self._exec_body(stmt.body, env, frame)
+            if stmt.orelse:
+                r = _merge(r, self._exec_body(stmt.orelse, env, frame))
+            return r
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = self._nested_fn(frame, stmt.name)
+            env[stmt.name] = Func(fn, dict(env)) if fn else TOP
+            return _NO_RETURN
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._ev(item.context_expr, env, frame)
+            return self._exec_body(stmt.body, env, frame)
+        if isinstance(stmt, ast.Try):
+            r = self._exec_body(stmt.body, env, frame)
+            for h in stmt.handlers:
+                r = _merge(r, self._exec_body(h.body, env, frame))
+            if stmt.finalbody:
+                r = _merge(r, self._exec_body(stmt.finalbody, env,
+                                              frame))
+            return r
+        if isinstance(stmt, (ast.Assert, ast.Raise)):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._ev(child, env, frame)
+            return _NO_RETURN
+        return _NO_RETURN
+
+    def _nested_fn(self, frame, name):
+        qual = f"{frame.fn.qual}.{name}" if frame.fn else name
+        return frame.info.functions.get(qual)
+
+    # --- expressions -------------------------------------------------
+    def _ev(self, node, env, frame):
+        if node is None:
+            return TOP
+        if isinstance(node, ast.Constant):
+            return node
+        if isinstance(node, ast.Name):
+            return self._ev_name(node, env, frame)
+        if isinstance(node, ast.Attribute):
+            return self._ev_attr(node, env, frame)
+        if isinstance(node, ast.Subscript):
+            return self._ev_subscript(node, env, frame)
+        if isinstance(node, ast.Call):
+            return self._ev_call(node, env, frame)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            items = [self._ev(e, env, frame) for e in node.elts]
+            if items and all(isinstance(i, (Func, FuncList))
+                             for i in items):
+                flat = []
+                for i in items:
+                    flat.extend(i.items if isinstance(i, FuncList)
+                                else [i])
+                return FuncList(flat)
+            return Tup(items)
+        if isinstance(node, ast.BinOp):
+            return self._ev_binop(node, env, frame)
+        if isinstance(node, ast.Compare):
+            return self._ev_compare(node, env, frame)
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                self._ev(v, env, frame)
+            return Arr("bool")
+        if isinstance(node, ast.UnaryOp):
+            v = self._ev(node.operand, env, frame)
+            if isinstance(node.op, ast.Not):
+                return Arr("bool")
+            return v if isinstance(v, (Arr, ast.Constant)) else TOP
+        if isinstance(node, ast.IfExp):
+            self._ev(node.test, env, frame)
+            return _merge(self._ev(node.body, env, frame),
+                          self._ev(node.orelse, env, frame))
+        if isinstance(node, ast.Lambda):
+            fn = self._nested_fn(frame, f"<lambda@{node.lineno}>")
+            return Func(fn, dict(env)) if fn else TOP
+        if isinstance(node, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp)):
+            with self._comp_env(node, env, frame) as cenv:
+                elt = self._ev(node.elt, cenv, frame)
+            if isinstance(elt, (Func, FuncList)):
+                return elt if isinstance(elt, FuncList) \
+                    else FuncList([elt])
+            return TOP
+        if isinstance(node, ast.DictComp):
+            with self._comp_env(node, env, frame) as cenv:
+                self._ev(node.key, cenv, frame)
+                self._ev(node.value, cenv, frame)
+            return TOP
+        if isinstance(node, ast.Starred):
+            return self._ev(node.value, env, frame)
+        if isinstance(node, ast.Slice):
+            for part in (node.lower, node.upper, node.step):
+                if part is not None:
+                    self._ev(part, env, frame)
+            return TOP
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                self._ev(k, env, frame)
+                self._ev(v, env, frame)
+            return TOP
+        return TOP
+
+    def _comp_env(self, node, env, frame):
+        """Context manager yielding the comprehension scope: loop vars
+        over literal string tuples become StrSet (the getattr-over-
+        field-list idiom); everything else TOP."""
+        interp = self
+
+        class _Ctx:
+            def __enter__(ctx):
+                ctx.env = dict(env)
+                for gen in node.generators:
+                    vals = interp._str_tuple(gen.iter, env, frame)
+                    tgt = gen.target
+                    if vals is not None and isinstance(tgt, ast.Name):
+                        ctx.env[tgt.id] = StrSet(vals)
+                    elif vals is not None and isinstance(
+                            tgt, ast.Tuple) and tgt.elts \
+                            and isinstance(tgt.elts[0], ast.Name):
+                        # `for f, v in kw.items()`
+                        ctx.env[tgt.elts[0].id] = StrSet(vals)
+                        for t in tgt.elts[1:]:
+                            _assign(t, TOP, ctx.env)
+                    else:
+                        interp._ev(gen.iter, ctx.env, frame)
+                        _assign(tgt, TOP, ctx.env)
+                return ctx.env
+
+            def __exit__(ctx, *a):
+                return False
+
+        return _Ctx()
+
+    def _str_tuple(self, node, env, frame):
+        """A literal (or module-constant) tuple/list of strings — or
+        the key set of a **kwargs dict (`kw.items()`/`kw.keys()`) —
+        or None."""
+        if isinstance(node, ast.Name):
+            v = env.get(node.id)
+            if isinstance(v, StrSet):
+                return v.values
+            return _module_str_tuple(frame.info, node.id)
+        if isinstance(node, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant)
+                and isinstance(e.value, str) for e in node.elts):
+            return tuple(e.value for e in node.elts)
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute) \
+                and node.func.attr in ("items", "keys") \
+                and isinstance(node.func.value, ast.Name):
+            v = env.get(node.func.value.id)
+            if isinstance(v, KwDict):
+                return tuple(sorted(v.entries))
+        return None
+
+    def _ev_name(self, node, env, frame):
+        if node.id in env:
+            return env[node.id]
+        if node.id == "SIMTIME_MAX":
+            return Sym("SIMTIME_MAX")
+        fn = self.project._lookup(frame.info, frame.fn, node.id)
+        if fn is not None:
+            return Func(fn, dict(env) if fn.parent else None)
+        return TOP
+
+    def _ev_attr(self, node, env, frame):
+        # shape/dtype-only access to a tree field is a META read: it
+        # is trace-time static and touches no data
+        if node.attr in _META_ATTRS and isinstance(node.value,
+                                                   ast.Attribute):
+            inner = self._ev(node.value.value, env, frame)
+            if isinstance(inner, Tree) and node.value.attr in \
+                    self.model.fields[inner.kind]:
+                self.access.record(self.access.meta, inner.kind,
+                                   node.value.attr,
+                                   self._site(frame, node))
+                return TOP
+        base = self._ev(node.value, env, frame)
+        if isinstance(base, Tree):
+            if node.attr in self.model.fields[base.kind]:
+                self._read(base.kind, node.attr, frame, node)
+                return Arr(self.model.dtype_of(base.kind, node.attr),
+                           node.attr)
+            if node.attr == "replace":
+                return Bound(base, "replace")
+            return TOP
+        if isinstance(base, Arr):
+            if node.attr in _META_ATTRS:
+                return TOP
+            return Bound(base, node.attr)
+        if isinstance(base, Bound):
+            return Bound(base.recv, node.attr)
+        # `equeue.q_push` / `nic.kick`-style module-function refs:
+        # the base Name is a module alias, so the base eval is TOP —
+        # resolve the whole dotted attribute instead
+        dotted = frame.info.aliases.resolve(node)
+        if dotted:
+            fn = self.project._by_dotted(dotted)
+            if fn is not None:
+                return Func(fn, None)
+        return TOP
+
+    def _ev_subscript(self, node, env, frame):
+        base = self._ev(node.value, env, frame)
+        self._ev(node.slice, env, frame)
+        if isinstance(base, Arr):
+            return Arr(base.dtype, base.origin, base.widened)
+        if isinstance(base, Bound):       # arr.at[idx] -> still bound
+            return base
+        if isinstance(base, Tup) and isinstance(node.slice,
+                                                ast.Constant) \
+                and isinstance(node.slice.value, int) \
+                and 0 <= node.slice.value < len(base.items):
+            return base.items[node.slice.value]
+        if isinstance(base, FuncList):    # registry[idx]: any member
+            return base
+        return TOP
+
+    # --- calls -------------------------------------------------------
+    def _ev_call(self, node, env, frame):
+        dotted = self._resolve(frame, node.func)
+        handler = self._dotted_call(node, dotted, env, frame)
+        if handler is not _UNHANDLED:
+            return handler
+        funcabs = self._ev(node.func, env, frame)
+        args = [self._ev(a, env, frame) for a in node.args]
+        kwargs = {}
+        for kw in node.keywords:
+            if kw.arg:
+                kwargs[kw.arg] = self._ev(kw.value, env, frame)
+            else:
+                self._ev(kw.value, env, frame)
+        if isinstance(funcabs, Bound):
+            return self._call_bound(funcabs, node, env, frame)
+        if isinstance(funcabs, (Func, FuncList, Partial)):
+            return self._call_fn(funcabs, args, kwargs, frame, node)
+        return TOP
+
+    def _dotted_call(self, node, dotted, env, frame):
+        if not dotted:
+            return _UNHANDLED
+        if dotted in _ROWOPS:
+            args = [self._ev(a, env, frame) for a in node.args]
+            arr = args[0] if args else TOP
+            return arr if isinstance(arr, Arr) else TOP
+        if dotted == "getattr" and len(node.args) >= 2:
+            base = self._ev(node.args[0], env, frame)
+            name = self._ev(node.args[1], env, frame)
+            if isinstance(base, Tree):
+                if isinstance(name, ast.Constant) and isinstance(
+                        name.value, str):
+                    if name.value in self.model.fields[base.kind]:
+                        self._read(base.kind, name.value, frame, node)
+                        return Arr(self.model.dtype_of(base.kind,
+                                                       name.value),
+                                   name.value)
+                elif isinstance(name, StrSet):
+                    for f in name.values:
+                        if f in self.model.fields[base.kind]:
+                            self._read(base.kind, f, frame, node)
+                else:
+                    self.access.bulk.append(("getattr(dynamic)",
+                                             *self._site(frame, node)))
+            return TOP
+        if dotted in ("functools.partial", "partial"):
+            target = self._ev(node.args[0], env, frame) \
+                if node.args else TOP
+            args = [self._ev(a, env, frame) for a in node.args[1:]]
+            kwargs = {kw.arg: self._ev(kw.value, env, frame)
+                      for kw in node.keywords if kw.arg}
+            return Partial(target, args, kwargs)
+        if dotted == "jax.vmap":
+            return self._ev(node.args[0], env, frame) \
+                if node.args else TOP
+        if dotted in ("jax.tree.map", "jax.tree_map",
+                      "jax.tree_util.tree_map"):
+            args = [self._ev(a, env, frame) for a in node.args]
+            trees = [a for a in args[1:] if isinstance(a, Tree)]
+            if trees:
+                self.access.bulk.append(("tree.map",
+                                         *self._site(frame, node)))
+                return trees[0]
+            return TOP
+        if dotted == "jax.lax.cond" and len(node.args) >= 3:
+            self._ev(node.args[0], env, frame)
+            ops = [self._ev(a, env, frame) for a in node.args[3:]]
+            ret = TOP
+            for br in (node.args[1], node.args[2]):
+                f = self._ev(br, env, frame)
+                ret = _merge(ret, self._call_fn(f, ops, {}, frame,
+                                                node))
+            return ret
+        if dotted == "jax.lax.switch" and len(node.args) >= 2:
+            self._ev(node.args[0], env, frame)
+            branches = self._ev(node.args[1], env, frame)
+            ops = [self._ev(a, env, frame) for a in node.args[2:]]
+            return self._call_fn(branches, ops, {}, frame, node)
+        if dotted == "jax.lax.while_loop" and len(node.args) >= 3:
+            init = self._ev(node.args[2], env, frame)
+            cond = self._ev(node.args[0], env, frame)
+            body = self._ev(node.args[1], env, frame)
+            self._call_fn(cond, [init], {}, frame, node)
+            ret = self._call_fn(body, [init], {}, frame, node)
+            return _merge(ret, init)
+        if dotted == "jax.lax.fori_loop" and len(node.args) >= 4:
+            f = self._ev(node.args[2], env, frame)
+            init = self._ev(node.args[3], env, frame)
+            ret = self._call_fn(f, [TOP, init], {}, frame, node)
+            return _merge(ret, init)
+        if dotted == "jax.lax.scan" and len(node.args) >= 2:
+            f = self._ev(node.args[0], env, frame)
+            init = self._ev(node.args[1], env, frame)
+            self._call_fn(f, [init, TOP], {}, frame, node)
+            return TOP
+        if dotted == "dataclasses.replace" and node.args:
+            target = self._ev(node.args[0], env, frame)
+            if isinstance(target, Tree):
+                self._replace_kwargs(target, node, env, frame)
+                return target
+            return TOP
+        if dotted.startswith("jax.numpy."):
+            return self._jnp_call(node, dotted.split(".", 2)[2], env,
+                                  frame)
+        return _UNHANDLED
+
+    def _jnp_call(self, node, attr, env, frame):
+        args = [self._ev(a, env, frame) for a in node.args]
+        kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+        if attr in _JNP_CASTS:
+            origin = args[0].origin if args and isinstance(args[0],
+                                                           Arr) \
+                else None
+            return Arr(_DT[attr], origin, widened=True)
+        if attr in ("asarray", "array", "full", "zeros", "ones",
+                    "full_like", "zeros_like", "ones_like", "arange"):
+            dt = None
+            if "dtype" in kwargs:
+                dt = _dtype_from_node(kwargs["dtype"])
+            elif attr == "full" and len(node.args) >= 3:
+                dt = _dtype_from_node(node.args[2])
+            if dt:
+                return Arr(dt, None, widened=True)
+            if attr in ("asarray", "array") and args \
+                    and isinstance(args[0], Arr):
+                return args[0]
+            return TOP
+        if attr in _JNP_BOOL:
+            return Arr("bool")
+        if attr in _JNP_REDUCE:
+            if "dtype" in kwargs:
+                dt = _dtype_from_node(kwargs["dtype"])
+                if dt:
+                    return Arr(dt, None, widened=True)
+            arrs = [a for a in args if isinstance(a, Arr)]
+            return arrs[0] if arrs else TOP
+        if attr in _JNP_PROMOTING:
+            arrs = [a for a in args if isinstance(a, Arr)]
+            if attr == "where" and len(args) >= 3:
+                arrs = [a for a in args[1:3] if isinstance(a, Arr)]
+            if not arrs:
+                return TOP
+            out = arrs[0]
+            for a in arrs[1:]:
+                out = Arr(_promote(out.dtype, a.dtype),
+                          out.origin if out.origin == a.origin
+                          else None,
+                          out.widened and a.widened)
+            return out
+        return TOP
+
+    def _call_bound(self, bound, node, env, frame):
+        recv, name = bound.recv, bound.name
+        if isinstance(recv, Tree) and name == "replace":
+            self._replace_kwargs(recv, node, env, frame)
+            return recv
+        if isinstance(recv, Arr):
+            for a in node.args:
+                self._ev(a, env, frame)
+            if name == "astype" and node.args:
+                dt = _dtype_from_node(node.args[0])
+                if dt is None and isinstance(node.args[0], ast.Name):
+                    dt = _DT.get(_module_alias(
+                        frame.info, node.args[0].id,
+                        tail=True) or "")
+                return Arr(dt or "?", recv.origin, widened=True)
+            if name in ("set", "add", "get", "mul", "reshape",
+                        "astype"):
+                return Arr(recv.dtype, recv.origin, recv.widened)
+            if name in _JNP_BOOL:
+                return Arr("bool")
+            if name in _JNP_REDUCE:
+                return Arr(recv.dtype, recv.origin, recv.widened)
+        return TOP
+
+    def _replace_kwargs(self, tree, node, env, frame):
+        """`.replace(field=..., **{...})` — the ONLY write channel
+        into a pytree. Records a write per named field; the dict-comp
+        form over a literal field tuple records each member; anything
+        dynamic becomes a bulk note (visible in the matrix, never
+        silently dropped)."""
+        for kw in node.keywords:
+            if kw.arg is not None:
+                if kw.arg in self.model.fields[tree.kind]:
+                    self._write(tree.kind, kw.arg, frame, kw.value)
+                self._ev(kw.value, env, frame)
+                continue
+            # **{...}
+            val = kw.value
+            if isinstance(val, ast.DictComp):
+                keys = None
+                for gen in val.generators:
+                    vals = self._str_tuple(gen.iter, env, frame)
+                    if vals is None or not isinstance(val.key,
+                                                      ast.Name):
+                        continue
+                    tgt = gen.target
+                    if isinstance(tgt, ast.Tuple) and tgt.elts:
+                        tgt = tgt.elts[0]
+                    if isinstance(tgt, ast.Name) \
+                            and val.key.id == tgt.id:
+                        keys = vals
+                if keys:
+                    for f in keys:
+                        if f in self.model.fields[tree.kind]:
+                            self._write(tree.kind, f, frame, val)
+                    with self._comp_env(val, env, frame) as cenv:
+                        self._ev(val.value, cenv, frame)
+                    continue
+            if isinstance(val, ast.Dict) and all(
+                    isinstance(k, ast.Constant) for k in val.keys):
+                for k, v in zip(val.keys, val.values):
+                    if k.value in self.model.fields[tree.kind]:
+                        self._write(tree.kind, k.value, frame, v)
+                    self._ev(v, env, frame)
+                continue
+            self.access.bulk.append(("replace(**dynamic)",
+                                     *self._site(frame, node)))
+            self._ev(val, env, frame)
+
+    # --- dtype-flow rules --------------------------------------------
+    def _ev_binop(self, node, env, frame):
+        l = self._ev(node.left, env, frame)
+        r = self._ev(node.right, env, frame)
+        if isinstance(node.op, _ARITH_OPS) \
+                and isinstance(l, Arr) and isinstance(r, Arr):
+            for narrow, wide in ((l, r), (r, l)):
+                if (wide.dtype == "i64" and narrow.dtype == "i32"
+                        and narrow.origin is not None
+                        and not narrow.widened):
+                    self._emit(STF401, frame, node,
+                               f"i32 `{narrow.origin}` flows into "
+                               f"i64 arithmetic"
+                               + (f" with `{wide.origin}`"
+                                  if wide.origin else "")
+                               + " without explicit widening")
+            return Arr(_promote(l.dtype, r.dtype), None, True)
+        if isinstance(l, FuncList) and isinstance(r, FuncList):
+            return FuncList(l.items + r.items)
+        if isinstance(l, Arr) and isinstance(r, Arr):
+            return Arr(_promote(l.dtype, r.dtype), None, True)
+        if isinstance(l, Arr):
+            return Arr(l.dtype, l.origin, l.widened)
+        if isinstance(r, Arr):
+            return Arr(r.dtype, r.origin, r.widened)
+        return TOP
+
+    def _ev_compare(self, node, env, frame):
+        vals = [self._ev(node.left, env, frame)]
+        vals += [self._ev(c, env, frame) for c in node.comparators]
+        for a, b in zip(vals, vals[1:]):
+            for x, y in ((a, b), (b, a)):
+                if isinstance(x, Sym) and x.name == "SIMTIME_MAX" \
+                        and isinstance(y, Arr) \
+                        and y.dtype not in ("i64", "?"):
+                    self._emit(STF403, frame, node,
+                               "SIMTIME_MAX compared against "
+                               f"{y.dtype} value"
+                               + (f" `{y.origin}`" if y.origin
+                                  else ""))
+                if isinstance(x, Arr) and x.dtype == "f32" \
+                        and x.origin is not None \
+                        and isinstance(y, Arr) and y.dtype == "i64" \
+                        and not x.widened:
+                    self._emit(STF402, frame, node,
+                               f"f32 `{x.origin}` compared against "
+                               "an i64 quantity"
+                               + (f" (`{y.origin}`)" if y.origin
+                                  else ""))
+        return Arr("bool")
+
+
+_NO_RETURN = object()
+_UNHANDLED = object()
+
+
+def _assign(target, val, env):
+    if isinstance(target, ast.Name):
+        env[target.id] = val
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        items = val.items if isinstance(val, Tup) \
+            and len(val.items) == len(target.elts) \
+            else [TOP] * len(target.elts)
+        for t, v in zip(target.elts, items):
+            _assign(t, v, env)
+    # attribute/subscript targets mutate nothing we track
+
+
+def _bind_params(fnode, args, kwargs, env):
+    a = fnode.args
+    params = [p.arg for p in a.posonlyargs + a.args]
+    kwonly = {p.arg for p in a.kwonlyargs}
+    for name, val in zip(params, args):
+        env[name] = val
+    leftover = {}
+    for name, val in kwargs.items():
+        if name in params or name in kwonly:
+            env[name] = val
+        else:
+            leftover[name] = val
+    if a.kwarg:
+        env[a.kwarg.arg] = KwDict(leftover)
+
+
+def _sig(v):
+    if isinstance(v, Tree):
+        return ("T", v.kind)
+    if isinstance(v, Arr):
+        return ("A", v.dtype, v.origin, v.widened)
+    if isinstance(v, Tup):
+        return ("t",) + tuple(_sig(i) for i in v.items)
+    if isinstance(v, (Func, FuncList, Partial)):
+        return ("F",)
+    if isinstance(v, KwDict):
+        return ("K",) + tuple(sorted(
+            (k, _sig(val)) for k, val in v.entries.items()))
+    return ("?",)
+
+
+def _bindkey(args, kwargs):
+    return (tuple(_sig(a) for a in args),
+            tuple(sorted((k, _sig(v)) for k, v in kwargs.items())))
+
+
+def _module_alias(info, name, tail=False):
+    """Module-level `X = jnp.int64`-style alias: returns the dotted
+    target (or with tail=True just its last attribute)."""
+    cached = getattr(info, "_stateflow_alias", None)
+    if cached is None:
+        cached = {}
+        for stmt in info.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and isinstance(stmt.value,
+                                   (ast.Attribute, ast.Name)):
+                dotted = info.aliases.resolve(stmt.value)
+                if dotted and "." in dotted:
+                    cached[stmt.targets[0].id] = dotted
+        info._stateflow_alias = cached
+    dotted = cached.get(name)
+    if dotted and tail:
+        return dotted.rsplit(".", 1)[1]
+    return dotted
+
+
+def _module_str_tuple(info, name):
+    cached = getattr(info, "_stateflow_strtup", None)
+    if cached is None:
+        cached = {}
+        for stmt in info.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and isinstance(stmt.value, (ast.Tuple, ast.List)) \
+                    and stmt.value.elts and all(
+                        isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                        for e in stmt.value.elts):
+                cached[stmt.targets[0].id] = tuple(
+                    e.value for e in stmt.value.elts)
+        info._stateflow_strtup = cached
+    return cached.get(name)
+
+
+# --- driver ----------------------------------------------------------
+
+def analyze(cache, project: _Project = None):
+    """-> (matrix dict, violations). The matrix maps entry name ->
+    {kind: {"reads": {...}, "writes": {...}, "meta": {...}},
+    "bulk": [...]} with access sites; tools/state_matrix.py renders
+    it."""
+    model = load_state_model(cache)
+    violations: list[Violation] = []
+    if model.missing:
+        return {}, violations
+    if model.errors:
+        for err in model.errors:
+            violations.append(Violation(STF300, STATE_PATH, 0, err,
+                                        snippet=err))
+        return {}, violations
+    if project is None:
+        project = _Project(cache)
+
+    matrix = {}
+    vseen: set = set()
+    drain_access = None
+    resolved = 0
+    for entry, fqn, binding, in_drain in ENTRIES:
+        mod, _, name = fqn.rpartition(".")
+        info = project.modules.get(mod)
+        fn = info.functions.get(name) if info else None
+        if fn is None:
+            # module present but the pass function gone = a RENAMED
+            # entry, which must fail loudly (a silently skipped entry
+            # shrinks the matrix and the STF302 read census). A
+            # missing module is a fixture repo exercising a subset —
+            # skipped, like shimproto's both-sides-missing rule.
+            if info is not None or entry == "drain":
+                violations.append(Violation(
+                    STF300, STATE_PATH, 0,
+                    f"entry pass `{fqn}` ({entry}) not found — "
+                    "renamed? update stateflow.ENTRIES in the same "
+                    "change", snippet=fqn))
+            continue
+        resolved += 1
+        interp = _EntryInterp(project, model, violations, vseen)
+        interp.run_entry(fn, binding)
+        matrix[entry] = _pack_access(interp.access)
+        if in_drain:
+            drain_access = interp.access
+    if resolved == 0:
+        violations.append(Violation(
+            STF300, STATE_PATH, 0,
+            "no stateflow entry passes resolved — wrong root or "
+            "renamed engine modules", snippet="entries"))
+        return matrix, violations
+
+    # vacuity guard: the drain subgraph reaches the event handlers,
+    # TCP machine and NIC — a tiny read set means the interpreter
+    # lost the plot, which must fail loudly, not pass green. The
+    # threshold scales with the model so fixture repos stay usable.
+    floor = min(10, len(model.fields[HOSTS]) // 2)
+    if drain_access is not None \
+            and len(drain_access.reads[HOSTS]) < floor:
+        violations.append(Violation(
+            STF300, STATE_PATH, 0,
+            f"drain subgraph reads only "
+            f"{len(drain_access.reads[HOSTS])} of "
+            f"{len(model.fields[HOSTS])} Hosts fields — vacuous "
+            "scan", snippet="drain-vacuity"))
+
+    violations.extend(_contract_violations(model, matrix,
+                                           drain_access))
+    return matrix, violations
+
+
+def _pack_access(acc: Access):
+    out = {}
+    for kind in (HOSTS, HP, SH):
+        out[kind] = {
+            "reads": dict(sorted(acc.reads[kind].items())),
+            "writes": dict(sorted(acc.writes[kind].items())),
+            "meta": dict(sorted(acc.meta[kind].items())),
+        }
+    out["bulk"] = sorted(set(acc.bulk))
+    return out
+
+
+def _contract_violations(model: StateModel, matrix, drain_access):
+    out = []
+    # STF301: every Hosts field sectioned
+    for field in model.fields[HOSTS]:
+        if model.section_of(field) is None:
+            out.append(Violation(
+                STF301, STATE_PATH, model.linenos.get(field, 0),
+                f"Hosts field `{field}` matches no STATE_SECTIONS "
+                "prefix (section_of would return 'other')"))
+    # STF302: dead / write-only columns
+    read_anywhere, written_anywhere = set(), set()
+    for entry in matrix.values():
+        read_anywhere |= set(entry[HOSTS]["reads"])
+        written_anywhere |= set(entry[HOSTS]["writes"])
+    for field in model.fields[HOSTS]:
+        if field in read_anywhere or field in HOST_CONSUMED:
+            continue
+        shape = ("write-only" if field in written_anywhere else "dead")
+        out.append(Violation(
+            STF302, STATE_PATH, model.linenos.get(field, 0),
+            f"Hosts column `{field}` is {shape}: no analyzed pass "
+            "reads it and no host-side consumer is declared "
+            "(lint/stateflow.HOST_CONSUMED)"))
+    # STF303: cold columns out of the drain subgraph
+    if drain_access is not None:
+        for field in sorted(model.cold):
+            for table, verb in ((drain_access.reads[HOSTS], "read"),
+                                (drain_access.writes[HOSTS],
+                                 "written")):
+                if field in table:
+                    file, line = table[field]
+                    out.append(Violation(
+                        STF303, file, line,
+                        f"cold column `{field}` is {verb} inside the "
+                        "drain-pass subgraph (engine/state.py "
+                        "COLD_FIELDS)"))
+    # unknown cold names are a contract typo, not a silent no-op
+    for field in sorted(model.cold - set(model.fields[HOSTS])):
+        out.append(Violation(
+            STF300, STATE_PATH, 0,
+            f"COLD_FIELDS names `{field}`, which is not a Hosts "
+            "field", snippet=f"cold:{field}"))
+    return out
+
+
+def check(cache, project: _Project = None) -> list:
+    """simlint family entry point. `project` shares the tracing
+    module index when the caller already built one (cli.collect) —
+    building it is ~1.5s of the gate's wall."""
+    _, violations = analyze(cache, project)
+    violations.sort(key=lambda v: (v.file, v.line, v.rule))
+    return violations

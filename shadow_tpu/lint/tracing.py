@@ -515,10 +515,13 @@ def _unhashable_statics(fnode, kw):
             yield p.arg
 
 
-def check(cache) -> list:
+def check(cache, project: "_Project" = None) -> list:
     """Run the tracing family: build the reachability set, then scan
-    every reachable function that lives in the report scope."""
-    project = _Project(cache)
+    every reachable function that lives in the report scope.
+    `project` reuses an already-built module index (cli.collect
+    shares one with the stateflow family)."""
+    if project is None:
+        project = _Project(cache)
     out = []
     seen = set()
     for fn in project.reachable:

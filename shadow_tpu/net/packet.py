@@ -93,7 +93,10 @@ def make(src, dst, sport, dport, flags, seq=0, ack=0, wnd=0, length=0,
 
 
 def wire_bytes(pkt):
-    """Total on-wire size for bandwidth accounting."""
+    """Total on-wire size for bandwidth accounting. Widened to i64 at
+    the source: every consumer is i64 byte/ns arithmetic (NIC busy
+    horizons, buffer backlogs), and the packet words are i32
+    (simlint STF401)."""
     proto = pkt[FLAGS] & PROTO_MASK
     hdr = jnp.where(proto == PROTO_TCP, HEADER_SIZE_TCPIPETH, HEADER_SIZE_UDPIPETH)
-    return pkt[LEN] + hdr
+    return (pkt[LEN] + hdr).astype(jnp.int64)

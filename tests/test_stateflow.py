@@ -1,0 +1,397 @@
+"""stateflow: the pass x field access matrix and its STF contracts.
+
+Three layers of proof, mirroring the simlint test philosophy (every
+check family must demonstrably FIRE, and the repo itself must be
+clean):
+
+1. hand-derived access matrices — the expected read/write sets of
+   small passes (NIC rx admission, the UDP deliver -> q_push chain,
+   cap-peak sampling) are derived by reading the source and pinned
+   exactly; SACK-scoreboard invariants are pinned on the tcp.timer
+   and nic.tx columns;
+2. fixture repos where a cold-column drain read, a dead column, an
+   unsectioned field and an unwidened i32->i64 flow each produce
+   exactly one NAMED violation;
+3. acceptance — a cold-column read PLANTED into the real engine's
+   drain subgraph fails `python -m tools.simlint` by rule name, and
+   engine.state.section_of covers every live Hosts field (strict
+   mode raises on anything else).
+
+Everything except the section_of test is jax-free (the analyzer is
+pure stdlib AST; the loader never touches shadow_tpu.__init__).
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import importlib
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.simlint import load  # noqa: E402
+
+lint = load()
+core = sys.modules["shadow_tpu.lint.core"]
+stateflow = importlib.import_module("shadow_tpu.lint.stateflow")
+
+
+@pytest.fixture(scope="module")
+def repo_matrix():
+    """The analyzer's output on the repo itself (shared: one ~1.5s
+    _Project build for the whole module)."""
+    cache = core.SourceCache(REPO)
+    matrix, violations = stateflow.analyze(cache)
+    return matrix, violations
+
+
+def make_repo(tmp_path, files):
+    for rel, content in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(content)
+    return str(tmp_path)
+
+
+def run_cli(args, cwd=REPO):
+    return subprocess.run([sys.executable, "-m", *args],
+                          cwd=cwd, capture_output=True, text=True)
+
+
+# --- the state model --------------------------------------------------
+
+def test_model_parses_fields_dtypes_sections():
+    cache = core.SourceCache(REPO)
+    m = stateflow.load_state_model(cache)
+    assert not m.errors, m.errors
+    # the socket table alone is ~45 columns; every field gets a dtype
+    assert len(m.fields["hosts"]) > 60
+    assert sum(1 for f in m.fields["hosts"] if f.startswith("sk_")) \
+        >= 40
+    for kind in ("hosts", "hp", "sh"):
+        unknown = [f for f, dt in m.fields[kind].items()
+                   if dt == "?" and f != "rng_root"]
+        assert not unknown, (kind, unknown)
+    assert m.fields["hosts"]["eq_time"] == "i64"
+    assert m.fields["hosts"]["sk_cwnd"] == "f32"
+    assert m.fields["hp"]["pcap_on"] == "bool"
+    assert m.fields["sh"]["seed32"] == "u32"
+    # every Hosts field sectioned, cold fields are real fields
+    assert all(m.section_of(f) for f in m.fields["hosts"])
+    assert m.cold and m.cold <= set(m.fields["hosts"])
+
+
+def test_section_of_strict_and_all_fields_sectioned():
+    """Satellite: section_of fails loudly in strict mode, and every
+    LIVE Hosts field (via the dataclass, not the parsed model) maps
+    to a section."""
+    import dataclasses
+    from shadow_tpu.engine.state import Hosts, section_of
+    for f in dataclasses.fields(Hosts):
+        assert section_of(f.name, strict=True) != "other"
+    assert section_of("no_such_field") == "other"
+    with pytest.raises(KeyError):
+        section_of("no_such_field", strict=True)
+
+
+# --- the repo's own matrix: hand-derived expectations ----------------
+
+def test_repo_scan_is_clean(repo_matrix):
+    _, violations = repo_matrix
+    assert violations == [], [v.render() for v in violations]
+
+
+def test_hand_derived_nic_rx_admit(repo_matrix):
+    """nic.rx_admit (net/nic.py): reads the rx busy horizon, rolls
+    the backlog against the buffer, counts drops. Derived by hand
+    from the function body — stateflow must reproduce it exactly."""
+    matrix, _ = repo_matrix
+    acc = matrix["nic.rx_admit"]
+    assert sorted(acc["hosts"]["reads"]) == ["nic_rx_until", "stats"]
+    assert sorted(acc["hosts"]["writes"]) == ["nic_rx_until", "stats"]
+    assert sorted(acc["hp"]["reads"]) == ["bw_down", "nic_buf"]
+    assert acc["sh"]["reads"] == {}
+
+
+def test_hand_derived_udp_deliver(repo_matrix):
+    """udp.deliver (net/udp.py): advances the stream cursor, counts
+    bytes, reads the socket generation for the wake, and pushes an
+    EV_APP through equeue.q_push (which touches every eq_* column
+    plus the overflow stat). Derived by hand across the helper
+    boundary — the analyzer must follow q_push."""
+    matrix, _ = repo_matrix
+    acc = matrix["udp.deliver"]
+    eq = ["eq_ctr", "eq_kind", "eq_next", "eq_pkt", "eq_seq",
+          "eq_time"]
+    assert sorted(acc["hosts"]["reads"]) == sorted(
+        eq + ["sk_rcv_nxt", "sk_timer_gen", "stats"])
+    assert sorted(acc["hosts"]["writes"]) == sorted(
+        eq + ["sk_rcv_nxt", "stats"])
+
+
+def test_hand_derived_cap_peaks(repo_matrix):
+    """update_cap_peaks samples four occupancy gauges and the peak
+    table — and touches nothing else (that is WHY cap_peaks can be a
+    cold column)."""
+    matrix, _ = repo_matrix
+    acc = matrix["cap_peaks"]
+    assert sorted(acc["hosts"]["reads"]) == [
+        "cap_peaks", "eq_time", "ob_cnt", "sk_used", "txq_cnt"]
+    assert sorted(acc["hosts"]["writes"]) == ["cap_peaks"]
+
+
+def test_sack_scoreboard_update_invariants(repo_matrix):
+    """The SACK scoreboard's access contract across passes:
+
+    - tcp.rx accumulates peer SACK blocks and consumes the receive
+      scoreboard: all four range tables are read AND written;
+    - the RTO path (tcp.timer) CLEARS the sender scoreboard (RFC 2018
+      s8 renege rule) and rewinds snd_nxt, but must never touch the
+      receive scoreboard (sk_ooo_*) and never take an RTT sample
+      (Karn: sk_srtt/sk_rttvar are not written);
+    - the NIC pull encodes the two most urgent receive ranges on
+      every ACK-bearing segment: sk_ooo_* are read, never written.
+    """
+    matrix, _ = repo_matrix
+    rx, timer, tx = matrix["tcp.rx"], matrix["tcp.timer"], \
+        matrix["nic.tx"]
+    for f in ("sk_ooo_s", "sk_ooo_e", "sk_sack_s", "sk_sack_e"):
+        assert f in rx["hosts"]["reads"]
+        assert f in rx["hosts"]["writes"]
+    for f in ("sk_sack_s", "sk_sack_e", "sk_snd_nxt", "sk_hole_end"):
+        assert f in timer["hosts"]["writes"], f
+    for f in ("sk_ooo_s", "sk_ooo_e", "sk_srtt", "sk_rttvar",
+              "sk_rcv_nxt"):
+        assert f not in timer["hosts"]["writes"], f
+    for f in ("sk_ooo_s", "sk_ooo_e"):
+        assert f in tx["hosts"]["reads"]
+        assert f not in tx["hosts"]["writes"]
+
+
+def test_drain_subgraph_covers_the_event_machine(repo_matrix):
+    """Vacuity guard on the guard: the drain entry must traverse the
+    handlers into TCP/NIC/app code (the cold-column gate is only as
+    strong as this reach)."""
+    matrix, _ = repo_matrix
+    drain = matrix["drain"]["hosts"]
+    for f in ("eq_time", "sk_state", "sk_sack_s", "txq_pkt",
+              "app_r", "rng_ctr", "nic_busy", "hw_cnt"):
+        assert f in drain["reads"], f
+    # and the declared cold columns are genuinely out of it
+    cache = core.SourceCache(REPO)
+    model = stateflow.load_state_model(cache)
+    for f in sorted(model.cold):
+        assert f not in drain["reads"], f
+        assert f not in drain["writes"], f
+
+
+def test_drain_excludes_exchange_only_columns(repo_matrix):
+    """ob_next is written by the exchange carry and read by the
+    window advance — never inside the drain. tr_* only move in the
+    exchange (trace records). This is the measured basis of
+    COLD_FIELDS."""
+    matrix, _ = repo_matrix
+    assert "ob_next" in matrix["exchange"]["hosts"]["writes"]
+    assert "ob_next" in matrix["advance"]["hosts"]["reads"]
+    assert "tr_pkt" in matrix["exchange"]["hosts"]["writes"]
+
+
+# --- fixture repos: each rule fires exactly once, by name ------------
+
+FIX_STATE = '''\
+import chex
+import jax.numpy as jnp
+
+STATE_SECTIONS = (
+    ("eq_", "event_queue"),
+    ("sk_", "tcp"),
+    ("tr_", "trace_ring"),
+    ("stats", "stats"),
+)
+
+COLD_FIELDS = frozenset({"tr_cnt"})
+
+
+@chex.dataclass
+class Hosts:
+    eq_time: jnp.ndarray   # [H, Q] i64
+    eq_ctr: jnp.ndarray    # [H] i32
+    sk_cwnd: jnp.ndarray   # [H, S] f32
+    tr_cnt: jnp.ndarray    # [H] i32
+    stats: jnp.ndarray     # [H, N] i64
+@EXTRA@
+
+@chex.dataclass
+class HostParams:
+    hid: jnp.ndarray       # [H] i32
+
+
+@chex.dataclass
+class Shared:
+    stop_time: jnp.ndarray  # i64
+'''
+
+FIX_WINDOW = '''\
+import jax.numpy as jnp
+
+
+def drain_window(hosts, hp, sh, wend, cfg, pc):
+    ctr = hosts.eq_ctr.astype(jnp.int64)
+    cw = hosts.sk_cwnd * 2.0
+@PLANT@
+    return hosts.replace(
+        eq_time=hosts.eq_time + ctr,
+        eq_ctr=hosts.eq_ctr + 1,
+        sk_cwnd=cw,
+        stats=hosts.stats + hp.hid.astype(jnp.int64)[:, None],
+    ), pc
+
+
+def exchange(hosts, hp, sh, cfg):
+    return hosts.replace(tr_cnt=hosts.tr_cnt + 1)
+
+
+def update_cap_peaks(hosts):
+    return hosts
+
+
+def next_wakeup(hosts):
+    return hosts.eq_time
+'''
+
+
+def fixture_violations(tmp_path, state_extra="", plant="    pass"):
+    root = make_repo(tmp_path, {
+        "shadow_tpu/engine/state.py": FIX_STATE.replace(
+            "@EXTRA@", state_extra),
+        "shadow_tpu/engine/window.py": FIX_WINDOW.replace(
+            "@PLANT@", plant),
+    })
+    return stateflow.check(core.SourceCache(root))
+
+
+def test_fixture_clean_base(tmp_path):
+    assert fixture_violations(tmp_path) == []
+
+
+def test_fixture_cold_column_drain_read(tmp_path):
+    vs = fixture_violations(
+        tmp_path, plant="    cold = hosts.tr_cnt + 0")
+    assert len(vs) == 1 and vs[0].rule == "STF303", vs
+    assert "tr_cnt" in vs[0].message
+    assert vs[0].file == "shadow_tpu/engine/window.py"
+
+
+def test_fixture_dead_column(tmp_path):
+    vs = fixture_violations(
+        tmp_path, state_extra="    sk_ghost: jnp.ndarray  # [H] i32\n")
+    assert len(vs) == 1 and vs[0].rule == "STF302", vs
+    assert "sk_ghost" in vs[0].message
+    assert vs[0].file == "shadow_tpu/engine/state.py"
+
+
+def test_fixture_unsectioned_field(tmp_path):
+    # read it in the drain so the ONLY failure is the missing section
+    vs = fixture_violations(
+        tmp_path,
+        state_extra="    zz_mystery: jnp.ndarray  # [H] i64\n",
+        plant="    m = hosts.zz_mystery + jnp.int64(1)")
+    assert len(vs) == 1 and vs[0].rule == "STF301", vs
+    assert "zz_mystery" in vs[0].message
+
+
+def test_fixture_unwidened_i32_flow(tmp_path):
+    vs = fixture_violations(
+        tmp_path, plant="    t = hosts.eq_time + hosts.eq_ctr")
+    assert len(vs) == 1 and vs[0].rule == "STF401", vs
+    assert "eq_ctr" in vs[0].message
+
+
+def test_fixture_f32_vs_i64_compare(tmp_path):
+    vs = fixture_violations(
+        tmp_path, plant="    c = hosts.sk_cwnd > hosts.eq_time")
+    assert len(vs) == 1 and vs[0].rule == "STF402", vs
+    assert "sk_cwnd" in vs[0].message
+
+
+def test_fixture_simtime_sentinel(tmp_path):
+    plant = ("    from shadow_tpu.core.simtime import SIMTIME_MAX\n"
+             "    s = hosts.eq_ctr == SIMTIME_MAX")
+    vs = fixture_violations(tmp_path, plant=plant)
+    assert len(vs) == 1 and vs[0].rule == "STF403", vs
+
+
+def test_renamed_entry_pass_fails_loudly(tmp_path):
+    """A pass function that disappears from a module that still
+    exists is a RENAME — silently dropping its matrix column would
+    shrink the STF302 read census and the CI artifact unnoticed, so
+    it must be an STF300."""
+    root = make_repo(tmp_path, {
+        "shadow_tpu/engine/state.py": FIX_STATE.replace("@EXTRA@", ""),
+        "shadow_tpu/engine/window.py": FIX_WINDOW
+        .replace("@PLANT@", "    pass")
+        .replace("def update_cap_peaks", "def update_cap_peaks_v2"),
+    })
+    vs = stateflow.check(core.SourceCache(root))
+    assert len(vs) == 1 and vs[0].rule == "STF300", vs
+    assert "update_cap_peaks" in vs[0].message
+    assert "cap_peaks" in vs[0].message
+
+
+# --- acceptance: planting a cold read in the REAL drain fails the
+# gate by name ---------------------------------------------------------
+
+def test_planted_cold_read_fails_gate_by_name(tmp_path):
+    root = str(tmp_path / "repo")
+    shutil.copytree(os.path.join(REPO, "shadow_tpu"),
+                    os.path.join(root, "shadow_tpu"),
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    shutil.copytree(os.path.join(REPO, "tools"),
+                    os.path.join(root, "tools"),
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    window = os.path.join(root, "shadow_tpu/engine/window.py")
+    with open(window) as f:
+        src = f.read()
+    anchor = "    slot, t = equeue.q_min(row)\n"
+    assert anchor in src
+    with open(window, "w") as f:
+        f.write(src.replace(
+            anchor, anchor + "    _cold = jnp.minimum(row.tr_cnt, 1)\n",
+            1))
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.simlint", "--root", root],
+        cwd=REPO, capture_output=True, text=True)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "STF303" in r.stdout and "tr_cnt" in r.stdout, r.stdout
+
+
+# --- the front-end tool ------------------------------------------------
+
+def test_state_matrix_json_and_markdown(tmp_path):
+    out = str(tmp_path / "m.json")
+    r = run_cli(["tools.state_matrix", "--json", "-o", out])
+    assert r.returncode == 0, r.stdout + r.stderr
+    with open(out) as f:
+        data = json.load(f)
+    assert sorted(data) == ["cold_fields", "entries", "fields",
+                            "root", "sections", "version"]
+    assert "drain" in data["entries"]
+    drain = data["entries"]["drain"]["hosts"]
+    assert "sk_state" in drain["reads"]
+    # access sites are (file, line) pairs
+    f0, l0 = next(iter(drain["reads"].values()))
+    assert f0.endswith(".py") and isinstance(l0, int)
+    assert data["fields"]["hosts"]["tr_cnt"]["cold"] is True
+    assert data["fields"]["hosts"]["eq_time"]["section"] \
+        == "event_queue"
+    assert sorted(data["cold_fields"]) == sorted(
+        stateflow.load_state_model(core.SourceCache(REPO)).cold)
+
+    r = run_cli(["tools.state_matrix", "--markdown"])
+    assert r.returncode == 0
+    assert "| `eq_time` | i64 | event_queue |" in r.stdout

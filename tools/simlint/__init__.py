@@ -1,11 +1,13 @@
 """`python -m tools.simlint` — the simlint static-analysis gate.
 
 The implementation lives in shadow_tpu/lint/ (determinism lints, JAX
-tracing-hazard lints, shim-protocol conformance; see
-docs/static-analysis.md). This wrapper loads that package WITHOUT
-importing the `shadow_tpu` package itself: shadow_tpu/__init__.py
-imports jax (seconds of startup and an accelerator-config side
-effect), and a lint gate must stay sub-second and dependency-free.
+tracing-hazard lints, shim-protocol conformance, state-access/dtype
+flow; see docs/static-analysis.md). This wrapper loads that package
+WITHOUT importing the `shadow_tpu` package itself:
+shadow_tpu/__init__.py imports jax (seconds of startup and an
+accelerator-config side effect), and the lint gate must stay a
+few-seconds, dependency-free check — it runs on a CI box with no jax
+installed at all (pinned by test_lint.test_gate_runs_without_jax).
 """
 
 import importlib.util

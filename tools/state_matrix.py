@@ -1,0 +1,186 @@
+"""state_matrix: the measured pass x field state-access matrix.
+
+Front end for ``shadow_tpu.lint.stateflow`` (docs/static-analysis.md):
+prints which ``Hosts``/``HostParams``/``Shared`` columns each jitted
+pass reads and writes — the ground truth the ROADMAP item-1 hot/cold
+socket-table split is designed from, and the artifact CI uploads so
+the split stays reviewable after the fact.
+
+Usage (from the repo root; never imports jax — safe anywhere)::
+
+    python -m tools.state_matrix               # aligned text table
+    python -m tools.state_matrix --markdown    # docs-ready table
+    python -m tools.state_matrix --json        # machine-readable
+    python -m tools.state_matrix --json -o state_matrix.json
+
+Cells: ``RW`` read+written, ``R`` read, ``W`` written, ``s``
+shape/dtype metadata only, blank untouched. A ``*`` after the field
+name marks a COLD_FIELDS column (engine/state.py) — the STF303
+contract that it stays out of the ``drain`` column. The matrix is the
+union over engine configurations (static ``cfg.*`` branches are all
+traversed). ``W`` cells on HostParams/Shared are local VIEW rebinds
+(the ``hp.replace(app_kind=...)`` per-process view in the app
+dispatcher), never persisted state — only Hosts columns carry state
+across passes.
+
+Exit codes: 0 matrix produced, 2 analysis-integrity failure (the
+violations are printed; ``python -m tools.simlint`` gates them).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import sys
+
+
+def build(root: str):
+    """-> (matrix, model, violations) via the standalone lint loader
+    (no shadow_tpu.__init__, no jax)."""
+    from tools.simlint import load
+    load()
+    stateflow = importlib.import_module("shadow_tpu.lint.stateflow")
+    core = importlib.import_module("shadow_tpu.lint.core")
+    cache = core.SourceCache(root)
+    model = stateflow.load_state_model(cache)
+    matrix, violations = stateflow.analyze(cache)
+    return matrix, model, violations
+
+
+def _cell(entry_acc, kind, field):
+    r = field in entry_acc[kind]["reads"]
+    w = field in entry_acc[kind]["writes"]
+    if r and w:
+        return "RW"
+    if r:
+        return "R"
+    if w:
+        return "W"
+    if field in entry_acc[kind]["meta"]:
+        return "s"
+    return ""
+
+
+def _rows(matrix, model, kind):
+    entries = list(matrix)
+    rows = []
+    for field in model.fields[kind]:
+        label = field + ("*" if kind == "hosts"
+                         and field in model.cold else "")
+        rows.append([label, model.dtype_of(kind, field)]
+                    + ([model.section_of(field) or "other"]
+                       if kind == "hosts" else [])
+                    + [_cell(matrix[e], kind, field) for e in entries])
+    return entries, rows
+
+
+_KIND_TITLES = (("hosts", "Hosts (mutable per-host state)"),
+                ("hp", "HostParams (read-only config)"),
+                ("sh", "Shared (replicated tables/scalars)"))
+
+
+def render_text(matrix, model) -> str:
+    out = []
+    for kind, title in _KIND_TITLES:
+        entries, rows = _rows(matrix, model, kind)
+        header = (["field", "dtype"]
+                  + (["section"] if kind == "hosts" else [])
+                  + entries)
+        widths = [max(len(str(r[i])) for r in [header] + rows)
+                  for i in range(len(header))]
+        out.append(f"## {title}")
+        out.append("  ".join(h.ljust(w)
+                             for h, w in zip(header, widths)))
+        for r in rows:
+            out.append("  ".join(str(c).ljust(w)
+                                 for c, w in zip(r, widths)))
+        out.append("")
+    bulk = sorted({b for e in matrix.values() for b in e["bulk"]})
+    if bulk:
+        out.append("whole-tree ops (every column; what the hot/cold "
+                   "split narrows):")
+        for tag, file, line in bulk:
+            out.append(f"  {file}:{line}: {tag}")
+    return "\n".join(out)
+
+
+def render_markdown(matrix, model) -> str:
+    out = []
+    for kind, title in _KIND_TITLES:
+        entries, rows = _rows(matrix, model, kind)
+        header = (["field", "dtype"]
+                  + (["section"] if kind == "hosts" else [])
+                  + entries)
+        out.append(f"### {title}\n")
+        out.append("| " + " | ".join(header) + " |")
+        out.append("|" + "---|" * len(header))
+        for r in rows:
+            out.append("| " + " | ".join(
+                f"`{r[0]}`" if i == 0 else str(c)
+                for i, c in enumerate(r)) + " |")
+        out.append("")
+    return "\n".join(out)
+
+
+def render_json(matrix, model, root) -> str:
+    fields = {}
+    for kind, _ in _KIND_TITLES:
+        fields[kind] = {
+            name: {"dtype": model.dtype_of(kind, name),
+                   **({"section": model.section_of(name) or "other",
+                       "cold": name in model.cold,
+                       "line": model.linenos.get(name, 0)}
+                      if kind == "hosts" else {})}
+            for name in model.fields[kind]}
+    return json.dumps({
+        "version": 1,
+        "root": root,
+        "entries": matrix,
+        "fields": fields,
+        "cold_fields": sorted(model.cold),
+        "sections": [list(s) for s in model.sections],
+    }, indent=1, sort_keys=False) + "\n"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="state_matrix",
+        description="pass x field state-access matrix "
+                    "(shadow_tpu.lint.stateflow)")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: auto-detect upward)")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--markdown", action="store_true")
+    p.add_argument("-o", "--out", default=None,
+                   help="write to a file instead of stdout")
+    args = p.parse_args(argv)
+
+    from tools.simlint import load
+    load()
+    root = args.root or sys.modules["shadow_tpu.lint.cli"].find_root()
+    matrix, model, violations = build(root)
+    if not matrix:
+        for v in violations:
+            print(v.render(), file=sys.stderr)
+        print("state_matrix: analysis failed (see violations above)",
+              file=sys.stderr)
+        return 2
+
+    if args.json:
+        text = render_json(matrix, model, root)
+    elif args.markdown:
+        text = render_markdown(matrix, model)
+    else:
+        text = render_text(matrix, model)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text if text.endswith("\n") else text + "\n")
+        print(f"state_matrix: wrote {args.out}")
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
