@@ -260,7 +260,8 @@ def _emit(metric, summary, baseline, baseline_cfg, baseline_c=None,
                 rep_rates=summary.get("rep_rates"),
                 rep_spread=summary.get("rep_spread"),
                 cold_wall=summary.get("cold_wall"),
-                warm_wall=summary.get("warm_wall"))
+                warm_wall=summary.get("warm_wall"),
+                cfg=ledger_cfg)
             LG.append(entry)
         except Exception as e:  # pragma: no cover — never fail a line
             print(json.dumps({"ledger_error": repr(e)}), flush=True)

@@ -487,7 +487,7 @@ def main(argv=None):
                               stop_ns=int(scenario.stop_time),
                               runahead=args.runahead or "",
                               workers=args.workers),
-            jax.default_backend(), report, att)
+            jax.default_backend(), report, att, cfg=sim.cfg)
         lpath = (LG.append(entry, args.perf or None)
                  if entry is not None else None)
         if lpath:

@@ -60,11 +60,28 @@ def named_leaves(hosts) -> list:
             for f in dataclasses.fields(hosts)]
 
 
+# EngineConfig knobs that are BIT-EXACT by contract (each pinned by a
+# dedicated equality test): they change how the compiled program
+# schedules work, never which state it computes — so a checkpoint
+# taken under one value resumes exactly under another, and the
+# scenario fingerprint must not bind to them (a pre-hot-split
+# checkpoint loads into the split engine; an event_batch retune does
+# not orphan a fleet's stores). Everything else — array shapes, app
+# wiring, protocol semantics, deferral capacities — stays in the hash.
+_PERF_ONLY_KNOBS = ("active_block", "exsortcap", "dstcap",
+                    "event_batch", "hot_split")
+
+
 def scenario_fingerprint(scenario, cfg, seed: int) -> str:
-    """Stable hash binding a checkpoint to its scenario + engine shape."""
+    """Stable hash binding a checkpoint to its scenario + engine
+    shape/semantics (perf-only knobs excluded — see
+    _PERF_ONLY_KNOBS)."""
+    import dataclasses
+    cfg_sem = {k: v for k, v in sorted(
+        dataclasses.asdict(cfg).items()) if k not in _PERF_ONLY_KNOBS}
     text = json.dumps({
         "scenario": repr(scenario),
-        "cfg": repr(cfg),
+        "cfg": json.dumps(cfg_sem, sort_keys=True, default=repr),
         "seed": seed,
     }, sort_keys=True)
     return hashlib.sha256(text.encode()).hexdigest()[:16]

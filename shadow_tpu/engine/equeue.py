@@ -11,7 +11,18 @@ which is what a TPU wants instead of pointer-chasing heaps. The
 (shd-event.c:102).
 
 All functions here operate on a *row* (one host's slice of
-state.Hosts, as seen under vmap).
+state.Hosts, as seen under vmap). Every eq_* column is
+unconditionally HOT in the drain's working set (state.HOT_FIELDS):
+q_push is the single most executed operation in the engine, and the
+eq_next cache below is what the split drain's pass loop reads for
+its ready masks ([K] or [H] instead of the [·, Q] table).
+
+Batched drains (EngineConfig.event_batch > 1) pop up to B consecutive
+due events per gathered host inside one compaction pass — exactly the
+order this queue would pop them over B passes, so the (time, seq)
+total order, and therefore every digest bit, is unchanged (the
+pass-count collapse lever of ROADMAP item 1; pinned by
+tests/test_compaction.py::test_event_batch_bit_identical).
 """
 
 from __future__ import annotations

@@ -27,7 +27,8 @@ from ..apps.compile import compile_app
 from ..net.packet import PKT_WORDS
 from . import defs
 from .defs import EV_APP, WAKE_START, N_STATS
-from .state import EngineConfig, Hosts, HostParams, Shared, alloc_hosts, make_shared
+from .state import (EngineConfig, Hosts, HostParams, Shared,
+                    alloc_hosts, hot_fields, make_shared)
 from .window import run_windows
 from ..net import packet as P
 
@@ -181,6 +182,7 @@ class SimReport:
         gbps = est_total / shards / wall / 1e9 if wall else 0.0
         return {
             "row_bytes": rb,
+            "hot_columns": self.cost.get("hot_columns"),
             "batch": B,
             "shards": shards,
             "passes": passes,
@@ -936,9 +938,16 @@ class Simulation:
                         axis=1),
                 jnp.sum((eqn < SIMTIME_MAX).reshape(n_shards, -1),
                         axis=1, dtype=jnp.int32)))
+        # per-pass traffic covers the drain's HOT working set only:
+        # the hot/cold split (state.hot_fields) keeps cold columns out
+        # of every rung gather/scatter and loop carry, so modeling
+        # them in the pass cost would overstate HBM traffic — on the
+        # UDP tiers by more than half the socket table
+        _hot = hot_fields(cfg)
         row_bytes = sum(
-            int(np.prod(leaf.shape[1:])) * leaf.dtype.itemsize
-            for leaf in jax.tree.leaves(hosts))
+            int(np.prod(getattr(hosts, f).shape[1:]))
+            * getattr(hosts, f).dtype.itemsize
+            for f in _hot)
 
         if multiproc:
             # eager reductions cannot run on non-addressable global
@@ -1354,6 +1363,7 @@ class Simulation:
                 wall > first_chunk_wall * 1.05 else None)
         cost = {
             "row_bytes": row_bytes,
+            "hot_columns": len(_hot),
             "pass_mix": {lbl: (size, int(nn)) for lbl, size, nn in
                          zip(_pass_labels, _pass_sizes, pass_acc)},
             "batch": sparse_batch(cfg),

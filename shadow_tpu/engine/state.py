@@ -120,13 +120,26 @@ class EngineConfig:
     #   every socks10k window). 0 = auto (min(H, 4096) —
     #   engine.window.dst_cap); bit-identical either way (a no-arrival
     #   row's merge is the identity).
-    event_batch: int = 8    # max consecutive due events drained per
+    event_batch: int = 16   # max consecutive due events drained per
     #   gathered host within ONE sparse compaction pass (engine.window.
     #   sparse_batch; forced to 1 under the CPU model and with hosted
     #   apps). Amortizes the rung gather/scatter over up to this many
     #   events — pass COUNT, not just pass cost, is the other factor
     #   of the lockstep-skew product (round-3 verdict item 2). Dense
-    #   passes always drain exactly one event per ready host.
+    #   passes always drain exactly one event per ready host. Default
+    #   widened 8 -> 16 by the paired event-batch A/B (BASELINE.md
+    #   round-12 table; 32 was not significantly better and doubles
+    #   the per-rung program size).
+    hot_split: int = 1      # hot/cold state split in the lockstep
+    #   drain: 1 (default) = the drain's gathers, scatters and
+    #   while-loop carries move only hot_fields(cfg) — COLD_FIELDS
+    #   plus the config-gated COLD_WHEN columns stay full-width at the
+    #   window boundary and rejoin after the drain. 0 = carry the full
+    #   pytree (the pre-split engine; kept for paired A/Bs and the
+    #   split-equivalence tests). Bit-identical either way: the drain
+    #   provably never touches cold columns (simlint STF303 statically;
+    #   COLD_WHEN columns hold their alloc defaults in the gating
+    #   configs, so the row prototype reads are exact — see row_proto).
 
 
 # Digest sections (obs.digest): Hosts field prefix -> the named state
@@ -170,23 +183,167 @@ def section_of(field: str, *, strict: bool = False) -> str:
     return "other"
 
 
-# Hot/cold column contract for the ROADMAP item-1 socket-table split:
-# a COLD column is one the lockstep drain's per-pass compute never
-# touches — it is only read/written at window boundaries (exchange,
-# cap-peak sampling, window advance) or by host-side consumers (pcap
-# drain, reports). The stateflow analyzer (lint/stateflow.py, STF303)
-# verifies this against the drain-pass subgraph on every simlint run,
-# so a cold column cannot creep back into the drain working set
-# unnoticed; tools/state_matrix.py prints the measured matrix this set
-# was derived from. Grow this set as the split progresses (the sk_*
-# cold candidates — SACK bookkeeping, config — first need the drain's
-# TCP handlers restructured; see docs/static-analysis.md).
+# Hot/cold column contract for the ROADMAP item-1 socket-table split
+# (engine.window.drain_window actually enforces it at runtime: the
+# drain's gathers, scatters and while-loop carries move hot columns
+# only). Two levels:
+#
+# - COLD_FIELDS (static): a column NO drain-pass code touches in ANY
+#   config — only read/written at window boundaries (exchange,
+#   cap-peak sampling, window advance) or by host-side consumers
+#   (pcap drain, reports). The stateflow analyzer (lint/stateflow.py,
+#   STF303) verifies this against the drain-pass subgraph on every
+#   simlint run, so a cold column cannot creep back into the working
+#   set unnoticed; tools/state_matrix.py prints the measured matrix
+#   this set was derived from.
+# - COLD_WHEN (config-gated): columns whose drain accesses are
+#   statically pruned under a named config predicate (cpu model off,
+#   no hosted apps, no tgen, no TCP) — the socket table's SACK
+#   bookkeeping, RTT/congestion state and per-connection config all
+#   leave the working set on the UDP/phold tiers. See the invariant
+#   note at COLD_WHEN below and docs/static-analysis.md.
 COLD_FIELDS = frozenset({
     "ob_next",      # written by the exchange carry, read by advance
     "tr_time", "tr_pkt", "tr_dir", "tr_cnt", "tr_drop",  # pcap ring:
     #   exchange-side appends, host-side drain
     "cap_peaks",    # window-boundary sampling only
 })
+
+# The drain's STATIC hot working set: every Hosts column that is not
+# in COLD_FIELDS, in declaration order. A LITERAL tuple on purpose —
+# the stateflow analyzer reads it from the AST (never importing this
+# module) and treats `hot_fields(cfg)` calls as exactly this set, so
+# the drain's declared working set and the machine-checked one cannot
+# drift. Import-time assert below pins HOT_FIELDS | COLD_FIELDS ==
+# fields(Hosts) with no overlap; simlint STF300 re-checks it statically.
+HOT_FIELDS = (
+    "eq_time", "eq_seq", "eq_kind", "eq_pkt", "eq_ctr", "eq_next",
+    "rng_ctr", "cpu_avail",
+    "nic_busy", "nic_sched", "nic_rr", "nic_rx_until",
+    "txq_pkt", "txq_head", "txq_cnt", "pkt_ctr", "next_eport",
+    "sk_used", "sk_proto", "sk_state", "sk_lport", "sk_rport",
+    "sk_rhost", "sk_parent", "sk_snd_una", "sk_snd_nxt", "sk_snd_max",
+    "sk_snd_end", "sk_rcv_nxt", "sk_ooo_s", "sk_ooo_e", "sk_sack_s",
+    "sk_sack_e", "sk_hole_end", "sk_rex_nxt", "sk_peer_fin",
+    "sk_fin_acked", "sk_close_after", "sk_cwnd", "sk_ssthresh",
+    "sk_srtt", "sk_rtt_min", "sk_rttvar", "sk_rto", "sk_rto_deadline",
+    "sk_timer_on", "sk_timer_gen", "sk_dupacks", "sk_rtt_seq",
+    "sk_rtt_time", "sk_ctl", "sk_peer_rwnd", "sk_sndbuf", "sk_rcvbuf",
+    "sk_hs_time", "sk_last_tx", "sk_syn_tag", "sk_proc", "sk_app_ref",
+    "sk_cc_wmax", "sk_cc_epoch", "sk_cc_k",
+    "app_node", "app_r", "app_proc", "tgen_sync",
+    "ob_pkt", "ob_time", "ob_cnt",
+    "hw_time", "hw_pkt", "hw_cnt", "hw_drop",
+    "stats",
+)
+
+# Config-gated cold columns (the level-2 split): (guard, fields) —
+# each field leaves the drain's RUNTIME working set when its guard
+# holds for the engine config, because the static pruning already
+# compiles no access to it (the Python `if cfg.*` branches and the
+# app_kinds switch table). Exactness invariant (pinned by
+# tests/test_compaction.py::test_hot_split_gating_bit_identical and
+# the dual-run digest suite): under the guard, the column holds its
+# alloc_hosts default on every row at every instant — the only
+# reachable writes are the sock_alloc/sock_free resets, which write
+# that same default — so the drain's compiled reads of it (e.g.
+# tcp_want_tx scanning a TCP-less socket table) see the true value
+# through the row prototype (row_proto), and discarding its writes is
+# the identity. The stateflow gate cannot see static config, so a new
+# access to one of these columns OUTSIDE its guard must be caught by
+# the equivalence tests; grow this table only with the paired
+# all-hot-vs-gated proof (docs/performance.md "hot/cold split").
+COLD_WHEN = (
+    # host CPU delay model off: cpu_avail is only touched inside
+    # `if cfg.cpu_model:` blocks (engine.window.step_one_host)
+    ("cpu_model_off", ("cpu_avail",)),
+    # no hosted apps: the wake ring is appended only by
+    # hosting.bridge (APP_HOSTED switch branch) and the mid-window
+    # pause check compiles only when hostedcap > 1
+    ("no_hosted", ("hw_time", "hw_pkt", "hw_cnt", "hw_drop")),
+    # no tgen processes: the synchronize-barrier counters are touched
+    # only by apps.tgen (APP_TGEN switch branch)
+    ("no_tgen", ("tgen_sync",)),
+    # no TCP sockets can exist (uses_tcp False prunes the rx TCP path
+    # and the timer/close handlers; no TCP-capable app kind is
+    # compiled): every column below is written only by the TCP
+    # machine or reset-to-default by sock_alloc/sock_free, and every
+    # residual compiled read (tcp_want_tx via nic.kick, the sock_alloc
+    # TIME_WAIT eviction rank, the fifo qdisc key) sees the default —
+    # the exact value the column invariantly holds. The UDP-touched
+    # columns (sk_used/proto/lport/snd_end/rcv_nxt/timer_gen) and
+    # sk_proc stay hot.
+    ("no_tcp", (
+        "sk_state", "sk_rport", "sk_rhost", "sk_parent", "sk_snd_una",
+        "sk_snd_nxt", "sk_snd_max", "sk_ooo_s", "sk_ooo_e",
+        "sk_sack_s", "sk_sack_e", "sk_hole_end", "sk_rex_nxt",
+        "sk_peer_fin", "sk_fin_acked", "sk_close_after", "sk_cwnd",
+        "sk_ssthresh", "sk_srtt", "sk_rtt_min", "sk_rttvar", "sk_rto",
+        "sk_rto_deadline", "sk_timer_on", "sk_dupacks", "sk_rtt_seq",
+        "sk_rtt_time", "sk_ctl", "sk_peer_rwnd", "sk_sndbuf",
+        "sk_rcvbuf", "sk_hs_time", "sk_last_tx", "sk_syn_tag",
+        "sk_app_ref", "sk_cc_wmax", "sk_cc_epoch", "sk_cc_k",
+    )),
+    # multi-process wake routing reads sk_proc (window._on_app, PP>1
+    # branch); single-process no-TCP configs only ever write the
+    # default 0 (sock_alloc stamps app_proc, which is 0 there)
+    ("no_tcp_single_proc", ("sk_proc",)),
+)
+
+
+def _guard_holds(guard: str, cfg: "EngineConfig") -> bool:
+    def has_app(kind):
+        # unknown app set (None = Simulation has not filled it) is
+        # treated as "present": gating must be conservative
+        return cfg.app_kinds is None or kind in cfg.app_kinds
+
+    from ..apps.base import APP_HOSTED, APP_TGEN  # no import cycle:
+    #   apps.base pulls engine.equeue/defs only
+
+    no_hosted = cfg.hostedcap <= 1 and not has_app(APP_HOSTED)
+    if guard == "cpu_model_off":
+        return not cfg.cpu_model
+    if guard == "no_hosted":
+        return no_hosted
+    if guard == "no_tgen":
+        return not has_app(APP_TGEN)
+    if guard == "no_tcp":
+        return not cfg.uses_tcp and no_hosted
+    if guard == "no_tcp_single_proc":
+        return (not cfg.uses_tcp and no_hosted
+                and cfg.procs_per_host <= 1)
+    raise KeyError(f"unknown COLD_WHEN guard {guard!r}")
+
+
+def hot_fields(cfg: "EngineConfig") -> tuple:
+    """The drain's runtime hot working set for this config, in Hosts
+    declaration order: HOT_FIELDS minus every COLD_WHEN column whose
+    guard holds. With cfg.hot_split == 0 the full pytree (static cold
+    columns included) is returned — the pre-split engine, for paired
+    A/Bs and equivalence tests."""
+    if not cfg.hot_split:
+        return tuple(Hosts.__dataclass_fields__)
+    off = set()
+    for guard, fields in COLD_WHEN:
+        if _guard_holds(guard, cfg):
+            off.update(fields)
+    return tuple(f for f in HOT_FIELDS if f not in off)
+
+
+def row_proto(cfg: "EngineConfig") -> "Hosts":
+    """One host ROW of alloc_hosts defaults (no leading H axis) — the
+    prototype the drain rebuilds its vmapped rows around: hot columns
+    are replaced by the gathered data; cold columns ride as these
+    defaults and are dropped on return (XLA dead-code-eliminates
+    them), which is exact because a config-gated cold column's live
+    value IS its default under the gating config (COLD_WHEN), and a
+    static COLD_FIELDS column is never read by any handler (STF303)."""
+    import dataclasses as _dc
+
+    import jax
+
+    h1 = alloc_hosts(_dc.replace(cfg, num_hosts=1))
+    return jax.tree.map(lambda a: jnp.squeeze(a, 0), h1)
 
 
 @chex.dataclass
@@ -463,6 +620,19 @@ def alloc_hosts(cfg: EngineConfig) -> Hosts:
         stats=full((H, N_STATS), 0, jnp.int64),
         cap_peaks=full((H, 4), 0, jnp.int32),
     )
+
+
+# Partition integrity: the declared hot/cold split covers every Hosts
+# column exactly once, and every config-gated cold column is a member
+# of the static hot set (it only LEAVES it under its guard). simlint
+# STF300/STF304 re-check both statically on every lint run.
+assert set(HOT_FIELDS).isdisjoint(COLD_FIELDS), \
+    sorted(set(HOT_FIELDS) & COLD_FIELDS)
+assert set(HOT_FIELDS) | COLD_FIELDS == set(Hosts.__dataclass_fields__), \
+    sorted(set(Hosts.__dataclass_fields__)
+           ^ (set(HOT_FIELDS) | COLD_FIELDS))
+assert all(f in HOT_FIELDS for _, flds in COLD_WHEN for f in flds), \
+    [f for _, flds in COLD_WHEN for f in flds if f not in HOT_FIELDS]
 
 
 def make_shared(topo_lat_ns: np.ndarray, topo_rel: np.ndarray, rng_root,

@@ -46,7 +46,7 @@ from . import equeue
 from .defs import (EV_NULL, EV_APP, EV_PKT, EV_NIC_TX, EV_TCP_TIMER,
                    EV_TCP_CLOSE, ST_EVENTS, ST_PKTS_RECV, ST_PKTS_DROP_NET,
                    ST_PKTS_DROP_Q, ST_DEFER_FANIN)
-from .state import EngineConfig
+from .state import EngineConfig, hot_fields, row_proto
 
 
 # --- Event handlers (row-level) -------------------------------------------
@@ -205,6 +205,41 @@ def step_all_hosts(hosts, hp, sh, wend, cfg: EngineConfig):
     return jax.vmap(f)(hosts, hp)
 
 
+# --- Hot/cold state split (engine.state HOT_FIELDS / COLD_WHEN) -----------
+#
+# The drain below never moves the full Hosts pytree: drain_window
+# splits it ONCE into the config's hot working set (a dict of hot
+# columns) and leaves everything else untouched at full width, then
+# rejoins at the window boundary. All gathers, scatters and while-loop
+# carries inside operate on the hot dict only — previously every
+# window-rung gather and every per-pass sub-compaction hauled all 81
+# columns (cold SACK bookkeeping, trace rings, stats sampling
+# included) through HBM once per pass. The vmapped row is rebuilt
+# around the static row prototype (row_proto): cold columns ride as
+# their config-invariant defaults and are dropped on return, so XLA
+# dead-code-eliminates them from the compiled pass.
+
+def _split_hosts(hosts, names):
+    """Hosts -> {field: array} for the hot working set."""
+    return {f: getattr(hosts, f) for f in names}
+
+
+def _join_hosts(hosts, hot, names):
+    """Rejoin the drained hot columns into the full pytree (cold
+    columns pass through untouched — byte-identical by contract)."""
+    return hosts.replace(**{f: hot[f] for f in names})
+
+
+def _step_hot(hot, proto, hp, sh, wend, cfg: EngineConfig, names):
+    """step_all_hosts over the hot working set only."""
+    def f(hrow, hprow):
+        row = proto.replace(**{f2: hrow[f2] for f2 in names})
+        row = step_one_host(row, hprow, sh, wend, cfg)
+        return {f2: getattr(row, f2) for f2 in names}
+
+    return jax.vmap(f)(hot, hp)
+
+
 def ladder_of(cfg: EngineConfig, H: int = None):
     """Active-set compaction rung sizes for this config (ascending),
     WITHOUT the implicit dense fallback rung.
@@ -294,10 +329,23 @@ def drain_window(hosts, hp, sh, wend, cfg: EngineConfig, pc):
     loop), window-level active-set compaction applied when the active
     count fits a rung. Returns (hosts, pc) with pass counters
     accumulated per rung (window rungs first, then the per-pass rungs
-    of the dense fallback, then dense — see pass_labels)."""
-    H = hosts.eq_ctr.shape[0]
+    of the dense fallback, then dense — see pass_labels).
+
+    Hot/cold split (state.HOT_FIELDS/COLD_WHEN): the full pytree is
+    split here ONCE per window; everything inside — the rung gathers,
+    per-pass sub-compaction and both while-loop carries — moves the
+    hot working set only, and the cold columns rejoin untouched at
+    the return. cfg.hot_split=0 restores the full-tree carry."""
+    names = hot_fields(cfg)
+    proto = row_proto(cfg)
+    hot = _split_hosts(hosts, names)
+    hot, pc = _drain_hot(hot, proto, hp, sh, wend, cfg, pc, names)
+    return _join_hosts(hosts, hot, names), pc
+
+
+def _drain_hot(hot, proto, hp, sh, wend, cfg: EngineConfig, pc, names):
+    H = hot["eq_ctr"].shape[0]
     wks = window_ladder(cfg, H)
-    B = sparse_batch(cfg)
     nw = len(wks)
 
     def fallback(h, pc2):
@@ -307,37 +355,40 @@ def drain_window(hosts, hp, sh, wend, cfg: EngineConfig, pc):
         # plain dense loop, not another rung-ladder copy of the
         # handler machine. Without window rungs (small/mid H, hosted
         # apps, explicit active_block) it IS the engine, and the
-        # per-pass ladder applies as before (step_window_pass handles
-        # the ladderless active_block=0 case as plain dense).
+        # per-pass ladder applies as before (_pass_hot handles the
+        # ladderless active_block=0 case as plain dense).
         use_ladder = not wks
 
         def ev_cond(carry2):
             h2, _ = carry2
-            go = next_event_time(h2) < wend
+            go = jnp.min(h2["eq_next"]) < wend
             if cfg.hostedcap > 1:
                 # pause before a hosted wake ring can overflow so the
                 # CPU tier drains mid-window (the window re-opens on
                 # the next call). The threshold floor keeps tiny
                 # manual hostedcap values from wedging the loop.
-                cap = h2.hw_time.shape[1]
-                go = go & (jnp.max(h2.hw_cnt) < max(cap - 4, 1))
+                # (hw_* are pinned hot whenever hostedcap > 1 —
+                # COLD_WHEN "no_hosted".)
+                cap = h2["hw_time"].shape[1]
+                go = go & (jnp.max(h2["hw_cnt"]) < max(cap - 4, 1))
             return go
 
         def ev_body(carry2):
             h2, pc3 = carry2
             if use_ladder:
-                h2, rung = step_window_pass(h2, hp, sh, wend, cfg)
+                h2, rung = _pass_hot(h2, proto, hp, sh, wend, cfg,
+                                     names)
             else:
-                h2 = step_all_hosts(h2, hp, sh, wend, cfg)
+                h2 = _step_hot(h2, proto, hp, sh, wend, cfg, names)
                 rung = len(ladder_of(cfg, H))  # the dense slot
             return h2, pc3.at[nw + rung].add(1)
 
         return jax.lax.while_loop(ev_cond, ev_body, (h, pc2))
 
     if not wks:
-        return fallback(hosts, pc)
+        return fallback(hot, pc)
 
-    active = hosts.eq_next < wend                     # [H]
+    active = hot["eq_next"] < wend                    # [H]
     nact = jnp.sum(active, dtype=jnp.int32)
 
     def make_win(K, slot):
@@ -349,12 +400,12 @@ def drain_window(hosts, hp, sh, wend, cfg: EngineConfig, pc):
             dummy = jnp.argmin(active).astype(jnp.int32)
             idx = jnp.full((K,), dummy, jnp.int32).at[tgt].set(
                 hid, mode="drop")
-            sub = jax.tree.map(lambda a: a[idx], h)
+            sub = {f2: h[f2][idx] for f2 in names}
             shp = jax.tree.map(lambda a: a[idx], hp)
 
             def c(carry2):
                 s, _ = carry2
-                return jnp.min(s.eq_next) < wend
+                return jnp.min(s["eq_next"]) < wend
 
             def b(carry2):
                 # per-pass sub-compaction INSIDE the gathered set:
@@ -365,11 +416,12 @@ def drain_window(hosts, hp, sh, wend, cfg: EngineConfig, pc):
                 # [K]-row switch (measured: a flat [2048]-wide drain
                 # was SLOWER than the per-pass ladder it replaced)
                 s, n = carry2
-                s, _rung = step_window_pass(s, shp, sh, wend, cfg)
+                s, _rung = _pass_hot(s, proto, shp, sh, wend, cfg,
+                                     names)
                 return s, n + 1
 
             sub, n = jax.lax.while_loop(c, b, (sub, jnp.int64(0)))
-            h = jax.tree.map(lambda a, s: a.at[idx].set(s), h, sub)
+            h = {f2: h[f2].at[idx].set(sub[f2]) for f2 in names}
             return h, pc2.at[slot].add(n)
         return f
 
@@ -381,7 +433,7 @@ def drain_window(hosts, hp, sh, wend, cfg: EngineConfig, pc):
     # them to the fallback, whose loop exits without the K-row
     # gather/scatter a window rung would pay for zero passes
     rung = jnp.where(nact == 0, jnp.int32(len(wks)), rung)
-    return jax.lax.switch(rung, branches, hosts, pc)
+    return jax.lax.switch(rung, branches, hot, pc)
 
 
 def pass_labels(cfg: EngineConfig, H: int = None):
@@ -426,15 +478,26 @@ def step_window_pass(hosts, hp, sh, wend, cfg: EngineConfig):
     Returns (hosts, rung) where rung indexes ladder_of(cfg) with
     len(ladder) == the dense fallback (pass-mix accounting for the
     SimReport cost model).
+
+    Public full-tree wrapper (tests, tools/phase_profile.py); the
+    drain itself calls the hot-working-set core `_pass_hot` directly.
     """
-    H = hosts.eq_ctr.shape[0]
+    names = hot_fields(cfg)
+    hot = _split_hosts(hosts, names)
+    hot, rung = _pass_hot(hot, row_proto(cfg), hp, sh, wend, cfg,
+                          names)
+    return _join_hosts(hosts, hot, names), rung
+
+
+def _pass_hot(hot, proto, hp, sh, wend, cfg: EngineConfig, names):
+    H = hot["eq_ctr"].shape[0]
     ks = ladder_of(cfg, H)
-    ready = hosts.eq_next < wend                      # [H]
+    ready = hot["eq_next"] < wend                     # [H]
     nready = jnp.sum(ready, dtype=jnp.int32)
     B = sparse_batch(cfg)
 
     def dense(h):
-        return step_all_hosts(h, hp, sh, wend, cfg)
+        return _step_hot(h, proto, hp, sh, wend, cfg, names)
 
     def make_sparse(K):
         def sparse(h):
@@ -448,26 +511,27 @@ def step_window_pass(hosts, hp, sh, wend, cfg: EngineConfig):
             dummy = jnp.argmin(ready).astype(jnp.int32)
             idx = jnp.full((K,), dummy, jnp.int32).at[tgt].set(
                 hid, mode="drop")
-            sub = jax.tree.map(lambda a: a[idx], h)
+            sub = {f: h[f][idx] for f in names}
             shp = jax.tree.map(lambda a: a[idx], hp)
             if B > 1:
                 sub = jax.lax.fori_loop(
                     0, B,
-                    lambda _, s: step_all_hosts(s, shp, sh, wend, cfg),
+                    lambda _, s: _step_hot(s, proto, shp, sh, wend,
+                                           cfg, names),
                     sub)
             else:
-                sub = step_all_hosts(sub, shp, sh, wend, cfg)
-            return jax.tree.map(lambda a, s: a.at[idx].set(s), h, sub)
+                sub = _step_hot(sub, proto, shp, sh, wend, cfg, names)
+            return {f: h[f].at[idx].set(sub[f]) for f in names}
         return sparse
 
     if not ks:
-        return dense(hosts), jnp.int32(0)
+        return dense(hot), jnp.int32(0)
 
     # smallest rung that fits the ready count; len(ks) = dense
     rung = jnp.searchsorted(jnp.asarray(ks, jnp.int32), nready,
                             side="left").astype(jnp.int32)
     branches = [make_sparse(K) for K in ks] + [dense]
-    return jax.lax.switch(rung, branches, hosts), rung
+    return jax.lax.switch(rung, branches, hot), rung
 
 
 # --- Window-boundary packet exchange --------------------------------------

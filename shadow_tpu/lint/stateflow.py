@@ -67,6 +67,12 @@ STF303 = rule(
     "engine/state.py COLD_FIELDS promises this column stays out of "
     "the lockstep drain's working set; move the access to a window-"
     "boundary phase or un-mark the column (docs/static-analysis.md)")
+STF304 = rule(
+    "STF304", "COLD_WHEN contract error",
+    "a config-gated cold column must name an existing Hosts field "
+    "that is in the static HOT_FIELDS set and not in COLD_FIELDS — "
+    "the level-2 split only gates columns the drain statically "
+    "touches (docs/static-analysis.md)")
 STF401 = rule(
     "STF401", "i32 column flows into i64 arithmetic without widening",
     "add .astype(jnp.int64) at the source; implicit promotion hides "
@@ -153,8 +159,22 @@ class StateModel:
         self.linenos = {}          # Hosts field -> state.py line
         self.sections = []         # [(prefix, section)]
         self.cold = set()          # COLD_FIELDS
+        self.hot = ()              # HOT_FIELDS literal (may be absent
+        #                            in fixture repos — see hot_set())
+        self.cold_when = []        # [(guard, (fields...))] COLD_WHEN
         self.errors = []           # human-readable parse failures
         self.missing = False       # no state.py at all (fixture repo)
+
+    def hot_set(self) -> tuple:
+        """The static hot working set: the declared HOT_FIELDS
+        literal, or (fixture repos without one) the complement of
+        COLD_FIELDS. This is what a `hot_fields(cfg)` call is modeled
+        as returning — the union over configs, which is exactly the
+        conservative contract the drain matrix states."""
+        if self.hot:
+            return self.hot
+        return tuple(f for f in self.fields[HOSTS]
+                     if f not in self.cold)
 
     def section_of(self, field: str):
         for prefix, section in self.sections:
@@ -228,6 +248,19 @@ def load_state_model(cache) -> StateModel:
                 except (ValueError, TypeError):
                     m.errors.append("COLD_FIELDS not a literal set "
                                     "of field names")
+            elif tname == "HOT_FIELDS":
+                try:
+                    m.hot = tuple(ast.literal_eval(node.value))
+                except (ValueError, TypeError):
+                    m.errors.append("HOT_FIELDS not a literal tuple "
+                                    "of field names")
+            elif tname == "COLD_WHEN":
+                try:
+                    m.cold_when = [(g, tuple(flds)) for g, flds in
+                                   ast.literal_eval(node.value)]
+                except (ValueError, TypeError):
+                    m.errors.append("COLD_WHEN not a literal tuple of "
+                                    "(guard, (fields...)) pairs")
         elif isinstance(node, ast.FunctionDef) and node.name in (
                 "alloc_hosts", "make_shared"):
             kind = HOSTS if node.name == "alloc_hosts" else SH
@@ -828,6 +861,21 @@ class _EntryInterp:
     def _dotted_call(self, node, dotted, env, frame):
         if not dotted:
             return _UNHANDLED
+        tail = dotted.rsplit(".", 1)[-1]
+        if tail in ("hot_fields", "row_proto") \
+                and self.model.fields[HOSTS]:
+            # engine.state's hot/cold split helpers: hot_fields(cfg)
+            # yields SOME subset of HOT_FIELDS depending on static
+            # config — modeled as the full set (the union over
+            # configs, which is what the matrix states); row_proto
+            # yields a default-valued Hosts row (the drain rebuilds
+            # its vmapped rows around it, threading the Hosts kind
+            # into the handler subgraph)
+            for a in node.args:
+                self._ev(a, env, frame)
+            if tail == "hot_fields":
+                return StrSet(self.model.hot_set())
+            return Tree(HOSTS)
         if dotted in _ROWOPS:
             args = [self._ev(a, env, frame) for a in node.args]
             arr = args[0] if args else TOP
@@ -866,7 +914,7 @@ class _EntryInterp:
             args = [self._ev(a, env, frame) for a in node.args]
             trees = [a for a in args[1:] if isinstance(a, Tree)]
             if trees:
-                self.access.bulk.append(("tree.map",
+                self.access.bulk.append((f"tree.map[{trees[0].kind}]",
                                          *self._site(frame, node)))
                 return trees[0]
             return TOP
@@ -1295,6 +1343,49 @@ def _contract_violations(model: StateModel, matrix, drain_access):
             STF300, STATE_PATH, 0,
             f"COLD_FIELDS names `{field}`, which is not a Hosts "
             "field", snippet=f"cold:{field}"))
+    # HOT_FIELDS (when declared) must partition the Hosts columns
+    # exactly against COLD_FIELDS — the drain's declared working set
+    # and the dataclass cannot drift apart
+    if model.hot:
+        hot = set(model.hot)
+        allf = set(model.fields[HOSTS])
+        for field in sorted(hot & model.cold):
+            out.append(Violation(
+                STF300, STATE_PATH, 0,
+                f"`{field}` is in both HOT_FIELDS and COLD_FIELDS",
+                snippet=f"hotcold:{field}"))
+        for field in sorted(allf - hot - model.cold):
+            out.append(Violation(
+                STF300, STATE_PATH, model.linenos.get(field, 0),
+                f"Hosts field `{field}` is in neither HOT_FIELDS nor "
+                "COLD_FIELDS — declare it in the hot/cold partition",
+                snippet=f"unpartitioned:{field}"))
+        for field in sorted(hot - allf):
+            out.append(Violation(
+                STF300, STATE_PATH, 0,
+                f"HOT_FIELDS names `{field}`, which is not a Hosts "
+                "field", snippet=f"hot:{field}"))
+    # STF304: config-gated cold columns must be real, statically-hot
+    # fields (a COLD_WHEN entry that is already in COLD_FIELDS, or
+    # unknown, is a contract error)
+    hot = set(model.hot_set())
+    for guard, fields in model.cold_when:
+        for field in fields:
+            if field not in model.fields[HOSTS]:
+                out.append(Violation(
+                    STF304, STATE_PATH, 0,
+                    f"COLD_WHEN[{guard}] names `{field}`, which is "
+                    "not a Hosts field"))
+            elif field in model.cold:
+                out.append(Violation(
+                    STF304, STATE_PATH, 0,
+                    f"COLD_WHEN[{guard}] names `{field}`, which is "
+                    "already statically cold (COLD_FIELDS)"))
+            elif field not in hot:
+                out.append(Violation(
+                    STF304, STATE_PATH, 0,
+                    f"COLD_WHEN[{guard}] names `{field}`, which is "
+                    "not in HOT_FIELDS"))
     return out
 
 

@@ -16,6 +16,13 @@ Every operation is a branch-free pass over the K lanes (K is small and
 static), so the whole scoreboard fuses into the surrounding TCP kernel
 — no lists, no loops over blocks.
 
+The four `[H, S, K]` i64 scoreboard columns (sk_ooo_*/sk_sack_*) are
+the largest per-host socket state after the packet buffers — on
+`uses_tcp=False` tiers they are config-gated COLD (engine.state
+COLD_WHEN "no_tcp") and leave every drain gather; on TCP tiers they
+are pinned hot by the rx/pull accesses the stateflow matrix records
+(tests/test_stateflow.py::test_sack_scoreboard_update_invariants).
+
 Wire encoding (the two most-urgent blocks ride each ACK, AUX word +
 APP word — real TCP carries 2-4 blocks per segment): 15-bit MSS-unit
 (offset, length) pairs, SHRUNK to segment alignment — the advertised

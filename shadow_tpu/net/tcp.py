@@ -29,6 +29,16 @@ branch-masked row-level kernels instead of per-connection callbacks:
 
 All functions are row-level (one host under vmap). App-facing calls:
 tcp_listen, tcp_connect, tcp_write, tcp_close_call.
+
+Hot/cold row contract (engine.state HOT_FIELDS/COLD_WHEN): every
+``sk_*`` column this machine touches is part of the drain's hot
+working set on TCP tiers, and on ``uses_tcp=False`` tiers the 38
+TCP-only columns are config-gated cold — the rows this module sees
+there come from the default row prototype, which is exact because the
+only reachable writes are the sock_alloc/sock_free default resets
+(see the COLD_WHEN invariant note in engine/state.py). A new column
+access here lands in the stateflow matrix and the CI snapshot diff;
+an access to a COLD_FIELDS column fails simlint STF303 by name.
 """
 
 from __future__ import annotations

@@ -87,14 +87,35 @@ def git_rev() -> str | None:
         return None
 
 
+def config_extras(cfg) -> dict | None:
+    """The config facts a trajectory reader needs to attribute a rate
+    move WITHOUT re-deriving the fingerprint: the drain's hot-column
+    count (the level-2 hot/cold split working set for this config),
+    the event batch width and the split switch. Recorded verbatim in
+    the entry (never part of the fingerprint — the full cfg already
+    is), so a ledger delta is attributable to the split rather than
+    just the git rev."""
+    if cfg is None or not dataclasses.is_dataclass(cfg):
+        return None
+    try:
+        from ..engine.state import hot_fields
+        return {"hot_columns": len(hot_fields(cfg)),
+                "event_batch": cfg.event_batch,
+                "hot_split": cfg.hot_split}
+    except Exception:  # pragma: no cover — old/partial cfg shapes
+        return None
+
+
 def make_entry(scenario: str, fingerprint: str, platform: str,
                summary: dict, cost: dict = None, phases: dict = None,
                attributed_frac: float = None, note: str = None,
                rep_rates=None, rep_spread=None, cold_wall=None,
-               warm_wall=None) -> dict:
+               warm_wall=None, cfg=None) -> dict:
     """One ledger line from a run's summary (SimReport.summary()) and
     cost model (SimReport.cost_model()). `phases` is the per-phase
-    wall map from obs.perf (``{phase: wall_s}``)."""
+    wall map from obs.perf (``{phase: wall_s}``); `cfg` (the
+    EngineConfig the fingerprint hashed) additionally stamps the
+    attribution extras (config_extras)."""
     warm_eps = None
     if warm_wall and summary.get("events"):
         # warm throughput excludes the cold compile — the number the
@@ -131,6 +152,15 @@ def make_entry(scenario: str, fingerprint: str, platform: str,
         e["attributed_frac"] = attributed_frac
     if note:
         e["note"] = note
+    extras = config_extras(cfg)
+    if extras:
+        if cost and cost.get("hot_columns"):
+            # the AS-RUN working set: Simulation fills app_kinds/
+            # uses_tcp from the compiled process specs, which can
+            # activate more COLD_WHEN gates than the caller's input
+            # config shows
+            extras["hot_columns"] = int(cost["hot_columns"])
+        e["extras"] = extras
     return e
 
 

@@ -283,7 +283,11 @@ def _windows_body(hosts, hp, sh, wstart, wend, cfg, lcfg, max_windows,
         # need — the reference's per-thread round execution before the
         # barrier (shd-scheduler.c:602-635). Only the window advance
         # below is a global decision. Rung choice and pass counters are
-        # per-shard; counters are psum-reduced at return.
+        # per-shard; counters are psum-reduced at return. The hot/cold
+        # split applies per shard: drain_window splits the shard-local
+        # rows into hot_fields(cfg) and rejoins before the exchange,
+        # which (like the checkpoint/digest pulls) stays whole-tree —
+        # so the mesh-vs-single digest equality contract is untouched.
         hosts, pc = drain_window(hosts, hp, sh, we_eff, cfg, pc)
         hosts = update_cap_peaks(hosts)
         ob0 = jax.lax.psum(jnp.sum(hosts.ob_cnt), AXIS)

@@ -271,3 +271,73 @@ def test_cli_test_scenario_smoke(capsys):
     summary = json.loads(out.strip().splitlines()[-1])
     assert summary["transfers_done"] > 0
     assert "[shadow-heartbeat]" in out
+
+
+def test_presplit_checkpoint_resumes_into_split_layout(tmp_path):
+    """Cross-version determinism across the hot/cold split: a
+    checkpoint written by the PRE-split engine (hot_split=0 full-tree
+    drain, the old event_batch=8 default — bit-exact stand-in for the
+    pre-split binary) must load into the split engine (same array
+    layout, same semantic fingerprint: both knobs are in
+    checkpoint._PERF_ONLY_KNOBS) and the resumed digest chain must
+    byte-equal an uninterrupted SPLIT run's chain."""
+    import numpy as np
+
+    pre_cfg = EngineConfig(num_hosts=8, hot_split=0, event_batch=8,
+                           **CFG)
+    post_cfg = EngineConfig(num_hosts=8, **CFG)
+
+    # uninterrupted run on the SPLIT engine records chain A
+    dg_a = str(tmp_path / "a.jsonl")
+    Simulation(scen(), engine_cfg=post_cfg).run(digest=dg_a,
+                                                digest_every=8)
+
+    # the pre-split engine checkpoints mid-run, recording chain B
+    base = str(tmp_path / "ck")
+    dg_b = str(tmp_path / "b.jsonl")
+    Simulation(scen(), engine_cfg=pre_cfg).run(
+        digest=dg_b, digest_every=8, checkpoint_path=base,
+        checkpoint_every_s=2, checkpoint_keep=8)
+
+    from shadow_tpu.engine import checkpoint as ck
+    store = ck.CheckpointStore(base)
+    snap_path = sorted(store.snapshots())[0]
+    n_recs = int(np.load(snap_path)["__digest_records__"])
+    lines = open(dg_b).read().splitlines()
+    assert n_recs + 1 < len(lines), "snapshot too late for this test"
+    with open(dg_b, "w") as f:
+        f.write("\n".join(lines[:n_recs + 1]) + "\n")
+
+    # resume on the SPLIT engine: the semantic fingerprint must match
+    # (no strict=False escape hatch involved) and the finished chain
+    # must equal the uninterrupted split run's byte for byte
+    report = Simulation(scen(), engine_cfg=post_cfg).run(
+        digest=dg_b, digest_every=8, resume_from=snap_path)
+    assert report.windows > 0
+    assert open(dg_a, "rb").read() == open(dg_b, "rb").read(), (
+        "pre-split checkpoint resumed under the split engine diverged")
+
+
+def test_fingerprint_ignores_perf_only_knobs():
+    """The checkpoint fingerprint binds to shapes and semantics, not
+    to the bit-exact perf knobs — and DOES bind to everything else."""
+    import dataclasses as dc
+
+    from shadow_tpu.engine.checkpoint import (_PERF_ONLY_KNOBS,
+                                              scenario_fingerprint)
+
+    s = scen()
+    base_cfg = EngineConfig(num_hosts=8, **CFG)
+    fp = scenario_fingerprint(s, base_cfg, 1)
+    for knob, val in (("hot_split", 0), ("event_batch", 32),
+                      ("active_block", 512), ("exsortcap", 64),
+                      ("dstcap", 4)):
+        assert knob in _PERF_ONLY_KNOBS
+        cfg2 = dc.replace(base_cfg, **{knob: val})
+        assert scenario_fingerprint(s, cfg2, 1) == fp, knob
+    # semantic knobs still bind
+    assert scenario_fingerprint(
+        s, dc.replace(base_cfg, qcap=CFG["qcap"] * 2), 1) != fp
+    assert scenario_fingerprint(
+        s, dc.replace(base_cfg, uses_tcp=False), 1) != fp
+    assert scenario_fingerprint(s, base_cfg, 2) != fp

@@ -163,3 +163,77 @@ def test_compaction_sharded_matches_dense_single():
     sharded = sim.run(mesh=mesh)
     assert np.array_equal(single.stats, sharded.stats)
     assert single.windows == sharded.windows
+
+
+def test_hot_split_gating_bit_identical(tmp_path):
+    """The hot/cold split's exactness proof: the gated drain (default
+    hot_split=1, config-gated COLD_WHEN columns excluded from every
+    gather/carry) produces byte-identical digest chains to the
+    full-tree drain (hot_split=0, the pre-split engine) — on a no-TCP
+    scenario (the 38-column `no_tcp` gate active) AND on a TCP
+    scenario (socket table pinned hot, boundary columns still cold)."""
+    from test_checkpoint import scen as phold_scen, CFG as PH_CFG
+
+    def chain(name, scenario, cfg):
+        path = str(tmp_path / f"{name}.jsonl")
+        Simulation(scenario, engine_cfg=cfg).run(digest=path,
+                                                 digest_every=8)
+        return open(path, "rb").read()
+
+    # UDP/phold tier: cpu_model off, no hosted, no tgen, no TCP —
+    # every COLD_WHEN guard active, drain working set 29 columns
+    base = dict(num_hosts=8, **PH_CFG)
+    a = chain("ph_gated", phold_scen(), EngineConfig(**base))
+    b = chain("ph_full", phold_scen(),
+              EngineConfig(hot_split=0, **base))
+    assert a == b, "no-TCP gated drain diverged from full-tree drain"
+
+    # TCP tier: the skewed bulk shape (the lockstep-skew scenario the
+    # compaction ladder exists for), socket table hot
+    tcp = dict(num_hosts=8, **CFG)
+    a = chain("tcp_gated", _skewed_scen(), EngineConfig(**tcp))
+    b = chain("tcp_full", _skewed_scen(),
+              EngineConfig(hot_split=0, **tcp))
+    assert a == b, "TCP gated drain diverged from full-tree drain"
+
+
+def test_hot_fields_gating_per_config():
+    """hot_fields(cfg) activates exactly the declared COLD_WHEN gates
+    for a config, and hot_split=0 restores the full pytree."""
+    import dataclasses as dc
+
+    from shadow_tpu.engine.state import (COLD_FIELDS, HOT_FIELDS,
+                                         Hosts, hot_fields)
+
+    # phold-style: no TCP, no hosted, no tgen, single process
+    udp = EngineConfig(num_hosts=4, app_kinds=(0, 3), uses_tcp=False)
+    hot = hot_fields(udp)
+    assert "sk_sack_s" not in hot and "sk_cwnd" not in hot
+    assert "sk_proc" not in hot          # single-process gate
+    assert "cpu_avail" not in hot and "hw_cnt" not in hot
+    assert "tgen_sync" not in hot
+    # UDP-touched socket columns stay hot
+    for f in ("sk_used", "sk_proto", "sk_lport", "sk_snd_end",
+              "sk_rcv_nxt", "sk_timer_gen"):
+        assert f in hot, f
+    assert len(hot) == 29
+
+    # multi-process UDP: wake routing reads sk_proc — pinned hot
+    assert "sk_proc" in hot_fields(dc.replace(udp, procs_per_host=2))
+
+    # TCP tier (tgen absent): socket table hot, boundary gates active
+    tcp = EngineConfig(num_hosts=4, app_kinds=(0, 9, 10),
+                       uses_tcp=True)
+    hot = hot_fields(tcp)
+    assert "sk_sack_s" in hot and "sk_cwnd" in hot
+    assert "cpu_avail" not in hot and "tgen_sync" not in hot
+
+    # hosted / cpu-model / unknown app set pin their columns hot
+    assert "hw_cnt" in hot_fields(dc.replace(udp, hostedcap=32))
+    assert "cpu_avail" in hot_fields(dc.replace(udp, cpu_model=True))
+    assert "tgen_sync" in hot_fields(EngineConfig(num_hosts=4))
+
+    # the escape hatch carries everything, static cold included
+    allf = hot_fields(EngineConfig(num_hosts=4, hot_split=0))
+    assert set(allf) == set(Hosts.__dataclass_fields__)
+    assert set(HOT_FIELDS) | COLD_FIELDS == set(allf)

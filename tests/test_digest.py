@@ -326,3 +326,52 @@ def test_bisect_pins_exact_window(tmp_path):
     assert fine["sections"]
     # the replay chains were kept where we asked
     assert (tmp_path / "replays" / "replay-a.jsonl").exists()
+
+
+# The canonical Hosts layout existing digest chains and checkpoints
+# were written against. The hot/cold split must never move it: digest
+# sections hash fields in THIS declaration order, and checkpoints
+# verify leaf-for-leaf against it. Renaming, reordering, adding or
+# removing a field invalidates every committed chain — do it only
+# with a digest format-version bump, and update this pin in the same
+# reviewed change.
+CANONICAL_HOSTS_LAYOUT = (
+    "eq_time", "eq_seq", "eq_kind", "eq_pkt", "eq_ctr", "eq_next",
+    "rng_ctr", "cpu_avail", "nic_busy", "nic_sched", "nic_rr",
+    "nic_rx_until", "txq_pkt", "txq_head", "txq_cnt", "pkt_ctr",
+    "next_eport", "sk_used", "sk_proto", "sk_state", "sk_lport",
+    "sk_rport", "sk_rhost", "sk_parent", "sk_snd_una", "sk_snd_nxt",
+    "sk_snd_max", "sk_snd_end", "sk_rcv_nxt", "sk_ooo_s", "sk_ooo_e",
+    "sk_sack_s", "sk_sack_e", "sk_hole_end", "sk_rex_nxt",
+    "sk_peer_fin", "sk_fin_acked", "sk_close_after", "sk_cwnd",
+    "sk_ssthresh", "sk_srtt", "sk_rtt_min", "sk_rttvar", "sk_rto",
+    "sk_rto_deadline", "sk_timer_on", "sk_timer_gen", "sk_dupacks",
+    "sk_rtt_seq", "sk_rtt_time", "sk_ctl", "sk_peer_rwnd",
+    "sk_sndbuf", "sk_rcvbuf", "sk_hs_time", "sk_last_tx",
+    "sk_syn_tag", "sk_proc", "sk_app_ref", "sk_cc_wmax",
+    "sk_cc_epoch", "sk_cc_k", "app_node", "app_r", "app_proc",
+    "tgen_sync", "ob_pkt", "ob_time", "ob_cnt", "ob_next", "hw_time",
+    "hw_pkt", "hw_cnt", "hw_drop", "tr_time", "tr_pkt", "tr_dir",
+    "tr_cnt", "tr_drop", "stats", "cap_peaks",
+)
+
+
+def test_digest_section_layout_pinned():
+    """The hot/cold split is a drain-side carry optimization — the
+    at-rest layout the digest chain and checkpoints hash is pinned
+    unchanged (field set, declaration order, section mapping)."""
+    from shadow_tpu.engine.state import Hosts, section_of
+
+    assert tuple(Hosts.__dataclass_fields__) == CANONICAL_HOSTS_LAYOUT
+    sections = {f: section_of(f, strict=True)
+                for f in CANONICAL_HOSTS_LAYOUT}
+    assert sorted(set(sections.values())) == [
+        "app", "cpu", "event_queue", "hosted_wakes", "nic", "outbox",
+        "rng", "stats", "tcp", "trace_ring"]
+    # checkpoint leaf enumeration = digest enumeration, same order
+    from shadow_tpu.engine.checkpoint import named_leaves
+    from shadow_tpu.engine.state import EngineConfig, alloc_hosts
+    hosts = alloc_hosts(EngineConfig(num_hosts=2, qcap=4, scap=2,
+                                     obcap=4, incap=4))
+    assert tuple(n for n, _ in named_leaves(hosts)) \
+        == CANONICAL_HOSTS_LAYOUT

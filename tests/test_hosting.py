@@ -216,3 +216,36 @@ def test_hosted_deterministic(simple_topology_xml):
 
     r1, r2 = go(), go()
     assert np.array_equal(r1.stats, r2.stats)
+
+
+def test_hosted_hot_split_bit_identical(simple_topology_xml, tmp_path):
+    """The hosted tier under the hot/cold split: a hosted TCP put
+    produces byte-identical digest chains under the gated drain
+    (default) and the full-tree drain (hot_split=0, the pre-split
+    engine). Hosted configs pin hw_* hot (hostedcap > 1) and the app
+    set pins the socket table hot — the split here is the static cold
+    boundary columns plus the slimmer loop carry."""
+    def chain(name, hot_split):
+        scen = Scenario(
+            stop_time=15 * 10**9,
+            topology_graphml=simple_topology_xml,
+            hosts=[
+                HostSpec(id="srv", processes=[
+                    ProcessSpec(plugin="bulkserver", start_time=10**9,
+                                arguments="port=80")]),
+                HostSpec(id="cli", processes=[
+                    ProcessSpec(plugin="hosted:test-putter",
+                                start_time=2 * 10**9,
+                                arguments="peer=srv port=80 "
+                                          "size=51200")]),
+            ],
+        )
+        path = str(tmp_path / f"{name}.jsonl")
+        sim = Simulation(scen, engine_cfg=EngineConfig(
+            num_hosts=2, hot_split=hot_split, **CFG))
+        sim.run(digest=path, digest_every=8)
+        return open(path, "rb").read()
+
+    assert chain("gated", 1) == chain("full", 0), (
+        "hosted digest chain diverged between gated and full-tree "
+        "drains")

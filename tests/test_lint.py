@@ -77,7 +77,8 @@ def test_reachability_graph_is_alive():
     fqns = {f.fqn for f in project.reachable}
     assert len(fqns) > 100, len(fqns)
     for expected in (
-            "shadow_tpu.engine.window.step_window_pass",
+            "shadow_tpu.engine.window._pass_hot",
+            "shadow_tpu.engine.window._step_hot",
             "shadow_tpu.engine.window.exchange",
             "shadow_tpu.parallel.shard._windows_body",
             "shadow_tpu.core.rowops.rget"):

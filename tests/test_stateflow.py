@@ -378,8 +378,12 @@ def test_state_matrix_json_and_markdown(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
     with open(out) as f:
         data = json.load(f)
-    assert sorted(data) == ["cold_fields", "entries", "fields",
-                            "root", "sections", "version"]
+    assert sorted(data) == ["cold_fields", "cold_when",
+                            "drain_hot_columns", "entries", "fields",
+                            "hot_counts", "hot_fields", "root",
+                            "sections", "version"]
+    # the drain's measured working set is exactly the declared hot set
+    assert data["drain_hot_columns"] == sorted(data["hot_fields"])
     assert "drain" in data["entries"]
     drain = data["entries"]["drain"]["hosts"]
     assert "sk_state" in drain["reads"]
@@ -395,3 +399,44 @@ def test_state_matrix_json_and_markdown(tmp_path):
     r = run_cli(["tools.state_matrix", "--markdown"])
     assert r.returncode == 0
     assert "| `eq_time` | i64 | event_queue |" in r.stdout
+
+
+# --- the hot/cold split declaration (HOT_FIELDS / COLD_WHEN) ---------
+
+def test_drain_hot_set_equals_declaration(repo_matrix):
+    """The drain's measured working set IS the declared HOT_FIELDS
+    partition — the split's machine-checked contract: reads/writes
+    recorded in the drain subgraph cover exactly the static hot set
+    (cold columns untouched), and every config-gated COLD_WHEN column
+    is a member of it."""
+    matrix, _ = repo_matrix
+    model = stateflow.load_state_model(core.SourceCache(REPO))
+    assert model.hot, "real repo must declare HOT_FIELDS"
+    drain = matrix["drain"]["hosts"]
+    touched = set(drain["reads"]) | set(drain["writes"])
+    assert touched == set(model.hot)
+    gated = {f for _, flds in model.cold_when for f in flds}
+    assert gated and gated <= set(model.hot)
+    assert not (gated & model.cold)
+
+
+def test_fixture_hot_partition_must_cover(tmp_path):
+    """A declared HOT_FIELDS that does not partition the Hosts
+    columns against COLD_FIELDS is an integrity failure (never
+    baselined)."""
+    vs = fixture_violations(
+        tmp_path,
+        state_extra="\nHOT_FIELDS = (\"eq_time\", \"eq_ctr\")\n")
+    assert vs and all(v.rule == "STF300" for v in vs), vs
+    missing = {m for v in vs for m in ("sk_cwnd", "stats")
+               if m in v.message}
+    assert missing == {"sk_cwnd", "stats"}, vs
+
+
+def test_fixture_cold_when_overlap_is_stf304(tmp_path):
+    vs = fixture_violations(
+        tmp_path,
+        state_extra="\nCOLD_WHEN = ((\"no_tcp\", (\"tr_cnt\",)),)\n")
+    assert len(vs) == 1 and vs[0].rule == "STF304", vs
+    assert "tr_cnt" in vs[0].message
+    assert "statically cold" in vs[0].message

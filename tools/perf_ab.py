@@ -153,6 +153,7 @@ def measure(config, variants, n=None, stop=10, reps=3, runahead_ms=0,
             "events": events[name],
             "events_match_baseline": events[name] == ev0,
             "passes": cost[name].get("passes"),
+            "cost_model": cost[name],
             "cfg": cfg,
         })
     base = out[0]
@@ -259,12 +260,15 @@ def main(argv):
                          "events_per_sec": r["median"],
                          "wall_seconds": (r["events"] / r["median"]
                                           if r["median"] else 0.0)},
+                cost=r["cost_model"],
                 rep_rates=r["rates"], rep_spread=r["spread"],
-                note=f"perf_ab vs {results[0]['variant']}")
+                note=f"perf_ab vs {results[0]['variant']}",
+                cfg=r["cfg"])
             LG.append(entry)
 
     for r in results:
         r.pop("cfg")  # not JSON-serializable, ledger consumed it
+        r.pop("cost_model", None)  # bulky; passes/ledger carry it
         print(json.dumps(r), flush=True)
     if args.markdown:
         print()

@@ -261,7 +261,7 @@ def main(argv):
             LG.fingerprint_of(cfg, stop=args.stop,
                               runahead=args.runahead_ms,
                               seed=args.seed),
-            out["platform"], report, att)
+            out["platform"], report, att, cfg=cfg)
         out["ledger"] = LG.append(entry, args.ledger or None)
     print(json.dumps(out, indent=1))
     return 0 if att["ok"] else 3

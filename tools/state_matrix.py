@@ -12,19 +12,28 @@ Usage (from the repo root; never imports jax — safe anywhere)::
     python -m tools.state_matrix --markdown    # docs-ready table
     python -m tools.state_matrix --json        # machine-readable
     python -m tools.state_matrix --json -o state_matrix.json
+    python -m tools.state_matrix --diff tools/state_matrix_snapshot.json
 
 Cells: ``RW`` read+written, ``R`` read, ``W`` written, ``s``
 shape/dtype metadata only, blank untouched. A ``*`` after the field
 name marks a COLD_FIELDS column (engine/state.py) — the STF303
 contract that it stays out of the ``drain`` column. The matrix is the
 union over engine configurations (static ``cfg.*`` branches are all
-traversed). ``W`` cells on HostParams/Shared are local VIEW rebinds
-(the ``hp.replace(app_kind=...)`` per-process view in the app
-dispatcher), never persisted state — only Hosts columns carry state
-across passes.
+traversed); the per-config drain working-set sizes (the COLD_WHEN
+level-2 gates) are summarized under the tables. ``W`` cells on
+HostParams/Shared are local VIEW rebinds (the
+``hp.replace(app_kind=...)`` per-process view in the app dispatcher),
+never persisted state — only Hosts columns carry state across passes.
 
-Exit codes: 0 matrix produced, 2 analysis-integrity failure (the
-violations are printed; ``python -m tools.simlint`` gates them).
+``--diff`` compares the fresh matrix against a committed ``--json``
+snapshot (CI runs it against ``tools/state_matrix_snapshot.json``):
+GROWTH of the drain working set, or a changed HOT/COLD declaration,
+exits 1 with the column named; shrinkage just suggests refreshing the
+snapshot so the gain is pinned.
+
+Exit codes: 0 matrix produced (or --diff clean), 1 --diff found
+unreviewed drift, 2 analysis-integrity failure (the violations are
+printed; ``python -m tools.simlint`` gates them).
 """
 
 from __future__ import annotations
@@ -98,11 +107,41 @@ def render_text(matrix, model) -> str:
         out.append("")
     bulk = sorted({b for e in matrix.values() for b in e["bulk"]})
     if bulk:
-        out.append("whole-tree ops (every column; what the hot/cold "
-                   "split narrows):")
+        out.append("whole-tree ops (every column of the named tree; "
+                   "hosts-kind ops are what the hot/cold split "
+                   "narrows):")
         for tag, file, line in bulk:
             out.append(f"  {file}:{line}: {tag}")
+    out.append("")
+    out.append(hot_summary_text(matrix, model))
     return "\n".join(out)
+
+
+def hot_counts(model) -> list:
+    """[(label, ncols)] drain working-set sizes: the static hot set
+    and the config-gated levels (cumulative per COLD_WHEN guard, in
+    declaration order — pure arithmetic on the parsed literals, no
+    engine import). The UNION row is every guard active at once: the
+    modeled UDP tier's per-pass working set."""
+    hot = set(model.hot_set())
+    rows = [("static (union over configs)", len(hot))]
+    off = set()
+    for guard, fields in model.cold_when:
+        off |= set(f for f in fields if f in hot)
+        rows.append((f"- {guard}", len(hot) - len(set(fields) & hot)))
+    rows.append(("all guards (modeled UDP tier)", len(hot - off)))
+    return rows
+
+
+def hot_summary_text(matrix, model) -> str:
+    drain = matrix.get("drain", {}).get("hosts", {})
+    touched = set(drain.get("reads", {})) | set(drain.get("writes", {}))
+    lines = [f"drain hot working set ({len(touched)} columns touched "
+             "in the drain subgraph; per-config sizes from the "
+             "declared COLD_WHEN gates):"]
+    for label, n in hot_counts(model):
+        lines.append(f"  {label}: {n}")
+    return "\n".join(lines)
 
 
 def render_markdown(matrix, model) -> str:
@@ -133,14 +172,63 @@ def render_json(matrix, model, root) -> str:
                        "line": model.linenos.get(name, 0)}
                       if kind == "hosts" else {})}
             for name in model.fields[kind]}
+    drain = matrix.get("drain", {}).get("hosts", {})
     return json.dumps({
-        "version": 1,
+        "version": 2,
         "root": root,
         "entries": matrix,
         "fields": fields,
         "cold_fields": sorted(model.cold),
+        "hot_fields": list(model.hot_set()),
+        "cold_when": [[g, list(f)] for g, f in model.cold_when],
+        "hot_counts": [list(r) for r in hot_counts(model)],
+        "drain_hot_columns": sorted(set(drain.get("reads", {}))
+                                    | set(drain.get("writes", {}))),
         "sections": [list(s) for s in model.sections],
     }, indent=1, sort_keys=False) + "\n"
+
+
+def diff_snapshot(matrix, model, snap_path: str) -> list:
+    """Compare the freshly-built matrix against a committed snapshot
+    (render_json output). Returns a list of human-readable failures —
+    empty when the drain's working set did not GROW and the declared
+    hot/cold partition is unchanged-or-reviewed. Shrinkage is
+    reported to stdout but never fails: the snapshot should simply be
+    refreshed in the same change (the growth direction is what needs
+    a reviewer — a column silently re-entering the per-pass working
+    set is exactly the regression the split exists to prevent)."""
+    with open(snap_path) as f:
+        snap = json.load(f)
+    failures = []
+    drain = matrix.get("drain", {}).get("hosts", {})
+    now = set(drain.get("reads", {})) | set(drain.get("writes", {}))
+    base = set(snap.get("drain_hot_columns", []))
+    grew = sorted(now - base)
+    for col in grew:
+        site = (drain.get("reads", {}).get(col)
+                or drain.get("writes", {}).get(col))
+        failures.append(
+            f"drain working set GREW: column `{col}` entered the "
+            f"drain subgraph at {site[0]}:{site[1]} but is not in "
+            f"the committed snapshot ({snap_path}) — either make it "
+            "cold again or refresh the snapshot with the reviewed "
+            "growth")
+    shrank = sorted(base - now)
+    if shrank:
+        print(f"state_matrix: drain working set shrank by "
+              f"{len(shrank)} columns vs snapshot ({', '.join(shrank)})"
+              " — refresh the snapshot to pin the gain")
+    for key in ("cold_fields", "hot_fields"):
+        if snap.get(key) is not None and \
+                list(snap[key]) != list({"cold_fields":
+                                         sorted(model.cold),
+                                         "hot_fields":
+                                         list(model.hot_set())}[key]):
+            failures.append(
+                f"declared {key.upper().replace('_', '')} changed vs "
+                f"snapshot {snap_path} — refresh it in the same "
+                "change so the diff is reviewed")
+    return failures
 
 
 def main(argv=None) -> int:
@@ -152,6 +240,10 @@ def main(argv=None) -> int:
                    help="repo root (default: auto-detect upward)")
     p.add_argument("--json", action="store_true")
     p.add_argument("--markdown", action="store_true")
+    p.add_argument("--diff", metavar="SNAPSHOT", default=None,
+                   help="compare against a committed --json snapshot; "
+                        "exit 1 when the drain working set grew or "
+                        "the declared partition changed (CI gate)")
     p.add_argument("-o", "--out", default=None,
                    help="write to a file instead of stdout")
     args = p.parse_args(argv)
@@ -166,6 +258,16 @@ def main(argv=None) -> int:
         print("state_matrix: analysis failed (see violations above)",
               file=sys.stderr)
         return 2
+
+    if args.diff:
+        failures = diff_snapshot(matrix, model, args.diff)
+        for msg in failures:
+            print(f"state_matrix: {msg}", file=sys.stderr)
+        if failures:
+            return 1
+        print(f"state_matrix: drain working set within snapshot "
+              f"{args.diff}")
+        return 0
 
     if args.json:
         text = render_json(matrix, model, root)
