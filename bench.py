@@ -35,7 +35,12 @@ BENCH_r{N}.json artifacts nobody diffs.
 
 A persistent XLA compile cache (.jax_cache/, gitignored) makes repeat
 runs skip the three cold compiles that dominated round 2's ~35 min
-matrix.
+matrix. Independently, SHADOW_TPU_AOT_CACHE=DIR enables the serving
+layer's executable cache (shadow_tpu/serving/aotcache.py) — and
+either way every line now says `compile_cache: hit|miss` plus the
+per-line `jitcache` counter deltas, so a "cold_wall" label is
+mechanically honest about whether cold included a real XLA build or
+opened warm from a cache (docs/serving.md).
 
 Legacy single-config mode (used by smoke tests):
   python bench.py 512 5     -> phold-512, 5 sim-seconds, one line
@@ -111,12 +116,17 @@ def _run_compiled(scen, cfg, warm_stop_ns=int(1.2 * 10**9), reps=1,
     --runahead knob (tools.baseline_configs.apply_runahead, the one
     shared definition)."""
     from shadow_tpu.engine.sim import Simulation
+    from shadow_tpu.serving import aotcache as _AC
     from tools.baseline_configs import apply_runahead
 
     def build(s):
         return apply_runahead(Simulation(s, engine_cfg=cfg),
                               runahead_ms)
 
+    # jitcache tallies over this line's warmup+reps: did "cold"
+    # include a real XLA build (compile_cache=miss), or did the line
+    # open warm from the in-memory/disk executable tier (hit)?
+    jc0 = dict(_AC.STATS)
     warm = copy.deepcopy(scen)
     warm.stop_time = warm_stop_ns
     build(warm).run()
@@ -139,6 +149,10 @@ def _run_compiled(scen, cfg, warm_stop_ns=int(1.2 * 10**9), reps=1,
         rates = [round(s["events_per_sec"], 1) for s in outs]
         med["rep_rates"] = rates
         med["rep_spread"] = round(rates[-1] - rates[0], 1)
+    delta = {k: round(_AC.STATS[k] - jc0[k], 3)
+             for k in jc0 if _AC.STATS[k] != jc0[k]}
+    med["compile_cache"] = "miss" if delta.get("compiles") else "hit"
+    med["jitcache"] = delta
     return med
 
 
@@ -224,6 +238,12 @@ def _emit(metric, summary, baseline, baseline_cfg, baseline_c=None,
         "events": summary["events"],
         "cold_wall": summary.get("cold_wall"),
         "warm_wall": summary.get("warm_wall"),
+        # what "cold" actually included, mechanically: miss = this
+        # line's warmup+reps paid >=1 real XLA compile; hit = every
+        # executable came from the jitcache memory/disk tier
+        # (serving.aotcache; jitcache holds the counter deltas)
+        "compile_cache": summary.get("compile_cache"),
+        "jitcache": summary.get("jitcache"),
         # cost-model digest (SimReport.cost_model): where the wall
         # goes, auditable per line
         "passes_per_window": round(cost.get("passes_per_window", 0), 2),
@@ -261,7 +281,9 @@ def _emit(metric, summary, baseline, baseline_cfg, baseline_c=None,
                 rep_spread=summary.get("rep_spread"),
                 cold_wall=summary.get("cold_wall"),
                 warm_wall=summary.get("warm_wall"),
-                cfg=ledger_cfg)
+                cfg=ledger_cfg,
+                note=(f"compile_cache={summary['compile_cache']}"
+                      if summary.get("compile_cache") else None))
             LG.append(entry)
         except Exception as e:  # pragma: no cover — never fail a line
             print(json.dumps({"ledger_error": repr(e)}), flush=True)
@@ -400,8 +422,11 @@ def main():
         except Exception as e:  # pragma: no cover
             print(json.dumps({"metric": fn.__name__, "error": repr(e)}),
                   flush=True)
+    from shadow_tpu.serving import aotcache as _AC
     print(json.dumps({"matrix": "complete",
-                      "wall_seconds": round(time.perf_counter() - t0, 1)}),
+                      "wall_seconds": round(time.perf_counter() - t0, 1),
+                      "jitcache": {k: round(v, 3)
+                                   for k, v in _AC.STATS.items()}}),
           flush=True)
 
 

@@ -93,6 +93,11 @@ def main(argv=None):
         # own argparse tree — dispatch before the run parser
         from .fleet.cli import main as fleet_main
         return fleet_main(argv_in[1:])
+    if argv_in[:1] == ["batch"]:
+        # vmapped scenario batching (serving.batch): N same-shape
+        # scenarios as one compiled program — its own argparse tree
+        from .serving.batch import main as batch_main
+        return batch_main(argv_in[1:])
     p = argparse.ArgumentParser(
         prog="shadow_tpu",
         description="TPU-native discrete-event network simulator")
@@ -223,6 +228,26 @@ def main(argv=None):
                         "or kind=latency,at=5s,until=9s,extra=30ms,"
                         "src=a,dst=b (engine.faults; deterministic, "
                         "seed-stable)")
+    p.add_argument("--aot-cache", default=None, metavar="DIR",
+                   help="persistent AOT executable cache: compiled "
+                        "window programs are serialized into DIR and "
+                        "reloaded by any later process with the same "
+                        "config fingerprint / arg signature / jax "
+                        "version / platform / source digest — a known "
+                        "shape loads in seconds instead of recompiling "
+                        "(docs/serving.md; SHADOW_TPU_AOT_CACHE also "
+                        "sets it)")
+    p.add_argument("--prewarm", action="store_true",
+                   help="compile (or disk-load) the scenario's window "
+                        "program into the AOT cache and exit WITHOUT "
+                        "running — the fleet pre-warm child "
+                        "(docs/serving.md)")
+    p.add_argument("--shape-fingerprint", action="store_true",
+                   help="print the scenario's compiled-shape "
+                        "fingerprint (obs.ledger.fingerprint_of of "
+                        "the resolved EngineConfig) as one JSON line "
+                        "and exit without compiling — the fleet "
+                        "scheduler's shape-dedup probe")
     p.add_argument("--engine-caps", default=None, metavar="K=V,...",
                    help="override engine array capacities, e.g. "
                         "qcap=16,scap=2,obcap=16,incap=32,chunk=256 "
@@ -403,6 +428,54 @@ def main(argv=None):
     if args.workers:
         from .parallel.shard import make_mesh
         mesh = make_mesh(args.workers)
+
+    if args.aot_cache:
+        from .serving import aotcache as AC
+        AC.install(args.aot_cache)
+
+    if args.shape_fingerprint or args.prewarm:
+        # serving-layer probes (docs/serving.md): both run AFTER every
+        # engine-knob override above (qdisc/caps mutate the compiled
+        # shape), so the fingerprint/program matches what a real run
+        # of this exact command line would build
+        from .obs.ledger import fingerprint_of
+        from .obs import digest as DG
+        if args.shape_fingerprint:
+            # the compiled-shape identity is fingerprint AND effective
+            # chunk (hosted runs chunk at 1; a digest cadence shrinks
+            # it) — two runs sharing a config fingerprint but chunking
+            # differently compile DIFFERENT programs, so the prewarm
+            # dedup keys on the composite `shape` (serving.prewarm)
+            chunk = sim.effective_chunk(
+                (args.digest_every or DG.DEFAULT_EVERY)
+                if args.digest else 0)
+            fp = fingerprint_of(sim.cfg)
+            # w<N> folds the mesh dimension in: --workers compiles
+            # the SHARDED program (run_windows_sharded), a different
+            # executable than the single-chip one — the two must
+            # never dedup onto one pre-warm slot
+            print(json.dumps(
+                {"shape_fingerprint": fp,
+                 "shape": f"c{chunk}.w{args.workers or 0}.{fp}",
+                 "chunk": chunk,
+                 "hosts": scenario.total_hosts(),
+                 "workers": args.workers}))
+            return 0
+        from .serving import aotcache as AC
+        info = sim.prewarm(
+            mesh=mesh,
+            digest_every=((args.digest_every or DG.DEFAULT_EVERY)
+                          if args.digest else 0))
+        st = AC.STATS
+        info["compile_cache"] = ("miss" if st["compiles"] else "hit")
+        info["cache_dir"] = args.aot_cache
+        print(json.dumps(info))
+        logger.message(0, "main",
+                       f"prewarm: shape {info['fingerprint']} "
+                       f"{info['compile_cache']} "
+                       f"(compile {st['compile_wall_s']:.1f}s, "
+                       f"load {st['load_wall_s']:.1f}s)")
+        return 0
 
     # --perf: install the span recorder ourselves (in-memory when no
     # --trace path was given) so the phase attribution + ledger append

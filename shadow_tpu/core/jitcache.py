@@ -10,8 +10,16 @@ backends; see tests/conftest.py notes). The ahead-of-time path —
 does not go through that dispatch cache and is immune.
 
 :class:`AotJit` wraps a function in exactly that: one Compiled object
-per argument-signature (shapes/dtypes/weak-types), cached. It costs a
-small per-call key computation over the arg pytree.
+per argument-signature (shapes/dtypes/weak-types/shardings), cached.
+It costs a small per-call key computation over the arg pytree.
+
+Since PR 13 the memoization has a DISK tier (serving.aotcache): an
+AotJit constructed with a stable ``cache_scope`` string resolves a
+signature miss by first trying the persistent executable cache (when
+one is active — ``--aot-cache DIR`` / ``SHADOW_TPU_AOT_CACHE``), so a
+fresh process loads a known program in seconds instead of recompiling
+it in minutes. Programs without a stable identity (no cache_scope)
+keep the memory-only behavior.
 """
 
 from __future__ import annotations
@@ -20,12 +28,61 @@ import jax
 
 
 class AotJit:
-    def __init__(self, fn, **jit_kwargs):
+    def __init__(self, fn, cache_scope: str = None, **jit_kwargs):
         self._jit = jax.jit(fn, **jit_kwargs)
+        self._fn = fn
+        self._jit_kwargs = dict(jit_kwargs)
         self._compiled = {}
+        # stable program identity for the persistent cache: must
+        # change whenever the traced Python would trace differently
+        # (closed-over config, chunk size...) — by convention it
+        # carries obs.ledger.fingerprint_of(cfg). None = memory only.
+        self.cache_scope = cache_scope
+
+    def undonated_jit(self):
+        """The donation-free twin of this program, or None when there
+        is nothing to strip. The disk tier executes cached programs
+        through this: a serialize/deserialize round trip of a DONATED
+        executable is unsound on the XLA:CPU client (the loaded
+        executable's outputs alias the donated input buffers, whose
+        memory the runtime frees — a use-after-free that corrupts
+        results after later allocations; see serving.aotcache).
+        Undonated execution computes identical values — donation is
+        memory management, never math — at a transient 2x peak for
+        the donated operands during the call."""
+        if not (self._jit_kwargs.get("donate_argnums")
+                or self._jit_kwargs.get("donate_argnames")):
+            return None
+        kw = {k: v for k, v in self._jit_kwargs.items()
+              if k not in ("donate_argnums", "donate_argnames")}
+        return jax.jit(self._fn, **kw)
 
     @staticmethod
-    def _sig(args):
+    def _sharding_key(sh):
+        """The signature's sharding component. Hashable shardings key
+        as themselves. An UNHASHABLE sharding must still yield a
+        distinct, stable key: the old ``sh = None`` degradation
+        aliased two different-sharding signatures onto one executable
+        — exactly the wrong-buffers failure mode this class exists to
+        prevent. Derive a structural key instead: type, the sorted
+        device ids it spans, its string form (NamedSharding spells
+        mesh + PartitionSpec there) and the memory kind."""
+        if sh is None:
+            return None
+        try:
+            hash(sh)
+            return sh
+        except TypeError:
+            pass
+        try:
+            devs = tuple(sorted(d.id for d in sh.device_set))
+        except Exception:
+            devs = None
+        return (type(sh).__name__, devs, str(sh),
+                getattr(sh, "memory_kind", None))
+
+    @classmethod
+    def _sig(cls, args):
         leaves, treedef = jax.tree.flatten(args)
 
         def leaf_sig(x):
@@ -34,23 +91,33 @@ class AotJit:
             # too: an AOT program compiled for replicated arrays must
             # not run against mesh-sharded ones (hosted + mesh runs
             # call the same op-replay program in both placements)
-            sh = getattr(x, "sharding", None)
-            try:
-                hash(sh)
-            except TypeError:
-                sh = None
+            sh = cls._sharding_key(getattr(x, "sharding", None))
             return (aval.shape, str(aval.dtype),
                     getattr(aval, "weak_type", False), sh)
 
         return treedef, tuple(leaf_sig(x) for x in leaves)
 
     def __call__(self, *args):
+        return self.warm(*args)(*args)
+
+    def warm(self, *args):
+        """Materialize the executable for this argument signature —
+        disk-load or compile — WITHOUT executing it: the fleet
+        pre-warm entry point (serving.prewarm). Donated buffers are
+        untouched (donation happens at execution, not compilation),
+        so a warmed Simulation still runs."""
         key = self._sig(args)
         fn = self._compiled.get(key)
         if fn is None:
-            fn = self._jit.lower(*args).compile()
+            fn = self._build(key, args)
             self._compiled[key] = fn
-        return fn(*args)
+        return fn
+
+    def _build(self, key, args):
+        from ..serving import aotcache
+        return aotcache.load_or_compile(self._jit, self.cache_scope,
+                                        key, args,
+                                        undonated=self.undonated_jit)
 
 
 def aot_jit(fn=None, **jit_kwargs):

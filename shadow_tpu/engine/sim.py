@@ -564,6 +564,59 @@ class Simulation:
 
         self._ran = False
 
+    def effective_chunk(self, digest_every: int = 0) -> int:
+        """The chunk the window program ACTUALLY compiles for: 1
+        under hosted apps (the CPU tier runs between every window),
+        shrunk to the digest cadence so records land on exact window
+        boundaries. One definition shared by run(), prewarm() and the
+        ``--shape-fingerprint`` probe — if they ever disagreed, the
+        pre-warm would silently warm a program no worker loads."""
+        chunk = 1 if self.hosting else self.cfg.chunk_windows
+        if digest_every:
+            chunk = min(chunk, digest_every)
+        return chunk
+
+    def prewarm(self, mesh=None, digest_every: int = 0) -> dict:
+        """Materialize the window-chunk executable this scenario will
+        run — disk-load or compile — WITHOUT executing it: the fleet
+        pre-warm entry point (serving.prewarm; CLI ``--prewarm``).
+
+        Builds exactly the program run() would build for the same
+        knobs: the chunk shrinks to 1 under hosted apps and to the
+        digest cadence when `digest_every` > 0 (run() records on
+        exact window boundaries), and a `mesh` pre-warms the sharded
+        program for that concrete device assignment. Populates the
+        process-wide memory tier (core.jitcache) and — when a
+        persistent cache is active (``--aot-cache`` /
+        ``SHADOW_TPU_AOT_CACHE``) — the disk tier, so a later worker
+        process opens warm. Donation happens at execution, never at
+        compilation, so this Simulation still runs afterwards.
+
+        Returns {"fingerprint", "chunk", "shards", "cache_scope"}.
+        """
+        from ..obs.ledger import fingerprint_of
+
+        if mesh is None:
+            hosts, cfg, hp, sh = self.hosts, self.cfg, self.hp, self.sh
+            chunk = self.effective_chunk(digest_every)
+            from .window import run_windows_aot
+            fn = run_windows_aot(cfg, chunk)
+            t0 = jnp.min(hosts.eq_next)
+        else:
+            from ..parallel.shard import (AXIS, device_put_sharded,
+                                          run_windows_sharded_aot)
+            n = mesh.shape[AXIS]
+            hosts, hp, sh, cfg = self._pad_for_mesh(n)
+            hosts, hp, sh = device_put_sharded(hosts, hp, sh, mesh)
+            chunk = self.effective_chunk(digest_every)
+            fn = run_windows_sharded_aot(cfg, chunk, mesh)
+            t0 = jax.jit(jnp.min)(hosts.eq_next)
+        wend = jnp.where(t0 == SIMTIME_MAX, t0, t0 + sh.min_jump)
+        fn.warm(hosts, hp, sh, t0, wend)
+        return {"fingerprint": fingerprint_of(cfg), "chunk": chunk,
+                "shards": 1 if mesh is None else mesh.size,
+                "cache_scope": fn.cache_scope}
+
     def _pad_for_mesh(self, n_shards: int):
         """Pad the host dimension to a multiple of the shard count with
         inert hosts (empty queues, no app). Inert rows never emit or
@@ -843,15 +896,11 @@ class Simulation:
 
         if mesh is None:
             hosts, cfg, hp, sh = self.hosts, self.cfg, self.hp, self.sh
-            # hosted apps need the CPU between every window
-            chunk = 1 if self.hosting else cfg.chunk_windows
+            # hosted chunk-1 + digest-cadence shrink: the one
+            # shared definition (a digest run is its own AOT entry,
+            # plain runs are untouched)
+            chunk = self.effective_chunk(dg.every if dg else 0)
             per_chip_h = cfg.num_hosts
-            if dg is not None:
-                # sub-chunk cadence: shrink the chunk so records land
-                # on exact digest boundaries (engine.window compiles
-                # one program per (cfg, chunk) — a digest run is its
-                # own AOT entry, plain runs are untouched)
-                chunk = min(chunk, dg.every)
 
             def step(hosts, sh_seg, ws, we):
                 return run_windows(hosts, hp, sh_seg, ws, we, cfg, chunk)
@@ -869,9 +918,7 @@ class Simulation:
             # between chunks (single-process mesh only — the multiproc
             # gate above still applies). chunk=1: hosted apps need the
             # CPU between every window.
-            chunk = 1 if self.hosting else cfg.chunk_windows
-            if dg is not None:
-                chunk = min(chunk, dg.every)  # exact digest boundaries
+            chunk = self.effective_chunk(dg.every if dg else 0)
 
             def step(hosts, sh_seg, ws, we):
                 return run_windows_sharded(hosts, hp, sh_seg, ws, we,

@@ -1009,6 +1009,66 @@ def next_wakeup(hosts):
 _RW_INSTANCES = {}
 
 
+def run_windows_aot(cfg: EngineConfig, max_windows: int):
+    """The AotJit wrapping the (cfg, max_windows) chunk program —
+    shared by run_windows and the serving layer's pre-warm path
+    (Simulation.prewarm compiles it without executing). The
+    cache_scope carries the config fingerprint, so the persistent
+    executable cache (serving.aotcache) keys this program stably
+    across processes."""
+    from ..core.jitcache import AotJit
+
+    key = (cfg, max_windows)
+    fn = _RW_INSTANCES.get(key)
+    if fn is None:
+        def impl(hosts, hp, sh, wstart, wend):
+            return _run_windows_impl(hosts, hp, sh, wstart, wend, cfg,
+                                     max_windows)
+
+        impl.__name__ = f"run_windows_v{len(_RW_INSTANCES)}"
+        impl.__qualname__ = impl.__name__
+        from ..obs.ledger import fingerprint_of
+        fn = AotJit(impl, donate_argnums=(0,),
+                    cache_scope=(f"run_windows.c{max_windows}"
+                                 f".{fingerprint_of(cfg)}"))
+        _RW_INSTANCES[key] = fn
+    return fn
+
+
+_RWB_INSTANCES = {}
+
+
+def run_windows_batch_aot(cfg: EngineConfig, max_windows: int,
+                          batch: int):
+    """The vmapped chunk program of the serving layer's scenario
+    batching (serving.batch): `batch` same-shape scenarios stacked on
+    a leading axis run the SAME (cfg, max_windows) program as
+    run_windows, one compile for all of them. jax's while_loop
+    batching rule freezes a finished lane's carry (select against the
+    old value), so each lane's window trajectory is exactly its
+    individual run's — byte-identical per digest chain
+    (tests/test_serving.py)."""
+    from ..core.jitcache import AotJit
+
+    key = (cfg, max_windows, batch)
+    fn = _RWB_INSTANCES.get(key)
+    if fn is None:
+        def impl(hosts, hp, sh, wstart, wend):
+            return jax.vmap(
+                lambda h, p, s, a, b: _run_windows_impl(
+                    h, p, s, a, b, cfg, max_windows))(
+                hosts, hp, sh, wstart, wend)
+
+        impl.__name__ = f"run_windows_batch_v{len(_RWB_INSTANCES)}"
+        impl.__qualname__ = impl.__name__
+        from ..obs.ledger import fingerprint_of
+        fn = AotJit(impl, donate_argnums=(0,),
+                    cache_scope=(f"run_windows_batch.c{max_windows}"
+                                 f".b{batch}.{fingerprint_of(cfg)}"))
+        _RWB_INSTANCES[key] = fn
+    return fn
+
+
 def run_windows(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
                 max_windows: int):
     """Execute up to `max_windows` lookahead windows on device.
@@ -1021,20 +1081,8 @@ def run_windows(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
     model (the TPU analogue of the reference's self-reported scheduler
     idle/barrier seconds, shd-scheduler.c:250-252).
     """
-    from ..core.jitcache import AotJit
-
-    key = (cfg, max_windows)
-    fn = _RW_INSTANCES.get(key)
-    if fn is None:
-        def impl(hosts, hp, sh, wstart, wend):
-            return _run_windows_impl(hosts, hp, sh, wstart, wend, cfg,
-                                     max_windows)
-
-        impl.__name__ = f"run_windows_v{len(_RW_INSTANCES)}"
-        impl.__qualname__ = impl.__name__
-        fn = AotJit(impl, donate_argnums=(0,))
-        _RW_INSTANCES[key] = fn
-    return fn(hosts, hp, sh, wstart, wend)
+    return run_windows_aot(cfg, max_windows)(hosts, hp, sh, wstart,
+                                             wend)
 
 
 def _run_windows_impl(hosts, hp, sh, wstart, wend, cfg: EngineConfig,

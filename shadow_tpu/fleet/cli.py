@@ -6,10 +6,15 @@
   python -m shadow_tpu fleet status Q [--json]
 
 ``submit`` durably enqueues one run (the XML is copied into the
-queue, so temp files are fine). ``run`` drains the queue — restart it
-after any crash or preemption and the sweep completes as if never
-interrupted (docs/fleet.md). ``status`` folds the journal into a
-table.
+queue, so temp files are fine); ``--batch GROUP [--seeds 1,2,..]``
+enqueues vmapped-batch members that execute as lanes of ONE compiled
+program (serving.batch). ``run`` drains the queue — restart it after
+any crash or preemption and the sweep completes as if never
+interrupted (docs/fleet.md); ``--aot-cache DIR`` shares a persistent
+executable cache across children and ``--prewarm`` compiles each
+distinct config shape once before its runs admit (docs/serving.md).
+``status`` folds the journal into a table, including shapes warmed
+vs pending.
 
 Exit codes of ``run``: 0 queue drained, every run done; 3 drained but
 some runs quarantined (their crash-cause journals are named in the
@@ -96,6 +101,19 @@ def main(argv=None) -> int:
                          "path unless LEDGER given). Resumed "
                          "attempts skip the append, as documented in "
                          "docs/performance.md")
+    ps.add_argument("--batch", default=None, metavar="GROUP",
+                    help="vmapped-batch group (serving.batch): every "
+                         "member submitted under GROUP executes in "
+                         "ONE child as lanes of one compiled program "
+                         "— one compile, N executions — while keeping "
+                         "its own journal state and digest chain. "
+                         "Members must share one compiled shape "
+                         "(identical EngineConfig); batch retries "
+                         "re-run the whole group from scratch (no "
+                         "managed checkpoint). docs/serving.md")
+    ps.add_argument("--seeds", default=None, metavar="S1,S2,...",
+                    help="with --batch: submit one member per seed "
+                         "from this one XML (ids <id>-s<seed>)")
     ps.add_argument("--env", action="append", default=[],
                     metavar="K=V", help="child environment override "
                                         "(repeatable)")
@@ -126,6 +144,22 @@ def main(argv=None) -> int:
                     help="write fleet.* metrics (obs.metrics) to FILE")
     pr.add_argument("--python", default=None,
                     help="interpreter for child runs")
+    pr.add_argument("--aot-cache", default=None, metavar="DIR",
+                    help="persistent AOT executable cache shared by "
+                         "every child (serving.aotcache): a sweep's "
+                         "repeated shapes compile once and load in "
+                         "seconds afterwards (docs/serving.md)")
+    pr.add_argument("--prewarm", action="store_true",
+                    help="with --aot-cache: fingerprint each queued "
+                         "config run's compiled shape headlessly, "
+                         "dedup shapes across the sweep, and compile "
+                         "each distinct shape ONCE before its runs "
+                         "admit — workers open on a warm cache "
+                         "(serving.prewarm; docs/serving.md)")
+    pr.add_argument("--prewarm-jobs", type=int, default=1,
+                    metavar="N",
+                    help="concurrent shape probe/compile children "
+                         "(default 1)")
 
     pt = sub.add_parser("status", help="fold the journal into a table")
     pt.add_argument("queue")
@@ -137,6 +171,7 @@ def main(argv=None) -> int:
     if rest and args.cmd_name != "submit":
         p.error(f"`{args.cmd_name}` takes no `--` tail")
     from .queue import Queue, make_spec
+    from .worker import _cfg_bytes
 
     if args.cmd_name == "submit":
         q = Queue(args.queue)
@@ -146,9 +181,17 @@ def main(argv=None) -> int:
             if not eq:
                 p.error(f"--env {kv!r} is not K=V")
             env[k] = v
+        if args.seeds and not args.batch:
+            p.error("--seeds expands a vmapped-batch group; give the "
+                    "group a name with --batch GROUP")
         if args.cmd:
             if not rest:
                 p.error("--cmd needs a command after --")
+            if args.batch:
+                p.error("--batch members are config runs (the batch "
+                        "child stacks their engine state on one "
+                        "vmapped axis; an arbitrary command has no "
+                        "such state)")
             # durability/perf args are managed for CONFIG runs only;
             # silently accepting them here would e.g. drop the user's
             # expected ledger entries without a trace
@@ -186,6 +229,118 @@ def main(argv=None) -> int:
                         "--no-digest, --perf) instead")
             stem = os.path.splitext(os.path.basename(args.config))[0]
             rid = args.id or _auto_id(q, stem)
+            if args.batch:
+                # batch children run the group's configs verbatim
+                # (serving.batch takes no per-member extra args) — a
+                # `--` tail would be silently dropped; refuse instead
+                if rest:
+                    p.error("--batch members take no `--` tail (the "
+                            "batch child runs the XMLs verbatim; "
+                            "vary members by --seeds or by config)")
+                if args.checkpoint_every != 10.0:
+                    p.error("--checkpoint-every with --batch: batch "
+                            "children carry no checkpoint store — a "
+                            "crashed group re-runs from scratch "
+                            "(docs/serving.md)")
+                seeds = [None]
+                if args.seeds:
+                    try:
+                        seeds = [int(s) for s in args.seeds.split(",")
+                                 if s.strip()]
+                    except ValueError:
+                        p.error(f"--seeds {args.seeds!r}: not "
+                                "integers")
+                    if not seeds:
+                        p.error("--seeds names no seeds")
+                # group consistency: ONE batch child runs the whole
+                # group, in exactly one of two forms (worker.
+                # build_batch_argv) — one XML x N seeds, or one XML
+                # per member. Submissions into an existing group must
+                # keep its form, and the seeded form must keep its
+                # one XML (by content; the queue copies per member)
+                if q.exists():
+                    prior = [st.spec for st in q.fold().values()
+                             if st.spec.get("batch") == args.batch]
+                    if prior:
+                        seeded = args.seeds is not None
+                        was = prior[0].get("batch_seed") is not None
+                        if seeded != was:
+                            form = "seeded" if was else "per-XML"
+                            p.error(
+                                f"batch group {args.batch!r} already "
+                                f"holds {form} members; a group "
+                                "mixes no forms (one child, one argv "
+                                "shape — docs/serving.md)")
+                        if not seeded:
+                            # the batch child names per-member
+                            # outputs by config stem (serving.batch);
+                            # a colliding stem would only fail at RUN
+                            # time as a usage-error quarantine of the
+                            # whole group — refuse it here instead
+                            stems = {os.path.splitext(
+                                os.path.basename(s["config"]))[0]
+                                for s in prior}
+                            if stem in stems:
+                                p.error(
+                                    f"batch group {args.batch!r} "
+                                    f"already holds a member whose "
+                                    f"config is named {stem!r} — the "
+                                    "batch child names per-member "
+                                    "outputs by config basename, so "
+                                    "stems must be distinct "
+                                    "(docs/serving.md)")
+                        if seeded and _cfg_bytes(
+                                prior[0]["config"]) not in (
+                                None, _cfg_bytes(args.config)):
+                            p.error(
+                                f"batch group {args.batch!r} is the "
+                                "one-XML-many-seeds form and this XML "
+                                "differs from the group's — seeded "
+                                "members all run ONE config "
+                                "(docs/serving.md)")
+                        # the ONE batch child runs with the group's
+                        # digest/perf/env settings; silently running
+                        # a member at another member's settings would
+                        # drop its expected ledger entry / cadence
+                        # without a trace (the PR 7 submit-gate
+                        # principle) — refuse instead
+                        group_knobs = {
+                            "digest": not args.no_digest,
+                            "digest_every": int(args.digest_every),
+                            "perf": args.perf, "env": env}
+                        prior_knobs = {k: prior[0].get(k)
+                                       for k in group_knobs}
+                        if prior_knobs != group_knobs:
+                            diff = [k for k in group_knobs
+                                    if group_knobs[k]
+                                    != prior_knobs[k]]
+                            p.error(
+                                f"batch group {args.batch!r}: "
+                                f"{', '.join(diff)} differ(s) from "
+                                "the group's — one child runs the "
+                                "whole group, so digest/perf/env "
+                                "settings are group-wide "
+                                "(docs/serving.md)")
+                rids = []
+                for seed in seeds:
+                    mid = rid if seed is None else f"{rid}-s{seed}"
+                    spec = make_spec(
+                        mid, config=args.config, env=env,
+                        hosts=args.hosts or _count_hosts(args.config),
+                        rss_mb=args.rss_mb,
+                        max_retries=args.max_retries,
+                        digest=not args.no_digest,
+                        digest_every=args.digest_every,
+                        perf=args.perf, batch=args.batch,
+                        batch_seed=seed)
+                    try:
+                        q.submit(spec)
+                    except (ValueError, OSError) as e:
+                        p.error(str(e))
+                    rids.append(mid)
+                print(f"submitted {' '.join(rids)} -> {args.queue} "
+                      f"(batch group {args.batch})")
+                return 0
             spec = make_spec(
                 rid, config=args.config, args=rest, env=env,
                 hosts=args.hosts or _count_hosts(args.config),
@@ -207,12 +362,17 @@ def main(argv=None) -> int:
         if not q.exists():
             p.error(f"{args.queue!r} holds no queue journal — submit "
                     "runs first")
+        if args.prewarm and not args.aot_cache:
+            p.error("--prewarm compiles shapes INTO the persistent "
+                    "executable cache; give it one with "
+                    "--aot-cache DIR")
         sched = Scheduler(
             q, workers=args.workers, max_hosts=args.max_hosts,
             max_rss_mb=args.max_rss_mb,
             hang_timeout_s=args.hang_timeout, backoff_s=args.backoff,
             backoff_cap_s=args.backoff_cap, grace_s=args.grace,
-            python=args.python)
+            python=args.python, aot_cache=args.aot_cache,
+            prewarm=args.prewarm, prewarm_jobs=args.prewarm_jobs)
         # SIGTERM/SIGINT = preempt: children checkpoint + requeue,
         # we exit 75; the next `fleet run` resumes the sweep
         for sig in (signal.SIGTERM, signal.SIGINT):
@@ -233,17 +393,22 @@ def main(argv=None) -> int:
     # status
     q = Queue(args.queue)
     states = q.fold()
+    pw = q.prewarm_fold()
     if args.json:
-        print(json.dumps(
-            {rid: {**st.spec, "state": st.state,
-                   "started": st.started, "crashes": st.crashes,
-                   "preemptions": st.preemptions,
-                   "reclaims": st.reclaims,
-                   "last_rc": st.last_rc,
-                   "last_cause": st.last_cause,
-                   "quarantine_cause": st.quarantine_cause}
-             for rid, st in states.items()},
-            indent=1, sort_keys=True))
+        out = {rid: {**st.spec, "state": st.state,
+                     "started": st.started, "crashes": st.crashes,
+                     "preemptions": st.preemptions,
+                     "reclaims": st.reclaims,
+                     "last_rc": st.last_rc,
+                     "last_cause": st.last_cause,
+                     "quarantine_cause": st.quarantine_cause}
+               for rid, st in states.items()}
+        if pw["shapes"]:
+            # shapes warmed vs pending (serving.prewarm journal
+            # records); "_shapes" cannot collide with a run id — the
+            # table is keyed by path-safe ids the submitter chose
+            out["_shapes"] = pw
+        print(json.dumps(out, indent=1, sort_keys=True))
         return 0
     if not states:
         print(f"{args.queue}: empty queue")
@@ -253,6 +418,10 @@ def main(argv=None) -> int:
           "cause")
     for rid, st in states.items():
         cause = st.quarantine_cause or st.last_cause or ""
+        batch = st.spec.get("batch")
+        if batch:
+            cause = (f"[batch {batch}] {cause}" if cause
+                     else f"[batch {batch}]")
         print(f"{rid:<{wid}}{st.state:<13}{st.started:<8}"
               f"{st.crashes:<9}{cause}")
     counts = {}
@@ -260,6 +429,19 @@ def main(argv=None) -> int:
         counts[st.state] = counts.get(st.state, 0) + 1
     print("total: " + ", ".join(f"{v} {k}"
                                 for k, v in sorted(counts.items())))
+    if pw["shapes"]:
+        sc = {}
+        for st in pw["shapes"].values():
+            sc[st] = sc.get(st, 0) + 1
+        print("shapes: " + ", ".join(
+            f"{v} {k}" for k, v in sorted(sc.items())))
+        for fp, st in sorted(pw["shapes"].items()):
+            members = sorted(r for r, f in pw["runs"].items()
+                             if f == fp)
+            print(f"  {fp}  {st:<10} "
+                  + (" ".join(members[:6])
+                     + (f" +{len(members) - 6}" if len(members) > 6
+                        else "")))
     return 0
 
 

@@ -50,14 +50,21 @@ def make_spec(run_id: str, config: str = None, cmd: list = None,
               args: list = None, env: dict = None, hosts: int = 1,
               rss_mb: int = 0, max_retries: int = 3,
               checkpoint_every: float = 10.0, digest: bool = True,
-              digest_every: int = 0, perf: str = None) -> dict:
+              digest_every: int = 0, perf: str = None,
+              batch: str = None, batch_seed: int = None) -> dict:
     """One run spec (a journal ``submit`` payload). Exactly one of
     `config` (scenario XML path — managed durability) and `cmd`
     (arbitrary argv — rerun-from-scratch retries) must be set.
     `hosts`/`rss_mb` are the admission-control weights; `args` extra
     CLI arguments for config runs (seed, faults, engine caps...);
     `perf` non-None appends a per-run perf-ledger entry on completion
-    ("" = the default ledger path)."""
+    ("" = the default ledger path). `batch` names a vmapped-batch
+    group (serving.batch): every member of the group executes in ONE
+    child (``python -m shadow_tpu batch``) while keeping its own
+    journal state; `batch_seed` is the member's seed in the
+    one-XML-many-seeds form. Batch members are config runs WITHOUT
+    managed checkpoints (a crashed batch re-runs from scratch, like
+    a cmd run)."""
     if not valid_run_id(run_id):
         raise ValueError(
             f"run id {run_id!r} is not path-safe (letters/digits/._- "
@@ -65,6 +72,8 @@ def make_spec(run_id: str, config: str = None, cmd: list = None,
     if bool(config) == bool(cmd):
         raise ValueError("a run spec needs exactly one of config=XML "
                          "or cmd=[argv]")
+    if batch is not None and not config:
+        raise ValueError("batch members are config runs")
     return {
         "id": run_id,
         "config": config,
@@ -78,6 +87,8 @@ def make_spec(run_id: str, config: str = None, cmd: list = None,
         "digest": bool(digest),
         "digest_every": int(digest_every),
         "perf": perf,
+        "batch": batch,
+        "batch_seed": batch_seed,
     }
 
 
@@ -169,6 +180,9 @@ class Queue:
         states: dict = {}
         for rec in self.entries():
             op = rec.get("op")
+            if op == "prewarm":
+                continue          # shape records fold separately
+                #   (prewarm_fold); they carry no run transition
             if op == "submit":
                 spec = rec.get("run") or {}
                 rid = spec.get("id")
@@ -218,6 +232,26 @@ class Queue:
                     f"fleet queue: {self.journal}: unknown op "
                     f"{op!r} — skipped\n")
         return states
+
+    def prewarm_fold(self) -> dict:
+        """The serving-layer shape records (``op: prewarm`` — written
+        by the scheduler's Prewarmer): {"shapes": {fingerprint: last
+        state}, "runs": {run_id: fingerprint}} — what ``fleet
+        status`` reports as shapes warmed vs pending."""
+        shapes: dict = {}
+        runs: dict = {}
+        for rec in self.entries():
+            if rec.get("op") != "prewarm":
+                continue
+            fp = rec.get("shape")
+            state = rec.get("state")
+            rid = rec.get("run")
+            if fp:
+                shapes[fp] = state if state != "resolved" else (
+                    shapes.get(fp) or "pending")
+            if rid and fp:
+                runs[rid] = fp
+        return {"shapes": shapes, "runs": runs}
 
     # --- per-run paths ---
     def run_dir(self, run_id: str) -> str:

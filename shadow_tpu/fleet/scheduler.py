@@ -43,7 +43,7 @@ import time
 
 from ..engine.supervisor import EXIT_PREEMPTED, backoff_delay
 from .queue import TERMINAL, Queue
-from .worker import Slot
+from .worker import BatchSlot, Slot
 
 LOCK = "scheduler.lock"
 
@@ -72,7 +72,8 @@ class Scheduler:
                  hang_timeout_s: float = 900.0, backoff_s: float = 1.0,
                  backoff_cap_s: float = 60.0, grace_s: float = 60.0,
                  poll_s: float = 0.2, python: str = None, log=None,
-                 max_spont_preempts: int = 20):
+                 max_spont_preempts: int = 20, aot_cache: str = None,
+                 prewarm: bool = False, prewarm_jobs: int = 1):
         self.queue = queue
         self.workers = max(int(workers), 1)
         self.max_hosts = int(max_hosts)
@@ -85,6 +86,13 @@ class Scheduler:
         self.python = python
         self.log = log or (lambda m: sys.stderr.write(
             f"shadow_tpu: fleet: {m}\n"))
+        # serving layer (docs/serving.md): children share a
+        # persistent AOT executable cache, and with prewarm=True each
+        # distinct config shape compiles ONCE before its runs admit
+        self.aot_cache = aot_cache
+        self.prewarm = bool(prewarm) and bool(aot_cache)
+        self.prewarm_jobs = max(int(prewarm_jobs), 1)
+        self._prewarmer = None
         # spontaneous exit-75s (nobody preempted): bounded so a child
         # that always exits 75 cannot livelock the drain loop
         self.max_spont_preempts = int(max_spont_preempts)
@@ -249,6 +257,8 @@ class Scheduler:
 
     # --- one reaped exit ---
     def _handle_exit(self, slot: Slot, rc: int, states: dict):
+        if isinstance(slot, BatchSlot):
+            return self._handle_batch_exit(slot, rc, states)
         st = states[slot.run_id]
         kind, cause = slot.classify(rc)
         slot.record_exit(rc, kind, cause)
@@ -285,6 +295,56 @@ class Scheduler:
             self.log(f"run {slot.run_id}: {cause}; requeued resumable")
             return
         self._register_crash(st, rc, cause)
+
+    def _handle_batch_exit(self, slot: BatchSlot, rc: int,
+                           states: dict):
+        """One batch child's exit fans out to every member's journal
+        state: done marks all members done; preempt requeues them
+        (re-run from scratch — batch children carry no checkpoint);
+        a crash escalates EACH member's own retry→quarantine count,
+        so a poisoned group parks member by member while the rest of
+        the queue drains."""
+        kind, cause = slot.classify(rc)
+        slot.record_exit(rc, kind, cause)
+        wall = round(time.time() - slot.t0, 3)
+        for rid in slot.member_ids:
+            self.queue.append("exit", id=rid, attempt=slot.attempt,
+                              rc=rc, kind=kind, cause=cause,
+                              wall_s=wall, batch=slot.group)
+            self.queue.release(rid)
+        slot.close()
+        for rid in slot.member_ids:
+            st = states[rid]
+            st.last_rc, st.last_cause, st.pid = rc, cause, None
+            if kind == "done":
+                st.state = "done"
+            elif kind == "preempt":
+                st.preemptions += 1
+                st.state = "queued"
+            else:
+                self._register_crash(st, rc, cause)
+        if kind == "done":
+            self.log(f"batch {slot.group}: completed "
+                     f"({len(slot.member_ids)} members, attempt "
+                     f"{slot.attempt})")
+        elif kind == "preempt":
+            if not slot.preempting:
+                n = self._spont_preempts.get(slot.run_id, 0) + 1
+                self._spont_preempts[slot.run_id] = n
+                if n > self.max_spont_preempts:
+                    for rid in slot.member_ids:
+                        self._quarantine(
+                            states[rid],
+                            f"batch preempted {n} times without a "
+                            "scheduler preemption (exit-75 "
+                            f"livelock); last: {cause}")
+                    return
+                delay = backoff_delay(self.backoff_s, n,
+                                      self.backoff_cap_s)
+                for rid in slot.member_ids:
+                    self._eligible_at[rid] = time.time() + delay
+            self.log(f"batch {slot.group}: {cause}; members requeued "
+                     "(batch retries re-run from scratch)")
 
     def _quarantine(self, st, why: str):
         self.queue.append("quarantine", id=st.id, cause=why,
@@ -355,6 +415,87 @@ class Scheduler:
             c = reg.counter(f"fleet.{k}")
             c.n = v                       # absolute, scheduler-owned
 
+    def _claim(self, run_id: str) -> bool:
+        """Claim one run, reclaiming a dead holder's stale claim."""
+        if self.queue.claim(run_id, {"scheduler_pid": os.getpid()}):
+            return True
+        claim = self.queue.read_claim(run_id) or {}
+        if _pid_alive(claim.get("pid")):
+            return False          # genuinely held (shouldn't happen
+            #   under the lock) — skip
+        self.queue.release(run_id)
+        return self.queue.claim(run_id,
+                                {"scheduler_pid": os.getpid()})
+
+    def _admit_batch(self, st, states: dict, now: float,
+                     slotted: set) -> bool:
+        """Try to admit the whole vmapped-batch group `st` belongs to
+        as ONE BatchSlot. All non-terminal members must be queued,
+        past their backoff and (under --prewarm) shape-warm; the
+        group's admission weight is the members' sum. Returns True
+        when a slot started."""
+        gid = st.spec.get("batch")
+        group = [s for s in states.values()
+                 if s.spec.get("batch") == gid
+                 and s.state not in TERMINAL]
+        if not group:
+            return False
+        for m in group:
+            if (m.state != "queued" or m.id in slotted
+                    or now < self._eligible_at.get(m.id, 0)
+                    or (self._prewarmer is not None
+                        and not self._prewarmer.ready(m.id))):
+                return False
+        weight = {"hosts": sum(m.spec.get("hosts", 1) for m in group),
+                  "rss_mb": sum(m.spec.get("rss_mb", 0)
+                                for m in group)}
+        if not self.admissible(weight):
+            return False
+        claimed = []
+        for m in group:
+            if not self._claim(m.id):
+                for rid in claimed:
+                    self.queue.release(rid)
+                return False
+            claimed.append(m.id)
+        try:
+            slot = BatchSlot(self.queue, group, python=self.python,
+                             log=self.log, aot_cache=self.aot_cache)
+        except OSError as e:
+            for m in group:
+                self._handle_spawn_failure(m, e)
+            return False
+        try:
+            slot.start()
+        except OSError as e:
+            slot.close()
+            for m in group:
+                self._handle_spawn_failure(m, e)
+            return False
+        for m in group:
+            m.state = "running"
+            m.started += 1
+            m.pid = slot.proc.pid
+            self.queue.append("start", id=m.id, attempt=slot.attempt,
+                              pid=slot.proc.pid, resume=False,
+                              batch=gid)
+        self.slots.append(slot)
+        self._counters["starts"] += 1
+        self.log(f"batch {gid}: started attempt {slot.attempt} "
+                 f"({len(group)} members, pid {slot.proc.pid})")
+        return True
+
+    def _slotted_ids(self) -> set:
+        """Every run id currently covered by a slot — a BatchSlot
+        covers all its members, not just its leading run_id."""
+        ids = set()
+        for s in self.slots:
+            if isinstance(s, BatchSlot):
+                ids.update(s.member_ids)
+            else:
+                ids.add(s.run_id)
+        return ids
+
     # --- the drain loop ---
     def run(self) -> int:
         self.queue.ensure()
@@ -365,11 +506,33 @@ class Scheduler:
                 self.log("queue is empty; nothing to do")
                 return EXIT_DRAINED
             self._recover(states)
+            if self.prewarm:
+                # serving.prewarm: fingerprint each queued config
+                # run's shape, dedup across the sweep, compile each
+                # distinct shape once into the shared cache; runs
+                # admit once their shape is warmed (docs/serving.md)
+                from ..serving.prewarm import Prewarmer
+                self._prewarmer = Prewarmer(
+                    [st.spec for st in states.values()
+                     if st.state not in TERMINAL
+                     and not st.spec.get("batch")],
+                    # batch groups are excluded: they compile their
+                    # own vmapped b<N> program (one compile for the
+                    # whole group by construction), which the
+                    # single-run warm would not serve — gating them
+                    # on it would pay two compiles (docs/serving.md)
+                    self.aot_cache, python=self.python,
+                    jobs=self.prewarm_jobs, log=self.log,
+                    journal=lambda **kw: self.queue.append(
+                        "prewarm", **kw))
             n_all = len(states)
             self.log(f"draining {n_all} runs "
                      f"({sum(1 for s in states.values() if s.state in TERMINAL)} "
                      f"already terminal) with {self.workers} workers")
             while True:
+                # 0. pre-warm pipeline (non-blocking)
+                if self._prewarmer is not None:
+                    self._prewarmer.tick()
                 # 1. reap
                 for slot in list(self.slots):
                     rc = slot.proc.poll()
@@ -395,31 +558,32 @@ class Scheduler:
                 if self._preempt.is_set():
                     return self._drain_preempt(states)
                 # 4. admit
+                slotted = self._slotted_ids()
                 for st in states.values():
                     if len(self.slots) >= self.workers:
                         break
                     if st.state != "queued":
                         continue
-                    if any(s.run_id == st.id for s in self.slots):
+                    if st.id in slotted:
                         continue
                     if now < self._eligible_at.get(st.id, 0):
                         continue
+                    if (self._prewarmer is not None
+                            and not self._prewarmer.ready(st.id)):
+                        continue      # shape still probing/compiling
+                    if st.spec.get("batch"):
+                        if self._admit_batch(st, states, now,
+                                             slotted):
+                            slotted = self._slotted_ids()
+                        continue
                     if not self.admissible(st.spec):
                         continue
-                    if not self.queue.claim(
-                            st.id, {"scheduler_pid": os.getpid()}):
-                        claim = self.queue.read_claim(st.id) or {}
-                        if _pid_alive(claim.get("pid")):
-                            continue      # genuinely held (shouldn't
-                            #   happen under the lock) — skip
-                        self.queue.release(st.id)
-                        if not self.queue.claim(
-                                st.id,
-                                {"scheduler_pid": os.getpid()}):
-                            continue
+                    if not self._claim(st.id):
+                        continue
                     try:
                         slot = Slot(self.queue, st, python=self.python,
-                                    log=self.log)
+                                    log=self.log,
+                                    aot_cache=self.aot_cache)
                     except OSError as e:
                         self._handle_spawn_failure(st, e)
                         continue
@@ -438,6 +602,7 @@ class Scheduler:
                     st.started += 1
                     st.pid = slot.proc.pid
                     self.slots.append(slot)
+                    slotted.add(st.id)
                     self._counters["starts"] += 1
                     self.queue.append(
                         "start", id=st.id, attempt=slot.attempt,
@@ -465,6 +630,8 @@ class Scheduler:
                         if quarantined else ""))
             return EXIT_QUARANTINED if quarantined else EXIT_DRAINED
         finally:
+            if self._prewarmer is not None:
+                self._prewarmer.shutdown()
             self._release_lock()
 
     def _drain_preempt(self, states: dict) -> int:

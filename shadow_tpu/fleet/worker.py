@@ -50,10 +50,12 @@ os.execvp(sys.argv[2], sys.argv[2:])
 
 
 def build_child_argv(queue: Queue, spec: dict, resume: bool,
-                     python: str = None) -> list:
+                     python: str = None, aot_cache: str = None) -> list:
     """The child command line for one attempt. Config runs get the
-    managed durability args; cmd runs are verbatim (their retries
-    re-run from scratch — the spec chose that mode)."""
+    managed durability args (plus ``--aot-cache`` when the scheduler
+    serves one — serving.aotcache); cmd runs are verbatim (their
+    retries re-run from scratch — the spec chose that mode; they get
+    the cache via SHADOW_TPU_AOT_CACHE in their environment)."""
     if spec.get("cmd"):
         return list(spec["cmd"])
     rid = spec["id"]
@@ -69,8 +71,69 @@ def build_child_argv(queue: Queue, spec: dict, resume: bool,
     if spec.get("perf") is not None:
         argv += (["--perf", spec["perf"]] if spec["perf"]
                  else ["--perf"])
+    if aot_cache:
+        argv += ["--aot-cache", os.path.abspath(aot_cache)]
     if resume:
         argv += ["--resume", "latest"]
+    return argv
+
+
+def _cfg_bytes(path: str) -> bytes | None:
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def build_batch_argv(queue: Queue, specs: list, python: str = None,
+                     aot_cache: str = None) -> list:
+    """The ONE child command line executing a whole vmapped-batch
+    group (``python -m shadow_tpu batch`` — serving.batch):
+    per-member digest chains land in each member's run directory via
+    --digest-paths, exactly where an individual run's would. Two
+    forms, decided by the specs: every member carrying a batch_seed
+    = one XML x N seeds; otherwise one XML per member."""
+    py = python or sys.executable
+    seeds = [s.get("batch_seed") for s in specs]
+    seeded = [sd is not None for sd in seeds]
+    # backstop for the submit-time group-consistency gate (fleet.cli):
+    # a malformed group must refuse here — the scheduler records the
+    # OSError as a per-member spawn failure — never silently run the
+    # wrong XML or drop seeds. OSError because that is the spawn-
+    # failure contract the scheduler already handles.
+    if any(seeded) and not all(seeded):
+        raise OSError(
+            "batch group mixes seeded and unseeded members — one "
+            "child runs one argv shape (docs/serving.md); resubmit "
+            "the group in one form")
+    if all(seeded):
+        if len(specs) > 1:
+            blobs = {_cfg_bytes(s["config"]) for s in specs}
+            blobs.discard(None)
+            if len(blobs) > 1:
+                raise OSError(
+                    "batch group is the one-XML-many-seeds form but "
+                    "its members' XMLs differ — seeded members all "
+                    "run ONE config (docs/serving.md)")
+        argv = [py, "-m", "shadow_tpu", "batch",
+                os.path.abspath(specs[0]["config"]),
+                "--seeds", ",".join(str(sd) for sd in seeds)]
+    else:
+        argv = ([py, "-m", "shadow_tpu", "batch"]
+                + [os.path.abspath(s["config"]) for s in specs])
+    if specs[0].get("digest", True):
+        argv += ["--digest-paths",
+                 ",".join(os.path.abspath(queue.digest_path(s["id"]))
+                          for s in specs)]
+        if specs[0].get("digest_every"):
+            argv += ["--digest-every",
+                     str(specs[0]["digest_every"])]
+    if specs[0].get("perf") is not None:
+        argv += (["--perf", specs[0]["perf"]] if specs[0]["perf"]
+                 else ["--perf"])
+    if aot_cache:
+        argv += ["--aot-cache", os.path.abspath(aot_cache)]
     return argv
 
 
@@ -79,7 +142,7 @@ class Slot:
     child process, the claim's pid refresh, and the exit record."""
 
     def __init__(self, queue: Queue, state, python: str = None,
-                 log=None):
+                 log=None, aot_cache: str = None):
         self.queue = queue
         self.spec = state.spec
         self.run_id = state.spec["id"]
@@ -94,10 +157,17 @@ class Slot:
 
         rd = queue.run_dir(self.run_id)
         os.makedirs(rd, exist_ok=True)
-        argv = build_child_argv(queue, self.spec, self.resume, python)
+        argv = build_child_argv(queue, self.spec, self.resume, python,
+                                aot_cache=aot_cache)
         env = dict(os.environ)
         env.update(self.spec.get("env") or {})
         env["SHADOW_TPU_FLEET_RUN_DIR"] = os.path.abspath(rd)
+        if aot_cache:
+            # cmd runs (arbitrary argv — bench lines, tools) pick the
+            # persistent executable cache up from the environment
+            # (serving.aotcache.active); config runs also get the
+            # explicit --aot-cache flag above
+            env["SHADOW_TPU_AOT_CACHE"] = os.path.abspath(aot_cache)
         self._stdout = open(queue.log_path(self.run_id), "ab")
         self.t0 = time.time()
         self.last_progress = self.t0
@@ -232,3 +302,82 @@ class Slot:
             self._stdout.close()
         except OSError:
             pass
+
+
+class BatchSlot(Slot):
+    """One executing vmapped-batch GROUP (serving.batch): a single
+    child process covering N member runs, each keeping its own
+    journal state. The scheduler claims every member before spawning;
+    the claim gate rides the FIRST member's claim file (one child,
+    one gate). Batch children carry no checkpoint store — a crashed
+    group re-runs from scratch, like a cmd run — so ``resume`` is
+    always False and the watchdog's progress signals are the group
+    heartbeat plus every member's digest chain."""
+
+    def __init__(self, queue: Queue, states: list, python: str = None,
+                 log=None, aot_cache: str = None):
+        assert states, "a batch group needs at least one member"
+        self.queue = queue
+        self.states = list(states)
+        self.specs = [st.spec for st in self.states]
+        self.member_ids = [st.id for st in self.states]
+        self.spec = dict(self.specs[0])
+        # the group's admission weight is the members' sum (they run
+        # concurrently as lanes of one program)
+        self.spec["hosts"] = sum(s.get("hosts", 1) for s in self.specs)
+        self.spec["rss_mb"] = sum(s.get("rss_mb", 0)
+                                  for s in self.specs)
+        self.run_id = self.member_ids[0]
+        self.group = self.specs[0].get("batch")
+        self.attempt = self.states[0].started + 1
+        self.resume = False
+        self.log = log or (lambda m: sys.stderr.write(
+            f"shadow_tpu: fleet: {m}\n"))
+        self.hung = False
+        self.preempting = False
+        self.preempt_killed = False
+        self.crash_log = CrashLog(queue.crash_log_path(self.run_id))
+
+        for rid in self.member_ids:
+            os.makedirs(queue.run_dir(rid), exist_ok=True)
+        argv = build_batch_argv(queue, self.specs, python,
+                                aot_cache=aot_cache)
+        env = dict(os.environ)
+        env.update(self.specs[0].get("env") or {})
+        env["SHADOW_TPU_FLEET_RUN_DIR"] = os.path.abspath(
+            queue.run_dir(self.run_id))
+        if aot_cache:
+            env["SHADOW_TPU_AOT_CACHE"] = os.path.abspath(aot_cache)
+        self._stdout = open(queue.log_path(self.run_id), "ab")
+        self.t0 = time.time()
+        self.last_progress = self.t0
+        try:
+            self.proc = subprocess.Popen(
+                [sys.executable, "-c", _CLAIM_GATE,
+                 os.path.abspath(queue.claim_path(self.run_id))]
+                + argv,
+                stdout=self._stdout, stderr=subprocess.STDOUT,
+                env=env, start_new_session=True)
+        except OSError:
+            self._stdout.close()
+            raise
+        self.argv = argv
+
+    def progress_paths(self) -> list:
+        q = self.queue
+        paths = [os.path.join(q.run_dir(self.run_id), "heartbeat"),
+                 q.log_path(self.run_id)]
+        for rid in self.member_ids:
+            paths.append(q.digest_path(rid))
+        return paths
+
+    def record_exit(self, rc: int, kind: str, cause: str):
+        self.crash_log.append({
+            "attempt": self.attempt, "exit_status": rc,
+            "kind": kind, "cause": cause,
+            "wall_s": round(time.time() - self.t0, 3),
+            "resumed": False,
+            "batch": self.group, "members": self.member_ids,
+            "argv": self.argv[1:],
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        })

@@ -249,4 +249,5 @@ def apply_ops(hosts, hp, sh, ops):
 
 from ..core.jitcache import AotJit  # noqa: E402  (see jitcache docstring)
 
-apply_ops_jit = AotJit(apply_ops, donate_argnums=(0,))
+apply_ops_jit = AotJit(apply_ops, donate_argnums=(0,),
+                       cache_scope="apply_ops")

@@ -75,6 +75,20 @@ ALLOW: dict[str, dict[str, str]] = {
         "shadow_tpu/fleet/worker.py":
             "progress watchdog compares wall mtimes of run artifacts "
             "(hung-run detection IS the product)",
+        # serving/ (in scope since PR 13): host-side compile/serve
+        # orchestration. Wall time here measures COMPILES and paces
+        # child watchdogs, never simulations — cached, pre-warmed and
+        # batched runs are proven byte-identical to cold individual
+        # runs by digest chains (tests/test_serving.py).
+        "shadow_tpu/serving/aotcache.py":
+            "compile/disk-load wall tallies (jitcache.* metrics and "
+            "the compile-hit/miss phase split ARE the product)",
+        "shadow_tpu/serving/prewarm.py":
+            "probe/warm child deadlines are wall-clock watchdogs "
+            "(the fleet worker contract, one level down)",
+        "shadow_tpu/serving/batch.py":
+            "batch wall / first-chunk-wall measurement feeding "
+            "SimReport and the perf ledger (obs-style reporting)",
     },
 }
 

@@ -49,7 +49,7 @@ DET105 = rule(
 # scan scope, repo-relative
 SCOPE = ("shadow_tpu/engine", "shadow_tpu/net", "shadow_tpu/core",
          "shadow_tpu/obs", "shadow_tpu/hosting", "shadow_tpu/fleet",
-         "shadow_tpu/lint")
+         "shadow_tpu/lint", "shadow_tpu/serving")
 
 _WALLCLOCK = {
     "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",

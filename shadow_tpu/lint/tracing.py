@@ -64,7 +64,7 @@ TRC106 = rule(
 
 # report scope (repo-relative); the call graph spans all of shadow_tpu
 SCOPE = ("shadow_tpu/engine", "shadow_tpu/net", "shadow_tpu/parallel",
-         "shadow_tpu/core")
+         "shadow_tpu/core", "shadow_tpu/serving")
 GRAPH_SCOPE = ("shadow_tpu",)
 
 _JIT_WRAPPERS = {

@@ -317,22 +317,14 @@ def _windows_body(hosts, hp, sh, wstart, wend, cfg, lcfg, max_windows,
 _RWS_INSTANCES = {}
 
 
-def run_windows_sharded(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
-                        max_windows: int, mesh: Mesh):
-    """Sharded equivalent of engine.window.run_windows.
-
-    Near-identical contract: returns (hosts, wstart', wend',
-    windows_run, pass_counts) with hosts block-sharded over the
-    mesh's "hosts" axis — except pass_counts is PER-SHARD, shape
-    [n_shards, NR] (each shard's own rung mix; ``pass_counts.sum(0)``
-    is the single-chip total). Shards run the same pass COUNT in
-    lockstep but pick rungs independently, so the per-shard mix is
-    the cross-shard load-imbalance signal the metrics layer publishes
-    (engine.sim -> obs.metrics ``shards`` section). On a
-    MULTI-PROCESS mesh pass_counts stays the replicated [NR] total
-    (sharded counters would be non-addressable). AOT-compiled per
-    (cfg, max_windows, mesh) — see core.jitcache for why.
-    """
+def run_windows_sharded_aot(cfg: EngineConfig, max_windows: int,
+                            mesh: Mesh):
+    """The AotJit wrapping the (cfg, max_windows, mesh) sharded chunk
+    program — shared by run_windows_sharded and the serving layer's
+    pre-warm path. The cache_scope additionally pins the mesh's
+    concrete device ids: the persistent executable cache
+    (serving.aotcache) must never hand a program compiled for one
+    device assignment to another."""
     from ..core.jitcache import AotJit
     from ..engine.window import pass_labels
 
@@ -381,9 +373,34 @@ def run_windows_sharded(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
 
         impl.__name__ = f"run_windows_sharded_v{len(_RWS_INSTANCES)}"
         impl.__qualname__ = impl.__name__
-        fn = AotJit(impl, donate_argnums=(0,))
+        from ..obs.ledger import fingerprint_of
+        devs = "-".join(str(d.id) for d in mesh.devices.flat)
+        fn = AotJit(impl, donate_argnums=(0,),
+                    cache_scope=(f"run_windows_sharded.c{max_windows}"
+                                 f".s{n}.d{devs}"
+                                 f".{fingerprint_of(cfg)}"))
         _RWS_INSTANCES[key] = fn
-    return fn(hosts, hp, sh, wstart, wend)
+    return fn
+
+
+def run_windows_sharded(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
+                        max_windows: int, mesh: Mesh):
+    """Sharded equivalent of engine.window.run_windows.
+
+    Near-identical contract: returns (hosts, wstart', wend',
+    windows_run, pass_counts) with hosts block-sharded over the
+    mesh's "hosts" axis — except pass_counts is PER-SHARD, shape
+    [n_shards, NR] (each shard's own rung mix; ``pass_counts.sum(0)``
+    is the single-chip total). Shards run the same pass COUNT in
+    lockstep but pick rungs independently, so the per-shard mix is
+    the cross-shard load-imbalance signal the metrics layer publishes
+    (engine.sim -> obs.metrics ``shards`` section). On a
+    MULTI-PROCESS mesh pass_counts stays the replicated [NR] total
+    (sharded counters would be non-addressable). AOT-compiled per
+    (cfg, max_windows, mesh) — see core.jitcache for why.
+    """
+    return run_windows_sharded_aot(cfg, max_windows, mesh)(
+        hosts, hp, sh, wstart, wend)
 
 
 def _put_tree(tree, mesh: Mesh, spec):

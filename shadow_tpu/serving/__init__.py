@@ -1,0 +1,31 @@
+"""Serving layer: compile once, execute N times.
+
+The reference simulator amortizes nothing across runs — and this
+port's dominant fixed cost per run is the XLA compile (312 s of a
+714 s cold tor50k wall on CPU; 10-15 min per config shape on chip —
+BASELINE.md). This package is the fleet's answer (ROADMAP item 3),
+three parts:
+
+- :mod:`aotcache` — a persistent disk tier under ``core.jitcache
+  .AotJit``: executables serialized via
+  ``jax.experimental.serialize_executable`` (capability-probed; loud
+  in-memory-only fallback), keyed config-fingerprint x arg-signature
+  x jax/XLA versions x platform x source digest, stored crash-safely
+  (tmp+fsync+os.replace + sha256 sidecars — the PR 5 checkpoint-store
+  pattern). A process-fresh run of a known shape loads in seconds
+  instead of compiling in minutes.
+- :mod:`prewarm` — the fleet scheduler fingerprints each queued run's
+  config shape headlessly, dedups shapes across the sweep, and
+  compiles each distinct shape ONCE in a pre-warm slot before
+  admission, so workers open on a warm cache (``fleet run --prewarm``).
+- :mod:`batch` — same-shape scenarios (identical EngineConfig,
+  differing seed/scalar params) execute as ONE vmapped program over a
+  leading scenario axis: one compile, N cheap executions, while still
+  emitting per-scenario digest chains and ledger entries, proven
+  byte-identical to N individual runs (``fleet submit --batch``,
+  ``python -m shadow_tpu batch``).
+
+Everything here is host-side orchestration: digest chains of cached,
+pre-warmed or batched runs are byte-identical to cold individual runs
+(tests/test_serving.py; docs/serving.md).
+"""

@@ -1,0 +1,864 @@
+"""Serving-layer tests (shadow_tpu/serving/ — PR 13).
+
+Four contracts under test:
+
+- the CACHE KEY (obs.ledger.fingerprint_of x AotJit._sig x
+  jax/platform x source digest) is stable where it must be stable and
+  distinct where it must be distinct — including the PR 13 regression
+  fix for unhashable shardings aliasing two signatures onto one
+  executable, and the structural stale-rejection of version/platform
+  skew;
+- the DISK TIER round-trips executables crash-safely: a fresh AotJit
+  loads instead of compiling, torn/corrupt entries fall back LOUDLY
+  to recompile (never load), retention bounds the directory;
+- the PRE-WARM pipeline probes, dedups and warms shapes without ever
+  wedging admission (failed probes/warms admit; hung children are
+  killed) — driven with jax-free fake children;
+- DETERMINISM is untouched: digest chains are byte-identical for
+  cached-vs-uncached runs and for a vmapped batch of N scenarios vs
+  the same N run individually (tools/divergence.py exit 0 — the
+  ISSUE 13 acceptance proof).
+
+Engine shapes mirror tests/test_digest.py (2-host ping, chunk 8) so
+the compiled window program is shared across the files.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from shadow_tpu.core.jitcache import AotJit
+from shadow_tpu.engine.sim import Simulation
+from shadow_tpu.engine.state import EngineConfig
+from shadow_tpu.fleet.queue import Queue, make_spec
+from shadow_tpu.fleet.worker import build_batch_argv, build_child_argv
+from shadow_tpu.obs.ledger import fingerprint_of
+from shadow_tpu.serving import aotcache as AC
+from shadow_tpu.serving import batch as BT
+from shadow_tpu.serving.prewarm import Prewarmer
+
+from test_digest import CFG, LOSSY_TOPO, ping_scen
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DIVERGENCE = os.path.join(REPO, "tools", "divergence.py")
+
+
+@pytest.fixture(autouse=True)
+def _aot_reset():
+    """The disk tier is process-global (aotcache.ACTIVE, STATS);
+    every test starts with NO cache installed and leaks nothing —
+    including into the other test files of this pytest process."""
+    saved_stats = dict(AC.STATS)
+    saved = (AC.ACTIVE, AC._ENV_CHECKED)
+    AC.uninstall()
+    yield
+    AC.ACTIVE, AC._ENV_CHECKED = saved
+    AC.STATS.clear()
+    AC.STATS.update(saved_stats)
+
+
+def _delta(before, *keys):
+    return {k: AC.STATS[k] - before[k] for k in keys}
+
+
+# ---------------------------------------------------------------------
+# the argument signature (AotJit._sig)
+# ---------------------------------------------------------------------
+
+class FakeSharding:
+    """A sharding whose rich __eq__ made it unhashable (the NamedSharding
+    failure mode the old code degraded on)."""
+    __hash__ = None
+
+    def __init__(self, ids, text):
+        self._ids, self._text = tuple(ids), text
+
+    @property
+    def device_set(self):
+        class Dev:
+            def __init__(self, i):
+                self.id = i
+        return {Dev(i) for i in self._ids}
+
+    def __str__(self):
+        return self._text
+
+    memory_kind = "device"
+
+
+class FakeLeaf:
+    """Array-shaped leaf carrying an arbitrary sharding (jax's
+    shaped_abstractify duck-types shape/dtype/weak_type)."""
+
+    def __init__(self, sharding):
+        self.shape = (4,)
+        self.dtype = jnp.float32.dtype
+        self.weak_type = False
+        self.sharding = sharding
+
+
+def test_unhashable_sharding_keys_distinct():
+    """REGRESSION (ISSUE 13 satellite 1): an unhashable sharding used
+    to degrade to ``sh = None`` in the signature, aliasing two
+    different-sharding signatures onto ONE executable — the exact
+    wrong-buffers failure mode AotJit exists to prevent. The
+    structural key must be distinct per sharding, stable per
+    structure, and never the None degradation."""
+    k_hosts = AotJit._sharding_key(FakeSharding((0, 1), "P('hosts')"))
+    k_repl = AotJit._sharding_key(FakeSharding((0, 1), "P(None)"))
+    k_dev = AotJit._sharding_key(FakeSharding((2, 3), "P('hosts')"))
+    assert k_hosts is not None and k_repl is not None
+    assert k_hosts != k_repl            # same devices, different layout
+    assert k_hosts != k_dev             # same layout, different devices
+    # stable: an equal-structure sharding keys identically
+    assert k_hosts == AotJit._sharding_key(
+        FakeSharding((0, 1), "P('hosts')"))
+    # and hashable None stays None (plain host arrays)
+    assert AotJit._sharding_key(None) is None
+
+
+def test_sig_distinguishes_unhashable_shardings():
+    """End to end through _sig: two pytrees differing ONLY in an
+    unhashable sharding must produce different (and hashable —
+    they're dict keys) signatures."""
+    sig_a = AotJit._sig((FakeLeaf(FakeSharding((0,), "P('hosts')")),))
+    sig_b = AotJit._sig((FakeLeaf(FakeSharding((0,), "P(None)")),))
+    assert sig_a != sig_b
+    assert {sig_a: 1, sig_b: 2}[sig_a] == 1
+    # identical structure -> identical signature (the memo must HIT)
+    assert sig_a == AotJit._sig(
+        (FakeLeaf(FakeSharding((0,), "P('hosts')")),))
+
+
+# ---------------------------------------------------------------------
+# the config fingerprint as a cache key (obs.ledger.fingerprint_of)
+# ---------------------------------------------------------------------
+
+def test_fingerprint_stable_across_field_order():
+    a = fingerprint_of({"qcap": 16, "scap": 4}, seed=7, stop_ns=10)
+    b = fingerprint_of({"scap": 4, "qcap": 16}, stop_ns=10, seed=7)
+    assert a == b
+    assert len(a) == 16 and int(a, 16) >= 0
+
+
+def _perturb(v):
+    if isinstance(v, bool):
+        return not v
+    if isinstance(v, int):
+        return v + 1
+    if isinstance(v, tuple):
+        return v + (99,)
+    if v is None:
+        return (0, 99)
+    return f"{v}-perturbed"
+
+
+def test_fingerprint_distinguishes_every_engineconfig_field():
+    """EVERY EngineConfig field changes compiled code (shapes, pruned
+    branches, pass structure) — so every field must change the
+    fingerprint, including the PR 12 knobs the issue names."""
+    names = {f.name for f in dataclasses.fields(EngineConfig)}
+    assert {"hot_split", "event_batch"} <= names
+    cfg = EngineConfig(num_hosts=2, **CFG)
+    base = fingerprint_of(cfg)
+    for f in dataclasses.fields(EngineConfig):
+        changed = dataclasses.replace(
+            cfg, **{f.name: _perturb(getattr(cfg, f.name))})
+        assert fingerprint_of(changed) != base, (
+            f"EngineConfig.{f.name} does not reach the cache key — a "
+            "stale executable for a different config could load")
+
+
+def test_entry_key_components(tmp_path, monkeypatch):
+    """Stale rejection is STRUCTURAL: a different scope, argument
+    signature, jax/XLA version, platform or source digest computes a
+    different entry key, so the stale executable is unreachable —
+    never loaded-and-wrong."""
+    sig = AotJit._sig((jnp.arange(4),))
+    base = AC.entry_key("run_windows.c8.aabb", sig)
+    assert base != AC.entry_key("run_windows.c16.aabb", sig)
+    assert base != AC.entry_key(
+        "run_windows.c8.aabb", AotJit._sig((jnp.arange(5),)))
+
+    real = AC.platform_key()
+    monkeypatch.setattr(
+        AC, "platform_key", lambda: {**real, "jax": "999.0.0"})
+    skewed_jax = AC.entry_key("run_windows.c8.aabb", sig)
+    assert skewed_jax != base
+    monkeypatch.setattr(
+        AC, "platform_key", lambda: {**real, "n_devices": 1 + real["n_devices"]})
+    assert AC.entry_key("run_windows.c8.aabb", sig) not in (base,
+                                                            skewed_jax)
+    monkeypatch.setattr(AC, "platform_key", lambda: real)
+    assert AC.entry_key("run_windows.c8.aabb", sig) == base
+
+    monkeypatch.setattr(AC, "_SOURCE_DIGEST", "feedfacefeedface")
+    assert AC.entry_key("run_windows.c8.aabb", sig) != base
+
+
+# ---------------------------------------------------------------------
+# the disk tier (round-trip, corruption, skew, retention)
+# ---------------------------------------------------------------------
+
+def _supports_serialization():
+    return AC.serialize_support()
+
+
+def test_disk_roundtrip_fresh_aotjit_loads(tmp_path):
+    """A fresh AotJit (fresh process stand-in: empty memory tier) of
+    a known scope+signature must LOAD from disk, not recompile — and
+    compute the same values."""
+    if not _supports_serialization():
+        pytest.skip("backend cannot serialize executables")
+    AC.install(str(tmp_path / "cache"))
+
+    def f(x):
+        return x * 2 + 1
+
+    before = dict(AC.STATS)
+    a1 = AotJit(f, cache_scope="test.roundtrip.v1")
+    y1 = a1(jnp.arange(4))
+    d = _delta(before, "compiles", "disk_stores", "disk_hits")
+    assert d == {"compiles": 1, "disk_stores": 1, "disk_hits": 0}
+
+    before = dict(AC.STATS)
+    a2 = AotJit(f, cache_scope="test.roundtrip.v1")
+    y2 = a2(jnp.arange(4))
+    d = _delta(before, "compiles", "disk_hits")
+    assert d == {"compiles": 0, "disk_hits": 1}
+    assert jnp.array_equal(y1, y2)
+    # sidecars published with the payload (the PR 5 store shape)
+    cache = AC.active()
+    keys = cache.entries()
+    assert len(keys) == 1
+    assert os.path.exists(cache.exec_path(keys[0]) + ".sha256")
+    meta = json.load(open(cache.meta_path(keys[0])))
+    assert meta["scope"] == "test.roundtrip.v1"
+    assert meta["platform"]["jax"] == AC.platform_key()["jax"]
+
+
+def test_no_scope_stays_memory_only(tmp_path):
+    """Programs without a stable identity (cache_scope=None) never
+    touch the disk tier, even with a cache installed."""
+    AC.install(str(tmp_path / "cache"))
+    before = dict(AC.STATS)
+    a = AotJit(lambda x: x - 3)
+    a(jnp.arange(4))
+    d = _delta(before, "compiles", "disk_stores", "disk_hits",
+               "disk_misses")
+    assert d == {"compiles": 1, "disk_stores": 0, "disk_hits": 0,
+                 "disk_misses": 0}
+    assert AC.active().entries() == []
+
+
+def test_corrupt_entry_falls_back_to_recompile(tmp_path):
+    """EVERY corrupt shape — flipped payload bytes, missing hash
+    sidecar — is a loud miss that recompiles and DROPS the entry;
+    a torn entry can never load."""
+    if not _supports_serialization():
+        pytest.skip("backend cannot serialize executables")
+    AC.install(str(tmp_path / "cache"))
+    cache = AC.active()
+
+    def f(x):
+        return x + 7
+
+    AotJit(f, cache_scope="test.corrupt.v1")(jnp.arange(4))
+    [key] = cache.entries()
+
+    # bit rot: flip one payload byte behind the published hash
+    p = cache.exec_path(key)
+    blob = bytearray(open(p, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(p, "wb").write(bytes(blob))
+    before = dict(AC.STATS)
+    y = AotJit(f, cache_scope="test.corrupt.v1")(jnp.arange(4))
+    d = _delta(before, "compiles", "disk_hits", "rejected")
+    assert d == {"compiles": 1, "disk_hits": 0, "rejected": 1}
+    assert jnp.array_equal(y, jnp.arange(4) + 7)
+
+    # torn write: payload visible without its hash sidecar
+    [key] = cache.entries()
+    os.unlink(cache.exec_path(key) + ".sha256")
+    before = dict(AC.STATS)
+    AotJit(f, cache_scope="test.corrupt.v1")(jnp.arange(4))
+    d = _delta(before, "compiles", "disk_hits", "rejected")
+    assert d == {"compiles": 1, "disk_hits": 0, "rejected": 1}
+
+
+def test_version_skew_never_loads_stale_entry(tmp_path, monkeypatch):
+    """An entry stored by a 'different jax' must MISS (its key is
+    unreachable), recompile, and leave the alien entry untouched —
+    the version/platform components of the key are the stale-
+    executable gate the issue requires."""
+    if not _supports_serialization():
+        pytest.skip("backend cannot serialize executables")
+    AC.install(str(tmp_path / "cache"))
+    cache = AC.active()
+
+    def f(x):
+        return x * 5
+
+    AotJit(f, cache_scope="test.skew.v1")(jnp.arange(4))
+    [stale_key] = cache.entries()
+
+    real = AC.platform_key()
+    monkeypatch.setattr(
+        AC, "platform_key", lambda: {**real, "jax": "999.0.0",
+                                     "xla": "other-xla"})
+    before = dict(AC.STATS)
+    AotJit(f, cache_scope="test.skew.v1")(jnp.arange(4))
+    d = _delta(before, "compiles", "disk_hits", "disk_misses")
+    assert d["compiles"] == 1 and d["disk_hits"] == 0
+    assert d["disk_misses"] == 1
+    assert cache.has(stale_key)     # not loaded, not clobbered
+
+
+def test_retention_prunes_oldest(tmp_path):
+    if not _supports_serialization():
+        pytest.skip("backend cannot serialize executables")
+    AC.install(str(tmp_path / "cache"), keep=2)
+    cache = AC.active()
+    import jax
+    compiled = jax.jit(lambda x: x + 1).lower(jnp.arange(4)).compile()
+    for i, key in enumerate(["aa" * 16, "bb" * 16, "cc" * 16]):
+        cache.store(key, compiled, meta={"n": i})
+        t = time.time() - 100 + i
+        os.utime(cache.exec_path(key), (t, t))
+        cache._retain()
+    assert cache.entries() == ["bb" * 16, "cc" * 16]
+    assert not os.path.exists(cache.meta_path("aa" * 16))
+
+
+def test_cached_programs_run_donation_free(tmp_path):
+    """REGRESSION: a donated program resolved through the disk tier
+    must compile/store/execute its donation-free twin. A serialize
+    round trip of a DONATED executable is unsound on the XLA:CPU
+    client — the loaded executable's outputs alias the donated input
+    buffers, whose memory the runtime frees; once the allocator
+    reuses the block the results silently corrupt (reproduced as
+    event-queue digest divergence on warm runs). Observable contract:
+    with a cache active the donated input SURVIVES the call (the
+    undonated twin ran); without one, donation applies untouched."""
+    def f(x):
+        return x * 2
+
+    x = jnp.arange(1024)
+    y = AotJit(f, cache_scope="test.donate.v1",
+               donate_argnums=(0,))(x)
+    assert x.is_deleted(), (
+        "donation should apply on the no-cache path (if this backend "
+        "ignores donation the regression below is vacuous)")
+
+    if not _supports_serialization():
+        pytest.skip("backend cannot serialize executables — the "
+                    "disk tier (and with it the undonated swap) "
+                    "stays off, donation untouched")
+    AC.install(str(tmp_path / "cache"))
+    x2 = jnp.arange(1024)
+    y2 = AotJit(f, cache_scope="test.donate.v1",
+                donate_argnums=(0,))(x2)
+    assert not x2.is_deleted(), (
+        "a cache-scoped donated program executed its DONATED build "
+        "through the disk tier — the use-after-free hazard is back")
+    assert jnp.array_equal(y2, jnp.asarray(y))
+    if _supports_serialization():
+        cache = AC.active()
+        [key] = cache.entries()
+        assert json.load(open(cache.meta_path(key)))["donated"] is False
+
+
+def test_store_is_first_writer_wins(tmp_path):
+    """Racing same-key stores (fleet children finishing the same
+    compile together) must serialize: a held lock skips the store, a
+    stale lock (dead writer) is broken, and a complete entry is never
+    overwritten — interleaved sidecar/payload writes from two
+    processes would read as corruption and get DELETED."""
+    if not _supports_serialization():
+        pytest.skip("backend cannot serialize executables")
+    AC.install(str(tmp_path / "cache"))
+    cache = AC.active()
+    import jax
+    compiled = jax.jit(lambda x: x + 1).lower(jnp.arange(4)).compile()
+
+    key = "ab" * 16
+    lock = cache.exec_path(key) + ".lock"
+    os.makedirs(cache.root, exist_ok=True)
+    open(lock, "w").close()                       # a LIVE writer
+    assert cache.store(key, compiled) is None
+    assert not cache.has(key)
+
+    os.utime(lock, (1, 1))                        # a DEAD writer
+    assert cache.store(key, compiled) is not None
+    assert cache.has(key) and not os.path.exists(lock)
+
+    before = dict(AC.STATS)
+    assert cache.store(key, compiled) is None     # already published
+    assert _delta(before, "disk_stores")["disk_stores"] == 0
+
+
+def test_env_var_activates_cache(tmp_path, monkeypatch):
+    """Fleet children enable the tier via SHADOW_TPU_AOT_CACHE, no
+    CLI plumbing (serving.aotcache.active)."""
+    monkeypatch.setenv("SHADOW_TPU_AOT_CACHE", str(tmp_path / "envc"))
+    AC.ACTIVE, AC._ENV_CHECKED = None, False
+    cache = AC.active()
+    assert cache is not None and cache.root == str(tmp_path / "envc")
+
+
+# ---------------------------------------------------------------------
+# the pre-warm pipeline (jax-free fake children)
+# ---------------------------------------------------------------------
+
+def _fake_probe(python, spec):
+    """Prints the fingerprint encoded in the spec's config path
+    ('name~FINGERPRINT'), like the real --shape-fingerprint child."""
+    fp = spec["config"].split("~")[-1]
+    return [sys.executable, "-c",
+            "import json; print(json.dumps("
+            f"{{'shape_fingerprint': {fp!r}}}))"]
+
+
+def _drive(pw, timeout_s=30.0):
+    t0 = time.monotonic()
+    while not pw.done():
+        pw.tick()
+        if time.monotonic() - t0 > timeout_s:
+            pw.shutdown()
+            raise AssertionError("prewarm pipeline did not drain")
+        time.sleep(0.02)
+    pw.tick()
+
+
+def test_probe_and_warm_argv_mirror_worker_chunk():
+    """The probe/warm children must see the digest flags a worker
+    attempt runs with — the cadence sets the compiled chunk, so
+    probing without them would fingerprint (and warm) the WRONG
+    program."""
+    from shadow_tpu.serving.prewarm import probe_argv, warm_argv
+
+    spec = {"id": "r1", "config": "/tmp/a.xml", "args": ["--seed", "3"],
+            "digest": True, "digest_every": 8}
+    p = " ".join(probe_argv(None, spec))
+    assert p.endswith("--shape-fingerprint")
+    assert "--digest " in p and "--digest-every 8" in p
+    assert "--seed 3" in p
+    w = " ".join(warm_argv(None, spec, "/tmp/cache"))
+    assert "--prewarm" in w and "--aot-cache" in w
+    assert "--digest-every 8" in w
+    nodigest = dict(spec, digest=False)
+    assert "--digest" not in " ".join(probe_argv(None, nodigest))
+
+
+def test_prewarmer_dedups_shapes_and_gates(tmp_path):
+    """3 runs, 2 shapes: every run gates until its shape warms, and
+    each DISTINCT shape warms exactly once."""
+    marks = tmp_path / "warms"
+    marks.mkdir()
+
+    def warm_fn(python, spec, cache_dir):
+        fp = spec["config"].split("~")[-1]
+        return [sys.executable, "-c",
+                f"open({str(marks / fp)!r}, 'a').write('x')"]
+
+    specs = [{"id": "r1", "config": "a~shapeX"},
+             {"id": "r2", "config": "b~shapeX"},
+             {"id": "r3", "config": "c~shapeY"},
+             {"id": "cmd1", "config": None, "cmd": ["true"]}]
+    records = []
+    pw = Prewarmer(specs, str(tmp_path / "cache"), jobs=2,
+                   log=lambda m: None,
+                   journal=lambda **kw: records.append(kw),
+                   probe_fn=_fake_probe, warm_fn=warm_fn)
+    assert pw.ready("cmd1")            # cmd runs never gate
+    assert not pw.ready("r1") and not pw.ready("r3")
+    _drive(pw)
+    assert pw.ready("r1") and pw.ready("r2") and pw.ready("r3")
+    # dedup: one warm child per DISTINCT shape
+    assert sorted(os.listdir(marks)) == ["shapeX", "shapeY"]
+    warmed = [r for r in records if r.get("state") == "warmed"]
+    assert {r["shape"] for r in warmed} == {"shapeX", "shapeY"}
+    resolved = [r for r in records if r.get("state") == "resolved"]
+    assert {r["run"] for r in resolved} == {"r1", "r2", "r3"}
+    assert pw.counts() == {"warmed": 2, "failed": 0, "warming": 0,
+                           "probing": 0}
+
+
+def test_prewarmer_failures_never_wedge_admission(tmp_path):
+    """A failed probe or a failed warm admits the run anyway (it pays
+    its own compile) — pre-warm is an optimization, never a gate that
+    can starve the queue."""
+    def bad_probe(python, spec):
+        return [sys.executable, "-c", "raise SystemExit(3)"]
+
+    pw = Prewarmer([{"id": "r1", "config": "a~x"}],
+                   str(tmp_path / "c"), log=lambda m: None,
+                   probe_fn=bad_probe, warm_fn=_fake_probe)
+    _drive(pw)
+    assert pw.ready("r1")
+
+    def bad_warm(python, spec, cache_dir):
+        return [sys.executable, "-c", "raise SystemExit(2)"]
+
+    records = []
+    pw = Prewarmer([{"id": "r2", "config": "b~shapeZ"}],
+                   str(tmp_path / "c"), log=lambda m: None,
+                   journal=lambda **kw: records.append(kw),
+                   probe_fn=_fake_probe, warm_fn=bad_warm)
+    _drive(pw)
+    assert pw.ready("r2")
+    assert [r["state"] for r in records
+            if r["shape"] == "shapeZ"][-1] == "failed"
+
+
+def test_prewarmer_children_get_spec_env(tmp_path):
+    """Probe/warm children run under the run's --env overrides (the
+    worker attempt applies them) — a probe under the scheduler's own
+    environment could fingerprint a different backend's program."""
+    def env_probe(python, spec):
+        return [sys.executable, "-c",
+                "import os, json; print(json.dumps("
+                "{'shape_fingerprint': "
+                "os.environ.get('SHADOW_TPU_TEST_MARK', 'MISSING')}))"]
+
+    marks = []
+
+    def warm_fn(python, spec, cache_dir):
+        return [sys.executable, "-c", "pass"]
+
+    pw = Prewarmer(
+        [{"id": "r1", "config": "a.xml",
+          "env": {"SHADOW_TPU_TEST_MARK": "from-spec"}}],
+        str(tmp_path / "c"), log=lambda m: None,
+        journal=lambda **kw: marks.append(kw),
+        probe_fn=env_probe, warm_fn=warm_fn)
+    _drive(pw)
+    assert pw._shape_of["r1"] == "from-spec"
+
+
+def test_batch_cli_refuses_duplicate_seeds(tmp_path, capsys):
+    """Duplicate seeds would name two lanes (and their digest
+    chains) identically — interleaving one chain file."""
+    xml = tmp_path / "s.xml"
+    xml.write_text("<shadow stoptime='1'/>")
+    with pytest.raises(SystemExit):
+        BT.main([str(xml), "--seeds", "3,3"])
+    assert "duplicates" in capsys.readouterr().err
+
+
+def test_prewarmer_kills_hung_probe(tmp_path):
+    """A hung probe child is SIGKILLed past its deadline and counted
+    failed — the scheduler-watchdog contract one level down."""
+    def hung_probe(python, spec):
+        return [sys.executable, "-c", "import time; time.sleep(600)"]
+
+    pw = Prewarmer([{"id": "r1", "config": "a~x"}],
+                   str(tmp_path / "c"), log=lambda m: None,
+                   probe_fn=hung_probe, warm_fn=_fake_probe,
+                   probe_timeout_s=0.2)
+    _drive(pw, timeout_s=30.0)
+    assert pw.ready("r1")
+
+
+# ---------------------------------------------------------------------
+# fleet wiring: batch specs, argv builders, shape journal fold
+# ---------------------------------------------------------------------
+
+def test_make_spec_batch_is_config_only():
+    with pytest.raises(ValueError, match="config runs"):
+        make_spec("x", cmd=["true"], batch="grp")
+    spec = make_spec("x-s7", config="a.xml", batch="grp", batch_seed=7)
+    assert spec["batch"] == "grp" and spec["batch_seed"] == 7
+
+
+def test_build_batch_argv_forms(tmp_path):
+    q = Queue(str(tmp_path / "q")).ensure()
+    # one XML x N seeds
+    specs = [make_spec(f"g-s{s}", config="/tmp/a.xml", batch="g",
+                       batch_seed=s, digest_every=8, perf="")
+             for s in (1, 2)]
+    argv = build_batch_argv(q, specs, aot_cache=str(tmp_path / "c"))
+    s = " ".join(argv)
+    assert " batch " in s and "--seeds 1,2" in s
+    assert s.count("a.xml") == 1
+    assert "--digest-paths" in s
+    assert os.path.abspath(q.digest_path("g-s1")) in s
+    assert "--digest-every 8" in s and "--perf" in s
+    assert "--aot-cache" in s
+    # one XML per member
+    specs = [make_spec("m1", config="/tmp/a.xml", batch="g"),
+             make_spec("m2", config="/tmp/b.xml", batch="g")]
+    argv = build_batch_argv(q, specs)
+    s = " ".join(argv)
+    assert "a.xml" in s and "b.xml" in s and "--seeds" not in s
+    # single runs get the cache as an explicit flag too
+    spec = make_spec("solo", config="/tmp/a.xml")
+    argv = build_child_argv(q, spec, resume=False,
+                            aot_cache=str(tmp_path / "c"))
+    assert "--aot-cache" in argv
+
+
+def test_build_batch_argv_refuses_malformed_groups(tmp_path):
+    """Backstop for the submit-time gate: a group mixing seeded and
+    unseeded members, or seeded members resolving DIFFERENT XMLs,
+    must refuse to spawn (OSError -> per-member spawn failure) —
+    never silently drop seeds or run the wrong config."""
+    q = Queue(str(tmp_path / "q")).ensure()
+    xa, xb = tmp_path / "a.xml", tmp_path / "b.xml"
+    xa.write_text("<shadow stoptime='1'/>")
+    xb.write_text("<shadow stoptime='2'/>")
+    mixed = [make_spec("m1", config=str(xa), batch="g", batch_seed=1),
+             make_spec("m2", config=str(xa), batch="g")]
+    with pytest.raises(OSError, match="mixes seeded"):
+        build_batch_argv(q, mixed)
+    divergent = [
+        make_spec("d1", config=str(xa), batch="g", batch_seed=1),
+        make_spec("d2", config=str(xb), batch="g", batch_seed=2)]
+    with pytest.raises(OSError, match="ONE config"):
+        build_batch_argv(q, divergent)
+    # same CONTENT under different paths (the queue's per-member
+    # copies) is the valid seeded form
+    xc = tmp_path / "c.xml"
+    xc.write_text(xa.read_text())
+    ok = [make_spec("k1", config=str(xa), batch="g", batch_seed=1),
+          make_spec("k2", config=str(xc), batch="g", batch_seed=2)]
+    assert "--seeds" in " ".join(build_batch_argv(q, ok))
+
+
+def test_submit_refuses_inconsistent_batch_group(tmp_path):
+    """The submit-time gate: a later submission cannot change an
+    existing group's form (seeded vs per-XML) or, in the seeded form,
+    its one XML."""
+    from shadow_tpu.fleet.cli import main as fleet_main
+
+    qdir = str(tmp_path / "q")
+    xa, xb = tmp_path / "a.xml", tmp_path / "b.xml"
+    xa.write_text('<shadow stoptime="6"><host id="h1"/></shadow>')
+    xb.write_text('<shadow stoptime="9"><host id="h1"/></shadow>')
+    assert fleet_main(["submit", qdir, str(xa), "--batch", "g",
+                       "--seeds", "1,2"]) == 0
+    with pytest.raises(SystemExit):        # form change: unseeded
+        fleet_main(["submit", qdir, str(xa), "--batch", "g",
+                    "--id", "late"])
+    with pytest.raises(SystemExit):        # different XML content
+        fleet_main(["submit", qdir, str(xb), "--batch", "g",
+                    "--id", "late2", "--seeds", "3"])
+    with pytest.raises(SystemExit):        # per-member knob drift
+        fleet_main(["submit", qdir, str(xa), "--batch", "g",
+                    "--id", "late3", "--seeds", "4", "--perf"])
+    # same form + same content + same knobs extends the group
+    assert fleet_main(["submit", qdir, str(xa), "--batch", "g",
+                       "--id", "more", "--seeds", "3"]) == 0
+    # per-XML form: a colliding config BASENAME would only fail at
+    # run time (the batch child names outputs by stem) — refused here
+    qdir2 = str(tmp_path / "q2")
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    xa2 = sub / "a.xml"                    # same stem, other content
+    xa2.write_text('<shadow stoptime="7"><host id="h1"/></shadow>')
+    assert fleet_main(["submit", qdir2, str(xa),
+                       "--batch", "h"]) == 0
+    with pytest.raises(SystemExit):
+        fleet_main(["submit", qdir2, str(xa2), "--batch", "h",
+                    "--id", "dup"])
+
+
+def test_queue_prewarm_fold(tmp_path):
+    """Shape records fold separately from run states: fleet status
+    reports shapes warmed vs pending, and fold() never mistakes a
+    prewarm record for a run transition."""
+    q = Queue(str(tmp_path / "q")).ensure()
+    q.submit(make_spec("r1", cmd=["true"]))
+    q.append("prewarm", shape="fpA", state="resolved", run="r1")
+    q.append("prewarm", shape="fpB", state="resolved", run="r2")
+    q.append("prewarm", shape="fpA", state="warming", run="r1")
+    q.append("prewarm", shape="fpA", state="warmed")
+    pw = q.prewarm_fold()
+    assert pw["shapes"] == {"fpA": "warmed", "fpB": "pending"}
+    assert pw["runs"] == {"r1": "fpA", "r2": "fpB"}
+    states = q.fold()
+    assert set(states) == {"r1"} and states["r1"].state == "queued"
+
+
+# ---------------------------------------------------------------------
+# determinism proofs (the ISSUE 13 acceptance criteria)
+# ---------------------------------------------------------------------
+
+def _run_individual(path, scen, every=8):
+    sim = Simulation(scen, engine_cfg=EngineConfig(num_hosts=2, **CFG))
+    rep = sim.run(digest=str(path), digest_every=every)
+    return str(path), rep
+
+
+def _divergence_rc(a, b):
+    return subprocess.run(
+        [sys.executable, DIVERGENCE, str(a), str(b)],
+        capture_output=True, text=True).returncode
+
+
+def test_batch_chains_byte_identical_to_individual(tmp_path):
+    """THE batching determinism proof: a vmapped batch of N scenarios
+    emits N digest chains byte-identical to the same N scenarios run
+    individually (and per-lane summaries match), while genuinely
+    different lanes stay different."""
+    seeds = (7, 8)
+    indiv = {}
+    for seed in seeds:
+        indiv[seed] = _run_individual(
+            tmp_path / f"ind-{seed}.jsonl",
+            ping_scen(seed=seed, topo=LOSSY_TOPO))
+
+    sims = [Simulation(ping_scen(seed=s, topo=LOSSY_TOPO),
+                       engine_cfg=EngineConfig(num_hosts=2, **CFG))
+            for s in seeds]
+    paths = [str(tmp_path / f"bat-{s}.jsonl") for s in seeds]
+    reports = BT.run_batch(sims, names=[f"s{s}" for s in seeds],
+                           digest_paths=paths, digest_every=8)
+
+    for seed, bpath, rep in zip(seeds, paths, reports):
+        ipath, irep = indiv[seed]
+        assert open(bpath, "rb").read() == open(ipath, "rb").read(), (
+            f"seed {seed}: batch lane chain differs from its "
+            "individual run")
+        assert _divergence_rc(bpath, ipath) == 0
+        assert rep.summary()["events"] == irep.summary()["events"]
+        assert rep.windows == irep.windows
+    # the lanes are real per-scenario chains, not copies of lane 0
+    assert (open(paths[0], "rb").read()
+            != open(paths[1], "rb").read())
+
+
+def test_batch_refuses_mixed_shapes():
+    a = Simulation(ping_scen(seed=1),
+                   engine_cfg=EngineConfig(num_hosts=2, **CFG))
+    b = Simulation(ping_scen(seed=2),
+                   engine_cfg=EngineConfig(num_hosts=2, **{
+                       **CFG, "qcap": 32}))
+    with pytest.raises(BT.BatchShapeError, match="EngineConfig"):
+        BT.check_same_shape([a, b])
+
+
+def test_cached_chains_byte_identical_to_uncached(tmp_path):
+    """THE cache determinism proof: the same scenario run (a) with no
+    cache, (b) cold through the cache (compile + store), (c) fresh
+    AotJit loading from disk, yields byte-identical digest chains —
+    the executable the disk hands back IS the program that was
+    compiled."""
+    from shadow_tpu.engine import window as W
+
+    saved = dict(W._RW_INSTANCES)
+    try:
+        a, _ = _run_individual(tmp_path / "a.jsonl",
+                               ping_scen(seed=7, topo=LOSSY_TOPO))
+
+        AC.install(str(tmp_path / "cache"))
+        W._RW_INSTANCES.clear()         # fresh-process stand-in
+        before = dict(AC.STATS)
+        b, _ = _run_individual(tmp_path / "b.jsonl",
+                               ping_scen(seed=7, topo=LOSSY_TOPO))
+        if _supports_serialization():
+            assert _delta(before, "disk_stores")["disk_stores"] >= 1
+
+        W._RW_INSTANCES.clear()
+        before = dict(AC.STATS)
+        c, _ = _run_individual(tmp_path / "c.jsonl",
+                               ping_scen(seed=7, topo=LOSSY_TOPO))
+        if _supports_serialization():
+            d = _delta(before, "compiles", "disk_hits")
+            assert d["compiles"] == 0 and d["disk_hits"] >= 1, (
+                "the warm run recompiled instead of disk-loading")
+
+        ab = open(a, "rb").read()
+        assert ab == open(b, "rb").read()
+        assert ab == open(c, "rb").read()
+        assert _divergence_rc(a, c) == 0
+    finally:
+        W._RW_INSTANCES.clear()
+        W._RW_INSTANCES.update(saved)
+
+
+# ---------------------------------------------------------------------
+# process-fresh CLI round trip (slow: subprocess jax imports)
+# ---------------------------------------------------------------------
+
+def _cli_env():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("SHADOW_TPU_AOT_CACHE", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    return env
+
+
+def _cli(args, timeout=900):
+    return subprocess.run(
+        [sys.executable, "-m", "shadow_tpu"] + args,
+        capture_output=True, text=True, timeout=timeout,
+        env=_cli_env(), cwd=REPO)
+
+
+def _last_json(out):
+    """The probe/prewarm JSON line (logger lines surround it — the
+    same scan the real Prewarmer does on its probe children)."""
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict):
+            return rec
+    raise AssertionError(f"no JSON line in stdout:\n{out.stdout}")
+
+
+@pytest.mark.slow
+def test_cli_process_fresh_warm_roundtrip(tmp_path):
+    """The acceptance shape end to end, across real process
+    boundaries: probe the shape fingerprint (no compile), pre-warm
+    cold (compile_cache=miss), pre-warm again process-fresh
+    (compile_cache=hit), then two full runs through the cache whose
+    digest chains are byte-identical."""
+    if not _supports_serialization():
+        pytest.skip("backend cannot serialize executables")
+    xml = tmp_path / "ping.xml"
+    xml.write_text(ping_scen(seed=7, topo=LOSSY_TOPO).to_xml())
+    cache = str(tmp_path / "cache")
+    caps = "qcap=16,scap=4,obcap=8,incap=16,chunk=8"
+    base = [str(xml), "--seed", "7", "--engine-caps", caps,
+            "--digest-every", "8"]
+
+    out = _cli(base + ["--shape-fingerprint"])
+    assert out.returncode == 0, out.stderr
+    probe = _last_json(out)
+    assert int(probe["shape_fingerprint"], 16) >= 0
+    # the dedup key is chunk- and mesh-qualified: same config
+    # fingerprint at a different cadence or worker count is a
+    # different compiled program
+    assert probe["shape"] == f"c8.w0.{probe['shape_fingerprint']}"
+
+    d1, d2 = str(tmp_path / "d1.jsonl"), str(tmp_path / "d2.jsonl")
+    out = _cli(base + ["--aot-cache", cache, "--prewarm",
+                       "--digest", d1])
+    assert out.returncode == 0, out.stderr
+    cold = _last_json(out)
+    assert cold["compile_cache"] == "miss"
+    assert cold["fingerprint"] == probe["shape_fingerprint"]
+
+    out = _cli(base + ["--aot-cache", cache, "--prewarm",
+                       "--digest", d1])
+    assert out.returncode == 0, out.stderr
+    warm = _last_json(out)
+    assert warm["compile_cache"] == "hit", (
+        "a process-fresh pre-warm of a cached shape recompiled")
+
+    for d in (d1, d2):
+        out = _cli(base + ["--aot-cache", cache, "--digest", d])
+        assert out.returncode == 0, out.stderr
+    assert open(d1, "rb").read() == open(d2, "rb").read()
+    assert _divergence_rc(d1, d2) == 0
+    assert any(n.endswith(".exec") for n in os.listdir(cache))

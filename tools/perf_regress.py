@@ -29,7 +29,12 @@ Policy (docs/performance.md):
   99.9% compile), so it is reported but never gated — and never
   counted into another candidate's history median. The throughput
   trajectory for such shapes comes from bench.py's warm-split
-  entries.
+  entries. Since the serving layer's executable cache (PR 13,
+  docs/serving.md) the phase map distinguishes compile-miss (a real
+  XLA build) from compile-hit (a persistent-cache load): only the
+  MISS wall argues for the exemption, so a cache-hit run of a
+  formerly compile-bound shape becomes a gateable trajectory point
+  instead of permanently reported-not-gated.
 
 Pure stdlib + the ledger module loaded by file path (no jax import:
 this gate must run headless in the verify skill on any box).
@@ -70,7 +75,19 @@ def compile_bound(e) -> bool:
     if e.get("warm_events_per_sec"):
         return False  # the warm rate already excludes the compile
     wall = e.get("wall_seconds") or 0.0
-    comp = (e.get("phases") or {}).get("compile", 0.0)
+    phases = e.get("phases") or {}
+    # Since the serving layer's executable cache (PR 13), the phase
+    # map splits the old monolithic "compile" into compile-miss (a
+    # real XLA build) vs compile-hit (a persistent-cache load,
+    # obs.perf PHASE_OF). A run that opened warm from the disk cache
+    # is NOT compile-bound — its rate is real throughput and it
+    # gates — so when the split is present only the MISS wall argues
+    # for the exemption. Entries predating the split keep the
+    # monolithic reading.
+    if "compile-miss" in phases or "compile-hit" in phases:
+        comp = phases.get("compile-miss", 0.0)
+    else:
+        comp = phases.get("compile", 0.0)
     return bool(wall) and comp / wall > COMPILE_BOUND
 
 
