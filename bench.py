@@ -248,6 +248,13 @@ def _emit(metric, summary, baseline, baseline_cfg, baseline_c=None,
         # goes, auditable per line
         "passes_per_window": round(cost.get("passes_per_window", 0), 2),
         "roofline_frac": round(cost.get("roofline_frac", 0), 4),
+        # memory observatory (obs.memscope): the rep's device-buffer
+        # watermark (allocator peak on device backends, process RSS on
+        # CPU — mem_source says which) and the per-host state census,
+        # so the matrix carries a byte trajectory next to the rate one
+        "mem_peak_bytes": summary.get("mem_peak_bytes"),
+        "mem_source": summary.get("mem_source"),
+        "state_bytes_per_host": summary.get("state_bytes_per_host"),
         "baseline": ({"engine": "pyengine (pure-Python reference "
                       "engine; C reference unbuildable here — see "
                       "BASELINE.md)",

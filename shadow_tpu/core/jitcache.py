@@ -38,6 +38,10 @@ class AotJit:
         # (closed-over config, chunk size...) — by convention it
         # carries obs.ledger.fingerprint_of(cfg). None = memory only.
         self.cache_scope = cache_scope
+        # latest obs.memscope analysis of an executable built through
+        # this wrapper (flops / bytes accessed / arg+temp bytes), or
+        # None before the first build
+        self.analysis = None
 
     def undonated_jit(self):
         """The donation-free twin of this program, or None when there
@@ -115,9 +119,22 @@ class AotJit:
 
     def _build(self, key, args):
         from ..serving import aotcache
-        return aotcache.load_or_compile(self._jit, self.cache_scope,
-                                        key, args,
-                                        undonated=self.undonated_jit)
+        fn = aotcache.load_or_compile(self._jit, self.cache_scope,
+                                      key, args,
+                                      undonated=self.undonated_jit)
+        # memory observatory hook (obs.memscope): record the XLA
+        # cost_analysis (flops, bytes accessed) and memory_analysis
+        # (argument/output/temp/generated-code bytes) of every
+        # executable this wrapper materializes — compile or disk-load.
+        # Graceful on executables that refuse either analysis (loaded
+        # disk entries, exotic backends): `available: False` with the
+        # error recorded, never a failed build. The latest analysis is
+        # also kept on the instance so callers holding the AotJit
+        # (engine.sim's cost model) read it without knowing the scope.
+        from ..obs import memscope
+        self.analysis = memscope.observe_executable(
+            self.cache_scope or getattr(self._fn, "__name__", "?"), fn)
+        return fn
 
 
 def aot_jit(fn=None, **jit_kwargs):

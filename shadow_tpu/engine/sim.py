@@ -80,6 +80,13 @@ class SimReport:
     capacity: dict = field(default_factory=dict)
     cost: dict = field(default_factory=dict)  # cost_model() inputs:
     #   pass mix per compaction rung, per-row state bytes, warm wall
+    memory: dict = field(default_factory=dict)  # memory observatory
+    #   record (obs.memscope): device-buffer watermark (peak_bytes /
+    #   source / per_device), the state byte census totals
+    #   (state_bytes, state_bytes_per_host, hot_state_bytes) and the
+    #   window program's captured XLA analysis under "xla" (flops,
+    #   bytes_accessed, argument/temp/output bytes — None entries
+    #   where the backend refused)
     hosted: dict = field(default_factory=dict)  # hosted-process exit
     #   report: host name -> {"exit_status", "cause", "sim_ns"} from
     #   the shim supervisor (hosting.runtime.exit_info) — the per-host
@@ -174,13 +181,29 @@ class SimReport:
             est_total += pb * int(n)
         warm = self.cost.get("warm_wall")
         wall = warm if warm else self.wall_seconds
-        peak = self.cost.get("hbm_peak_gbps", 819.0)
+        from ..obs.memscope import hbm_peak_gbps
+        peak = self.cost.get("hbm_peak_gbps") or hbm_peak_gbps()
         # sharded pass counters sum every chip's passes (shards move
         # their pass bytes CONCURRENTLY), so the per-chip bandwidth —
         # the number comparable to one chip's HBM peak — divides the
         # aggregate by the shard count
-        gbps = est_total / shards / wall / 1e9 if wall else 0.0
-        return {
+        gbps_modeled = est_total / shards / wall / 1e9 if wall else 0.0
+        # MEASURED traffic (obs.memscope, PR 15): XLA's own
+        # bytes-accessed for the compiled chunk program x chunk calls
+        # replaces the hand model when the backend provides it —
+        # modeled and measured report side by side, and the headline
+        # roofline_frac prefers the measured figure. Like the modeled
+        # path, the sharded program's analysis covers all shards'
+        # concurrent traffic, so the per-chip figure divides by the
+        # shard count.
+        xla = (self.memory or {}).get("xla") or {}
+        chunks = self.cost.get("chunks")
+        meas_total = (xla["bytes_accessed"] * chunks
+                      if xla.get("bytes_accessed") and chunks else None)
+        gbps_meas = (meas_total / shards / wall / 1e9
+                     if meas_total and wall else None)
+        gbps = gbps_meas if gbps_meas is not None else gbps_modeled
+        out = {
             "row_bytes": rb,
             "hot_columns": self.cost.get("hot_columns"),
             "batch": B,
@@ -193,12 +216,27 @@ class SimReport:
             "est_total_gb": est_total / 1e9,
             "wall_seconds_used": wall,
             # False = single-chunk run: the wall INCLUDES the cold
-            # compile and achieved_gbps_est understates accordingly
+            # compile and the gbps figures understate accordingly
             "warm": warm is not None,
+            # modeled vs measured, side by side; achieved_gbps_est
+            # keeps its name for trajectory readers and carries the
+            # best available figure (measured when the backend
+            # provides bytes-accessed, modeled otherwise — `measured`
+            # says which)
             "achieved_gbps_est": gbps,
+            "modeled_gbps": gbps_modeled,
+            "measured": gbps_meas is not None,
             "hbm_peak_gbps": peak,
             "roofline_frac": gbps / peak if peak else 0.0,
+            "roofline_frac_modeled": (gbps_modeled / peak
+                                      if peak else 0.0),
         }
+        if meas_total is not None:
+            out["measured_total_gb"] = meas_total / 1e9
+            out["measured_gbps"] = gbps_meas
+            out["roofline_frac_measured"] = (gbps_meas / peak
+                                             if peak else 0.0)
+        return out
 
     def summary(self) -> dict:
         """The run's headline figures. When the metrics registry is
@@ -232,6 +270,16 @@ class SimReport:
             "transfers_aborted": self.total(defs.ST_TGEN_ABORT),
             "mean_rtt_us": mean_rtt_us,
         }
+        # memory observatory figures (obs.memscope): the run's
+        # device-buffer watermark and per-host state bytes — the
+        # fields bench lines and perf-ledger entries carry
+        # (mem_peak_bytes is what tools/perf_regress.py's memory gate
+        # compares)
+        if self.memory:
+            s["mem_peak_bytes"] = int(self.memory.get("peak_bytes", 0))
+            s["mem_source"] = self.memory.get("source")
+            s["state_bytes_per_host"] = int(
+                self.memory.get("state_bytes_per_host", 0))
         # robustness figures appear only when the features were used —
         # keeps the BENCH-diffable section stable for plain runs
         if self.faults:
@@ -996,6 +1044,19 @@ class Simulation:
             * getattr(hosts, f).dtype.itemsize
             for f in _hot)
 
+        # memory observatory (obs.memscope): per-chunk device-buffer
+        # high-water sampling — real device memory stats where the
+        # backend provides them (per device, so a mesh run's
+        # per_device list IS the per-shard watermark), RSS fallback on
+        # CPU. Host-side reads only, so a memscope-enabled run's
+        # digest chain is byte-identical to a plain run's.
+        from ..obs import memscope as MS
+        if mesh is None:
+            wm = MS.Watermark()
+        else:
+            from ..parallel.shard import mesh_local_devices
+            wm = MS.Watermark(mesh_local_devices(mesh))
+
         if multiproc:
             # eager reductions cannot run on non-addressable global
             # arrays; a jitted min yields a replicated (addressable)
@@ -1136,6 +1197,9 @@ class Simulation:
         wall0 = _time.perf_counter()
         first_chunk_wall = None
         chunk_i = 0
+        n_chunks = 0     # unconditional (chunk_i only counts with obs
+        #   on): the cost model scales the window program's measured
+        #   bytes-accessed by how many times the chunk executed
         # jitted once, called per chunk (multiproc pcap ring reset)
         _zeros_like = jax.jit(jnp.zeros_like)
         # per-chunk events total as a jitted reduction: a replicated
@@ -1207,6 +1271,8 @@ class Simulation:
                     shard_pass_acc += pc_np
             else:
                 pass_acc += pc_np
+            n_chunks += 1
+            wm.sample()
             if first_chunk_wall is None:
                 # everything after this excludes the cold compile
                 first_chunk_wall = _time.perf_counter() - wall0
@@ -1275,7 +1341,8 @@ class Simulation:
                     dist.gather_stats(hosts.stats)[:H],
                     socks=None if multiproc else socket_columns(hosts),
                     hosted_rss=(self.hosting.child_rss()
-                                if self.hosting is not None else None))
+                                if self.hosting is not None else None),
+                    dev_peak=wm.peak_bytes)
                 if TR.ENABLED:
                     TR.TRACER.complete("tracker.heartbeat", _t0)
             if checkpoint_path and ckpt_at is not None and ws >= ckpt_at:
@@ -1404,7 +1471,6 @@ class Simulation:
             ("nic_txq", cfg.txqcap, int(peaks[3])),
         ]}
         sim_ns = min(stop_ns, ws) if ws < SIMTIME_MAX else stop_ns
-        import os as _os
         warm = (wall - first_chunk_wall
                 if first_chunk_wall is not None and
                 wall > first_chunk_wall * 1.05 else None)
@@ -1418,14 +1484,42 @@ class Simulation:
             "shards": (1 if mesh is None else
                        cfg.num_hosts // per_chip_h),
             "warm_wall": warm,
-            "hbm_peak_gbps": float(_os.environ.get(
-                "SHADOW_TPU_HBM_GBPS", "819")),
+            "chunks": n_chunks,
+            # the one HBM-peak definition (obs.memscope — honors
+            # SHADOW_TPU_HBM_GBPS); cost_model falls back to the same
+            # function, so the env value reaches both ends
+            "hbm_peak_gbps": MS.hbm_peak_gbps(),
         }
+        # memory observatory record (obs.memscope): the final
+        # watermark, the state byte census at the as-run shapes, and
+        # the window program's captured XLA cost/memory analysis —
+        # what SimReport.memory / summary() / the ledger's
+        # mem_peak_bytes field and cost_model()'s measured traffic all
+        # read
+        wm.sample()
+        wm_snap = wm.snapshot()
+        census = MS.state_census(cfg, hosts=hosts, hp=hp, sh=sh)
+        if mesh is None:
+            from .window import run_windows_aot
+            xla = run_windows_aot(cfg, chunk).analysis
+        else:
+            from ..parallel.shard import run_windows_sharded_aot
+            xla = run_windows_sharded_aot(cfg, chunk, mesh).analysis
+        memrec = dict(wm_snap)
+        memrec["state_bytes"] = census["bytes"]
+        memrec["state_bytes_per_host"] = census["per_host"]
+        memrec["hot_state_bytes"] = \
+            census["hosts"]["hot"]["runtime_bytes"]
+        memrec["cold_state_bytes"] = \
+            census["hosts"]["hot"]["runtime_cold_bytes"]
+        memrec["sections"] = census["hosts"]["sections"]
+        memrec["xla"] = xla
         report = SimReport(stats=stats, host_names=self.host_names,
                            sim_time_ns=sim_ns, wall_seconds=wall,
                            windows=total_windows,
                            heartbeats=(tracker.lines if tracker else []),
                            capacity=capacity, cost=cost,
+                           memory=memrec,
                            hosted=(self.hosting.exit_info()
                                    if self.hosting is not None else {}),
                            faults=(inj.log if inj is not None else []))
@@ -1434,6 +1528,10 @@ class Simulation:
         if MT.ENABLED:
             MT.REGISTRY.gauge("engine.first_chunk_wall_s").set(
                 first_chunk_wall or 0.0)
+            # memory observatory gauges -> the metrics.json `memory`
+            # section (watermark + census + captured XLA analysis)
+            MS.publish(MT.REGISTRY, watermark=wm_snap, census=census,
+                       xla=xla)
             if shard_pass_acc is not None and shard_pass_acc.any():
                 # per-shard pass totals + rung mix: which shard went
                 # dense while its peers rode the small rungs — the

@@ -330,6 +330,23 @@ def hot_fields(cfg: "EngineConfig") -> tuple:
     return tuple(f for f in HOT_FIELDS if f not in off)
 
 
+def shape_census(cfg: "EngineConfig") -> dict:
+    """{field: (shape, dtype_name)} of every Hosts column at this
+    config, via ``jax.eval_shape`` over the real :func:`alloc_hosts` —
+    zero allocation, exact by construction. This is the ground truth
+    the memory observatory's stdlib dims table
+    (obs.memscope.HOSTS_DIMS — the jax-free byte census behind
+    tools/state_matrix's bytes column and the capacity planner) is
+    pinned against in tests/test_memscope.py: an alloc_hosts edit that
+    forgets the table fails that pin by field name."""
+    import jax
+
+    sd = jax.eval_shape(lambda: alloc_hosts(cfg))
+    return {f: (tuple(int(d) for d in getattr(sd, f).shape),
+                str(getattr(sd, f).dtype))
+            for f in sd.__dataclass_fields__}
+
+
 def row_proto(cfg: "EngineConfig") -> "Hosts":
     """One host ROW of alloc_hosts defaults (no leading H axis) — the
     prototype the drain rebuilds its vmapped rows around: hot columns

@@ -53,6 +53,17 @@ def _split_rest(argv: list) -> tuple:
     return list(argv), []
 
 
+def _rss_weight(args, hosts: int) -> int:
+    """The admission RSS weight (MiB) of one member: an explicit
+    --rss-mb always wins; otherwise --mem-bytes-per-host (measured
+    per-host state bytes from the memscope census) x the member's
+    host count, rounded up — so admission bounds concurrent footprint
+    by what a run MEASURES, not by a static host-count proxy."""
+    if args.rss_mb or not args.mem_bytes_per_host:
+        return args.rss_mb
+    return -(-hosts * args.mem_bytes_per_host // (1 << 20))
+
+
 def _auto_id(queue, stem: str) -> str:
     taken = set(queue.fold()) if queue.exists() else set()
     if stem not in taken:
@@ -85,6 +96,15 @@ def main(argv=None) -> int:
                          "XML; 1 for --cmd)")
     ps.add_argument("--rss-mb", type=int, default=0,
                     help="declared peak RSS for admission control")
+    ps.add_argument("--mem-bytes-per-host", type=int, default=0,
+                    metavar="BYTES",
+                    help="measured per-host state bytes (the memscope "
+                         "census — tools/capacity_plan.py or a "
+                         "--perf run's state_bytes_per_host): the "
+                         "admission RSS weight becomes hosts x this, "
+                         "so the scheduler bounds concurrent runs by "
+                         "MEASURED footprint instead of raw host "
+                         "counts. Explicit --rss-mb wins")
     ps.add_argument("--max-retries", type=int, default=3,
                     help="crashes before quarantine (default 3)")
     ps.add_argument("--checkpoint-every", type=float, default=10.0,
@@ -205,7 +225,8 @@ def main(argv=None) -> int:
                 rest = [args.config] + rest
             rid = args.id or _auto_id(q, "cmd")
             spec = make_spec(rid, cmd=rest, env=env,
-                             hosts=args.hosts or 1, rss_mb=args.rss_mb,
+                             hosts=args.hosts or 1,
+                             rss_mb=_rss_weight(args, args.hosts or 1),
                              max_retries=args.max_retries)
         else:
             if not args.config:
@@ -322,12 +343,13 @@ def main(argv=None) -> int:
                                 "settings are group-wide "
                                 "(docs/serving.md)")
                 rids = []
+                n_hosts = args.hosts or _count_hosts(args.config)
                 for seed in seeds:
                     mid = rid if seed is None else f"{rid}-s{seed}"
                     spec = make_spec(
                         mid, config=args.config, env=env,
-                        hosts=args.hosts or _count_hosts(args.config),
-                        rss_mb=args.rss_mb,
+                        hosts=n_hosts,
+                        rss_mb=_rss_weight(args, n_hosts),
                         max_retries=args.max_retries,
                         digest=not args.no_digest,
                         digest_every=args.digest_every,
@@ -341,10 +363,12 @@ def main(argv=None) -> int:
                 print(f"submitted {' '.join(rids)} -> {args.queue} "
                       f"(batch group {args.batch})")
                 return 0
+            n_hosts = args.hosts or _count_hosts(args.config)
             spec = make_spec(
                 rid, config=args.config, args=rest, env=env,
-                hosts=args.hosts or _count_hosts(args.config),
-                rss_mb=args.rss_mb, max_retries=args.max_retries,
+                hosts=n_hosts,
+                rss_mb=_rss_weight(args, n_hosts),
+                max_retries=args.max_retries,
                 checkpoint_every=args.checkpoint_every,
                 digest=not args.no_digest,
                 digest_every=args.digest_every, perf=args.perf)

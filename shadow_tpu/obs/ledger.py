@@ -138,6 +138,19 @@ def make_entry(scenario: str, fingerprint: str, platform: str,
         "events_per_sec": round(summary.get("events_per_sec", 0.0), 1),
         "warm_events_per_sec": warm_eps,
     }
+    # memory observatory fields (obs.memscope, docs/observability.md):
+    # the run's device-buffer watermark and per-host state bytes —
+    # mem_peak_bytes is what tools/perf_regress.py's memory gate
+    # compares against the trajectory's own history (a run whose peak
+    # GROWS past the band is a regression like a rate drop is).
+    # Present only when the run carried the observatory record, so
+    # pre-PR-15 trajectories stay untouched.
+    if summary.get("mem_peak_bytes"):
+        e["mem_peak_bytes"] = int(summary["mem_peak_bytes"])
+        if summary.get("mem_source"):
+            e["mem_source"] = summary["mem_source"]
+    if summary.get("state_bytes_per_host"):
+        e["state_bytes_per_host"] = int(summary["state_bytes_per_host"])
     if rep_rates:
         e["rep_rates"] = list(rep_rates)
     if rep_spread is not None:

@@ -191,6 +191,18 @@ class Registry:
              if k.startswith("shard.")})
         perf = {k[len("perf."):]: v for k, v in gauges.items()
                 if k.startswith("perf.")}
+        # memory view (obs.memscope): device-buffer watermark +
+        # per-host state census + the captured XLA cost/memory
+        # analysis of the compiled programs — assembled like the perf
+        # section, with the per-device peaks folded into a list (the
+        # per-shard watermark of a mesh run)
+        memory = _assemble_indexed(
+            {k[len("mem."):]: v for k, v in gauges.items()
+             if k.startswith("mem.")})
+        xla_cost = {k[len("cost."):]: v for k, v in gauges.items()
+                    if k.startswith("cost.")}
+        if xla_cost:
+            memory["cost"] = xla_cost
         # fleet view (shadow_tpu.fleet scheduler): queue depth by
         # state plus lifetime start/retry/preempt/watchdog counters —
         # the sweep-health section of a ``fleet run --metrics`` file
@@ -209,6 +221,8 @@ class Registry:
             out["shards"] = shards
         if perf:
             out["perf"] = perf
+        if memory:
+            out["memory"] = memory
         if fleet:
             out["fleet"] = fleet
         return out

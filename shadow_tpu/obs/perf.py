@@ -53,6 +53,11 @@ PHASE_OF = {
     # compile-miss when present: a cache-hit run is gateable.
     "jitcache.compile": "compile-miss",
     "jitcache.load": "compile-hit",
+    # memory observatory (obs.memscope, PR 15): the per-executable
+    # XLA cost/memory-analysis capture that AotJit runs right after
+    # materializing a program. Kept OUT of compile-miss on purpose —
+    # analysis wall is observatory overhead, not the XLA build.
+    "memscope.analyze": "memscope",
 }
 
 RESIDUAL = "unattributed (host loop glue)"

@@ -73,7 +73,8 @@ class Tracker:
 
     def maybe_heartbeat(self, sim_ns: int, stats: np.ndarray,
                         socks: dict | None = None,
-                        hosted_rss: dict | None = None):
+                        hosted_rss: dict | None = None,
+                        dev_peak: int | None = None):
         """Called after each window chunk with current cumulative stats;
         emits one heartbeat covering all interval boundaries elapsed
         since the last call (see module docstring on sampling).
@@ -88,6 +89,14 @@ class Tracker:
         the [ram] line as a trailing ``rss=`` column — real process
         memory next to the modeled buffer bytes, the reference's
         tracker-RSS role (shd-tracker.c:266).
+
+        dev_peak: optional device-buffer high-water bytes
+        (obs.memscope.Watermark — the allocator peak on device
+        backends, process RSS on CPU). Rides every [ram] line as a
+        trailing ``dev=`` column: the REAL buffer watermark beside
+        the modeled per-host bytes. Process/device-global, so the
+        value repeats per line by design (the [ram] family is the
+        per-host view; consumers take any one).
         """
         if self.interval <= 0 or sim_ns < self.next_ns:
             return
@@ -124,16 +133,24 @@ class Tracker:
                     f"{d[i, defs.ST_PKTS_DROP_BUF]},"
                     f"{d[i, defs.ST_XFER_DONE]}")
         if socks is not None:
-            self._heartbeat_sockets(t, span_s, socks, hosted_rss)
+            self._heartbeat_sockets(t, span_s, socks, hosted_rss,
+                                    dev_peak)
 
         ru = resource.getrusage(resource.RUSAGE_SELF)
         tot = d.sum(axis=0)
+        # dev-peak-gib: the device-buffer watermark (obs.memscope) on
+        # every summary heartbeat — scenarios whose hosts buffer
+        # nothing (no [ram] lines) still report the measured high
+        # water this way
+        dev = (f"dev-peak-gib={dev_peak / (1 << 30):.3f},"
+               if dev_peak else "")
         self._emit(
             f"[shadow-heartbeat] [summary] {t},"
             f"interval={span_s},"
             f"events={tot[defs.ST_EVENTS]},"
             f"pkts={tot[defs.ST_PKTS_SENT]}/{tot[defs.ST_PKTS_RECV]},"
             f"bytes={tot[defs.ST_BYTES_SENT]}/{tot[defs.ST_BYTES_RECV]},"
+            f"{dev}"
             f"maxrss-gib={ru.ru_maxrss / (1 << 20):.3f},"
             f"utime-min={ru.ru_utime / 60:.3f},"
             f"stime-min={ru.ru_stime / 60:.3f}")
@@ -148,7 +165,8 @@ class Tracker:
         self.next_ns += self.interval
 
     def _heartbeat_sockets(self, t: int, span_s: str, socks: dict,
-                           hosted_rss: dict | None = None):
+                           hosted_rss: dict | None = None,
+                           dev_peak: int | None = None):
         used = socks["sk_used"]
         proto = socks["sk_proto"]
         is_tcp = proto == 6
@@ -195,8 +213,12 @@ class Tracker:
                 dealloc = max(-int(ram_delta[i]), 0)
                 # trailing rss= column: the hosted child's REAL
                 # resident set beside the modeled buffer bytes (only
-                # hosts running a live hosted process carry it)
+                # hosts running a live hosted process carry it); dev=
+                # is the device-buffer watermark (obs.memscope) — the
+                # measured high-water mark beside the modeled bytes
                 suffix = f",rss={int(rss)}" if rss is not None else ""
+                if dev_peak:
+                    suffix += f",dev={int(dev_peak)}"
                 self._emit(
                     f"[shadow-heartbeat] [ram] {t},{name},"
                     f"{alloc},{dealloc},{int(ram_total[i])},"

@@ -72,6 +72,16 @@ def make_mesh(n_devices: int = None) -> Mesh:
     return Mesh(devs, (AXIS,))
 
 
+def mesh_local_devices(mesh: Mesh) -> list:
+    """This process's devices of the mesh, in host-axis order — the
+    shard-index order the memory observatory's per-shard watermarks
+    (obs.memscope.Watermark.per_device) report in: index i of the
+    watermark list is the device holding host block i among the local
+    shards."""
+    local = {d.id for d in jax.local_devices()}
+    return [d for d in mesh.devices.flat if d.id in local]
+
+
 def exchange_sharded(hosts, hp, sh, cfg: EngineConfig,
                      lcfg: EngineConfig):
     """Window-boundary packet exchange, one shard's view.

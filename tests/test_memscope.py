@@ -1,0 +1,537 @@
+"""Memory-observatory tests (obs.memscope + tools/capacity_plan.py):
+
+- census EXACTNESS: the stdlib dims table == eval_shape over the real
+  alloc_hosts == live array bytes, field by field, plus hand-computed
+  spot checks — the pin that keeps the jax-free byte table honest;
+- hot/cold rollup parity with the HOT_FIELDS/COLD_WHEN declaration;
+- the unified HBM-peak constant: a custom SHADOW_TPU_HBM_GBPS reaches
+  both the run's cost bookkeeping and the cost_model report;
+- compiled-program capture: cost/memory analysis on CPU, graceful
+  absence on refusing executables;
+- the run-wired record: SimReport.memory, summary/ledger fields, the
+  tracker's dev watermark column, the metrics.json `memory` section;
+- the perf_regress MEMORY gate: flat history exit 0, synthetic peak
+  regression exit 1, pre-memscope history untouched;
+- the capacity planner: plan() arithmetic on synthetic measurements
+  and predict-vs-measure within tolerance on a real run;
+- observation-does-not-perturb-digest for a fully-observed run.
+
+The run-based tests share one tiny phold shape so the process pays
+one window-program compile (the AotJit memoizes per (cfg, chunk)).
+Like test_perf, this file sorts past the compile-bound tier-1 horizon
+on the CPU container; the pure-unit tests up top cost milliseconds.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+import jax  # noqa: E402
+
+from shadow_tpu.engine.state import (COLD_FIELDS, HOT_FIELDS,  # noqa: E402
+                                     EngineConfig, Hosts, alloc_hosts,
+                                     hot_fields, shape_census)
+from shadow_tpu.obs import ledger as LG  # noqa: E402
+from shadow_tpu.obs import memscope as MS  # noqa: E402
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+SMALL = dict(qcap=8, scap=4, obcap=8, incap=8, txqcap=4)
+
+
+# --- census exactness -------------------------------------------------------
+
+def test_census_exactness_small_config():
+    """The stdlib dims table == eval_shape over the real alloc_hosts
+    == live array bytes, for EVERY field — plus hand-computed spot
+    checks, so a wrong table AND a wrong alloc cannot cancel out."""
+    cfg = EngineConfig(num_hosts=4, **SMALL)
+    sc = shape_census(cfg)
+    assert set(sc) == set(Hosts.__dataclass_fields__)
+    table = MS.table_row_bytes(cfg)
+    np_bytes = {"int64": 8, "int32": 4, "uint32": 4, "float32": 4,
+                "bool": 1}
+    for f, (shape, dt) in sc.items():
+        n = np_bytes[dt]
+        for d in shape:
+            n *= d
+        assert table[f] == n // 4, \
+            f"{f}: stdlib table {table[f]} != eval_shape {n // 4}"
+    # live arrays agree (the census's hosts= path)
+    hosts = alloc_hosts(cfg)
+    census = MS.state_census(cfg, hosts=hosts)
+    live = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+               for a in jax.tree.leaves(hosts))
+    assert census["hosts"]["bytes"] == live
+    # cfg-only path (eval_shape) computes the same totals
+    census2 = MS.state_census(cfg)
+    assert census2["hosts"]["bytes"] == census["hosts"]["bytes"]
+    # hand-computed spot checks: eq_time [4, 8] i64, eq_pkt
+    # [4, 8, 13] i32, sk_ooo_s [4, 4, 4] i64, stats [4, 24] i64
+    fl = census["hosts"]["fields"]
+    assert fl["eq_time"]["bytes"] == 4 * 8 * 8
+    assert fl["eq_pkt"]["bytes"] == 4 * 8 * 13 * 4
+    assert fl["sk_ooo_s"]["bytes"] == 4 * 4 * 4 * 8
+    assert fl["stats"]["bytes"] == 4 * 24 * 8
+    assert fl["eq_time"]["section"] == "event_queue"
+    # HostParams table matches the real thing too (via a built sim in
+    # the run tests; here the dims): hid i32 -> 4 B/host
+    assert MS.table_row_bytes(cfg, MS.HP_DIMS)["hid"] == 4
+    assert MS.table_row_bytes(cfg, MS.HP_DIMS)["app_cfg"] == 8 * 8
+
+
+def test_census_constants_match_modules():
+    """The stdlib table's literal constants mirror their owning
+    modules — the drift pin the module docstring promises."""
+    from shadow_tpu.engine.defs import N_STATS
+    from shadow_tpu.net.packet import PKT_WORDS
+    from shadow_tpu.net.sack import K
+    assert MS.PKT_WORDS == PKT_WORDS
+    assert MS.SACK_K == K
+    assert MS.N_STATS == N_STATS
+
+
+def test_census_hot_cold_rollup_parity():
+    """The census's hot/cold rollup is EXACTLY the HOT_FIELDS /
+    COLD_FIELDS partition, and the runtime rollup follows
+    hot_fields(cfg) — so the split's HBM saving is the number the
+    declaration implies, not an independent re-derivation."""
+    cfg = EngineConfig(num_hosts=8, **SMALL)
+    c = MS.state_census(cfg)
+    fl = c["hosts"]["fields"]
+    hot_b = sum(v["bytes"] for f, v in fl.items() if f in HOT_FIELDS)
+    cold_b = sum(v["bytes"] for f, v in fl.items() if f in COLD_FIELDS)
+    assert c["hosts"]["hot"]["static_bytes"] == hot_b
+    assert c["hosts"]["hot"]["static_cold_bytes"] == cold_b
+    assert hot_b + cold_b == c["hosts"]["bytes"]
+    rt = set(hot_fields(cfg))
+    rt_b = sum(v["bytes"] for f, v in fl.items() if f in rt)
+    assert c["hosts"]["hot"]["runtime_bytes"] == rt_b
+    assert c["hosts"]["hot"]["runtime_columns"] == len(rt)
+    # a no-TCP config's runtime working set is much smaller: the
+    # level-2 split's saving as bytes
+    import dataclasses
+    udp = dataclasses.replace(cfg, uses_tcp=False, app_kinds=(0,))
+    cu = MS.state_census(udp)
+    assert (cu["hosts"]["hot"]["runtime_bytes"]
+            < c["hosts"]["hot"]["runtime_bytes"])
+    # sections rollup covers every byte exactly once
+    assert sum(c["hosts"]["sections"].values()) == c["hosts"]["bytes"]
+
+
+def test_shared_per_host_classification_by_name():
+    """The Shared fixed-vs-per-host split is classified by the
+    DECLARED names, pinned against the live tree: exactly the
+    [H]-replicated tables scale, and each really has leading dim H —
+    a shape[0]==H coincidence (e.g. an [H,H] oracle of a
+    one-vertex-per-host topology) must never reclassify the fixed
+    tables as linear."""
+    from shadow_tpu.engine.sim import Simulation
+    from test_phold import phold_scenario
+
+    sim = Simulation(phold_scenario(n=4, stop=1),
+                     engine_cfg=EngineConfig(num_hosts=4, **SMALL))
+    c = MS.state_census(sim.cfg, hosts=sim.hosts, hp=sim.hp,
+                        sh=sim.sh)
+    scaling = {f for f, v in sorted(c["shared"]["fields"].items())
+               if v["scales_with_h"]}
+    assert scaling == set(MS.SHARED_PER_HOST_FIELDS)
+    for f in MS.SHARED_PER_HOST_FIELDS:
+        assert getattr(sim.sh, f).shape[0] == 4, \
+            f"declared per-host Shared field {f} is not [H]"
+    # the oracle tables stay fixed cost
+    assert not c["shared"]["fields"]["lat_ns"]["scales_with_h"]
+
+
+# --- HBM peak unification ---------------------------------------------------
+
+def test_hbm_peak_env(monkeypatch):
+    monkeypatch.delenv("SHADOW_TPU_HBM_GBPS", raising=False)
+    assert MS.hbm_peak_gbps() == MS.DEFAULT_HBM_GBPS
+    monkeypatch.setenv("SHADOW_TPU_HBM_GBPS", "500")
+    assert MS.hbm_peak_gbps() == 500.0
+    monkeypatch.setenv("SHADOW_TPU_HBM_GBPS", "not-a-number")
+    assert MS.hbm_peak_gbps() == MS.DEFAULT_HBM_GBPS
+
+
+def test_hbm_peak_reaches_cost_model_and_report(monkeypatch):
+    """Satellite: a custom SHADOW_TPU_HBM_GBPS reaches BOTH the run's
+    pass-cost bookkeeping (cost dict) and the cost_model report — the
+    two sites that used to carry their own 819."""
+    from shadow_tpu.engine.sim import SimReport, Simulation
+    from test_phold import phold_scenario
+
+    monkeypatch.setenv("SHADOW_TPU_HBM_GBPS", "500")
+    report = Simulation(phold_scenario(n=16, stop=5)).run()
+    assert report.cost["hbm_peak_gbps"] == 500.0
+    cm = report.cost_model()
+    assert cm["hbm_peak_gbps"] == 500.0
+    # the roofline fraction divides by the custom peak
+    assert cm["roofline_frac"] == pytest.approx(
+        cm["achieved_gbps_est"] / 500.0)
+    # the fallback path (a cost dict that predates the key) reads the
+    # same definition
+    r2 = SimReport(stats=report.stats, host_names=report.host_names,
+                   sim_time_ns=report.sim_time_ns, wall_seconds=1.0,
+                   windows=report.windows,
+                   cost={k: v for k, v in report.cost.items()
+                         if k != "hbm_peak_gbps"})
+    assert r2.cost_model()["hbm_peak_gbps"] == 500.0
+
+
+# --- compiled-program capture ----------------------------------------------
+
+def test_capture_smoke_cpu():
+    """CPU provides both analyses in this build: flops/bytes-accessed
+    and argument/output/temp bytes all land."""
+    import jax.numpy as jnp
+    comp = jax.jit(lambda x: x * 2 + 1).lower(
+        jnp.zeros((8, 8), jnp.float32)).compile()
+    a = MS.observe_executable("smoke", comp)
+    assert a["available"]
+    assert a["bytes_accessed"] and a["bytes_accessed"] > 0
+    assert a["argument_bytes"] == 8 * 8 * 4
+    assert a["output_bytes"] == 8 * 8 * 4
+    assert MS.program_footprint(a) is not None
+    assert MS.CAPTURED["smoke"] is a
+
+
+def test_capture_graceful_absence():
+    """Backends/executables that refuse either analysis record the
+    error and carry None — never an exception (the contract for TPU
+    variants and disk-loaded executables)."""
+
+    class Refuses:
+        def cost_analysis(self):
+            raise NotImplementedError("no cost analysis on this "
+                                      "backend")
+
+        def memory_analysis(self):
+            return None
+
+    a = MS.observe_executable("refuses", Refuses())
+    assert not a["available"]
+    assert a["flops"] is None and a["argument_bytes"] is None
+    assert "cost_analysis" in a["errors"]
+    assert "memory_analysis" in a["errors"]
+    assert MS.program_footprint(a) is None
+    assert MS.observe_executable("none", None)["available"] is False
+
+
+# --- watermark --------------------------------------------------------------
+
+def test_watermark_rss_fallback():
+    wm = MS.Watermark()
+    p1 = wm.sample()
+    assert p1 > 0
+    # monotone peak
+    big = np.ones(1 << 22, np.int64)  # ~32 MB
+    p2 = wm.sample()
+    assert p2 >= p1
+    del big
+    snap = wm.snapshot()
+    assert snap["peak_bytes"] == p2
+    assert snap["source"] in ("rss", "device")
+    assert snap["samples"] >= 2
+    assert snap["lifetime_peak_bytes"] >= snap["peak_bytes"] or \
+        snap["source"] == "device"
+    if snap["source"] == "rss":
+        assert snap["per_device"] is None
+
+
+def test_watermark_is_per_run_not_process_lifetime():
+    """The gated peak is the RUN's high water, not the process's: a
+    watermark created after a large allocation died must not inherit
+    its peak (ru_maxrss would — bench.py's 4-config matrix runs in
+    one process, and a small scenario after a large one would record
+    the large one's bytes as its own and poison the memory gate)."""
+    big = np.ones(1 << 25, np.int64)  # ~256 MB, mmap-backed
+    big[::4096] = 2                   # fault the pages in
+    lifetime_with_big = MS.rss_bytes()
+    del big
+    # current RSS dropped well below the lifetime peak once the block
+    # was unmapped...
+    assert MS.current_rss_bytes() < lifetime_with_big - (1 << 27)
+    # ...and a fresh watermark reports the CURRENT level, not the
+    # lifetime one
+    wm = MS.Watermark()
+    wm.sample()
+    snap = wm.snapshot()
+    if snap["source"] == "rss":
+        assert snap["peak_bytes"] < lifetime_with_big - (1 << 27)
+        assert snap["lifetime_peak_bytes"] >= lifetime_with_big
+
+
+# --- the run-wired record ---------------------------------------------------
+
+def test_run_memory_record(tmp_path):
+    """A real run carries the full observatory record: watermark,
+    census totals, captured XLA analysis (argument bytes == census +
+    the two window scalars), summary/ledger fields, the tracker's dev
+    watermark, and the metrics.json memory section."""
+    from shadow_tpu.engine.sim import Simulation
+    from shadow_tpu.obs import metrics as MT
+    from test_phold import phold_scenario
+
+    mpath = str(tmp_path / "metrics.json")
+    sim = Simulation(phold_scenario(n=16, stop=5))
+    report = sim.run(heartbeat_s=1.0, metrics=mpath)
+    mem = report.memory
+    assert mem["peak_bytes"] > 0
+    assert mem["source"] in ("rss", "device")
+    assert mem["state_bytes"] > 0
+    assert 0 < mem["hot_state_bytes"] <= mem["state_bytes"]
+    census = MS.state_census(sim.cfg, hosts=sim.final_hosts,
+                             hp=sim.hp, sh=sim.sh)
+    assert mem["state_bytes"] == census["bytes"]
+    assert mem["state_bytes_per_host"] == census["per_host"]
+    xla = mem["xla"]
+    if xla["argument_bytes"] is not None:  # CPU provides it here
+        assert xla["argument_bytes"] == census["bytes"] + 16
+        cm = report.cost_model()
+        assert cm["measured"]
+        assert cm["roofline_frac"] == pytest.approx(
+            cm["roofline_frac_measured"])
+        assert "roofline_frac_modeled" in cm
+    # summary carries the ledger fields
+    s = report.summary()
+    assert s["mem_peak_bytes"] == mem["peak_bytes"]
+    assert s["state_bytes_per_host"] == mem["state_bytes_per_host"]
+    # ledger entry round-trip
+    e = LG.make_entry("memscope-test", "fp", "cpu", s)
+    assert e["mem_peak_bytes"] == mem["peak_bytes"]
+    assert e["state_bytes_per_host"] == mem["state_bytes_per_host"]
+    # tracker: the summary heartbeat carries the watermark column
+    summaries = [l for l in report.heartbeats if "[summary]" in l]
+    assert summaries and all("dev-peak-gib=" in l for l in summaries)
+    # metrics.json memory section
+    m = json.load(open(mpath))
+    assert "memory" in m
+    assert m["memory"]["peak_bytes"] == mem["peak_bytes"]
+    assert m["memory"]["state_bytes_per_host"] == \
+        mem["state_bytes_per_host"]
+    assert m["memory"]["cost"].get("bytes_accessed") is not None
+
+
+def test_tracker_ram_dev_column():
+    """[ram] lines (buffered-bytes hosts) gain the trailing dev=
+    watermark column beside the modeled bytes and rss=."""
+    from shadow_tpu.obs.tracker import Tracker
+
+    tr = Tracker(10**9, ["a", "b"])
+    socks = {
+        "sk_used": np.array([[True], [False]]),
+        "sk_proto": np.array([[6], [0]]),
+        "sk_rhost": np.array([[1], [-1]]),
+        "sk_rport": np.array([[80], [0]]),
+        "sk_snd_una": np.array([[100], [0]]),
+        "sk_snd_end": np.array([[500], [0]]),
+        "sk_sndbuf": np.array([[4096], [4096]]),
+        "sk_rcv_nxt": np.array([[0], [0]]),
+        "sk_rcvbuf": np.array([[4096], [4096]]),
+        "ooo_held": np.array([[0], [0]]),
+    }
+    stats = np.zeros((2, 24), np.int64)
+    stats[0, 0] = 5
+    tr.maybe_heartbeat(2 * 10**9, stats, socks=socks,
+                       hosted_rss={0: 12345}, dev_peak=777)
+    ram = [l for l in tr.lines if "[ram]" in l]
+    assert ram
+    assert any("rss=12345" in l and "dev=777" in l for l in ram)
+
+
+# --- the memory regression gate --------------------------------------------
+
+def _entry(rate=100.0, mem=None, fp="f0"):
+    s = {"events": 1000, "wall_seconds": 1000 / rate,
+         "events_per_sec": rate, "sim_seconds": 5.0, "windows": 10}
+    if mem is not None:
+        s["mem_peak_bytes"] = mem
+        s["mem_source"] = "rss"
+        s["state_bytes_per_host"] = 4510
+    return LG.make_entry("memgate", fp, "cpu", s)
+
+
+def test_memory_gate_flat_history_ok(tmp_path):
+    pr = _load_tool("perf_regress")
+    path = str(tmp_path / "l.jsonl")
+    for r, m in ((100, 10_000), (101, 10_100), (99, 9_900),
+                 (100, 10_050)):
+        LG.append(_entry(rate=r, mem=m), path)
+    assert pr.main([path]) == 0
+
+
+def test_memory_gate_synthetic_regression_exits_1(tmp_path):
+    """Acceptance: a synthetic memory regression (peak doubles at a
+    flat rate) exits 1 with the memory row marked."""
+    pr = _load_tool("perf_regress")
+    path = str(tmp_path / "l.jsonl")
+    for r, m in ((100, 10_000), (101, 10_100), (99, 9_900)):
+        LG.append(_entry(rate=r, mem=m), path)
+    LG.append(_entry(rate=100, mem=20_000), path)
+    results, reg = pr.check(LG.read(path))
+    assert reg
+    assert results[0]["mem_status"] == "REGRESSION"
+    assert results[0]["status"] == "ok"  # the RATE did not regress
+    assert pr.main([path]) == 1
+
+
+def test_memory_gate_band_and_direction(tmp_path):
+    """Memory regresses UP: a peak DROP never gates, and growth
+    within the band passes."""
+    pr = _load_tool("perf_regress")
+    path = str(tmp_path / "l.jsonl")
+    for m in (10_000, 10_200, 9_800):
+        LG.append(_entry(mem=m), path)
+    LG.append(_entry(mem=5_000), path)        # big drop: fine
+    assert pr.main([path]) == 0
+    LG.append(_entry(mem=11_000), path)       # +10% < 15% band: fine
+    assert pr.main([path]) == 0
+
+
+def test_memory_gate_ignores_pre_memscope_history(tmp_path):
+    """Entries without mem_peak_bytes (the committed pre-PR-15 ledger)
+    neither gate nor feed a baseline — the first memscope-carrying
+    entry starts the byte trajectory without failing it."""
+    pr = _load_tool("perf_regress")
+    path = str(tmp_path / "l.jsonl")
+    for r in (100, 101, 99):
+        LG.append(_entry(rate=r), path)       # no mem fields
+    LG.append(_entry(rate=100, mem=50_000_000), path)
+    results, reg = pr.check(LG.read(path))
+    assert not reg
+    assert "mem_status" not in results[0]
+    assert pr.main([path]) == 0
+
+
+# --- fleet admission from measured bytes ------------------------------------
+
+def test_fleet_rss_weight_from_measured_bytes():
+    """fleet submit --mem-bytes-per-host: the admission RSS weight
+    becomes hosts x measured per-host bytes (MiB, rounded up);
+    explicit --rss-mb always wins."""
+    import types
+
+    from shadow_tpu.fleet.cli import _rss_weight
+
+    a = types.SimpleNamespace(rss_mb=0, mem_bytes_per_host=102_471)
+    # 10_000 hosts x ~100 KB = ~977 MiB
+    assert _rss_weight(a, 10_000) == -(-10_000 * 102_471 // (1 << 20))
+    assert _rss_weight(a, 10_000) == 978
+    a2 = types.SimpleNamespace(rss_mb=512, mem_bytes_per_host=102_471)
+    assert _rss_weight(a2, 10_000) == 512
+    a3 = types.SimpleNamespace(rss_mb=0, mem_bytes_per_host=0)
+    assert _rss_weight(a3, 10_000) == 0
+
+
+# --- the capacity planner ---------------------------------------------------
+
+def _fake_measured(H=100, per_host=1000, fixed=5000, temp_ph=500,
+                   arg_err=0.0):
+    state = per_host * H + fixed
+    return {
+        "config": "synthetic", "hosts": H, "stop_s": 1,
+        "census": {
+            "H": H, "bytes": state, "per_host": per_host,
+            "fixed_bytes": fixed,
+            "hosts": {"hot": {"runtime_bytes": per_host * H // 2}},
+        },
+        "memory": {
+            "peak_bytes": 2 * state, "source": "rss",
+            "per_device": None,
+            "xla": {"argument_bytes":
+                    int((state + 16) * (1 + arg_err)),
+                    "temp_bytes": temp_ph * H, "output_bytes": 0,
+                    "alias_bytes": 0, "generated_code_bytes": 100,
+                    "errors": {}},
+        },
+        "events": 1,
+    }
+
+
+def test_planner_arithmetic_and_tolerance():
+    cp = _load_tool("capacity_plan")
+    p = cp.plan(_fake_measured(), hbm_gb=1.0,
+                targets=(1000, 10**6), tolerance=0.10)
+    v = p["validation"]
+    assert v["ok"] is True and v["rel_error"] == 0.0
+    # per-host: 1000 state + 500 temp; fixed 5000 + 100 code
+    assert p["per_host_total_bytes"] == 1500.0
+    assert p["fixed_bytes"] == 5100
+    budget = 1 << 30
+    assert p["max_hosts_per_chip"] == (budget - 5100) // 1500
+    row = p["ladder"][0]
+    assert row["hosts"] == 1000 and row["fits_one_chip"]
+    big = p["ladder"][1]
+    assert big["total_gib"] == pytest.approx(
+        (5100 + 1500 * 10**6) / (1 << 30), rel=1e-3)
+    assert big["chips_at_budget"] >= 2
+    # out-of-tolerance prediction fails validation
+    p2 = cp.plan(_fake_measured(arg_err=0.25), hbm_gb=1.0,
+                 tolerance=0.10)
+    assert p2["validation"]["ok"] is False
+    # a backend with no memory_analysis: unvalidated, never a crash
+    m3 = _fake_measured()
+    m3["memory"]["xla"] = {"argument_bytes": None, "errors":
+                           {"memory_analysis": "refused"}}
+    p3 = cp.plan(m3, hbm_gb=1.0)
+    assert p3["validation"]["ok"] is None
+    assert p3["ladder"]  # the census ladder still renders
+    assert "unvalidated" in cp.render_markdown(p3).lower() or \
+        "UNVALIDATED" in cp.render_markdown(p3)
+    # a DEGENERATE measurement (0 argument bytes) FAILS validation —
+    # it must never be misfiled as merely "unvalidated"
+    m4 = _fake_measured()
+    m4["memory"]["xla"]["argument_bytes"] = 0
+    p4 = cp.plan(m4, hbm_gb=1.0)
+    assert p4["validation"]["ok"] is False
+
+
+def test_planner_predict_vs_measure_real_run():
+    """Acceptance (in-process): the census prediction lands within
+    tolerance of the XLA-measured argument bytes on a real run."""
+    cp = _load_tool("capacity_plan")
+    measured = cp.measure("phold", n=16, stop=5)
+    p = cp.plan(measured, hbm_gb=16.0, tolerance=0.10)
+    assert p["validation"]["ok"] is True, p["validation"]
+    assert p["max_hosts_per_chip"] > 1000
+    md = cp.render_markdown(p)
+    assert "| hosts |" in md and "1,000,000" in md
+
+
+# --- observation must not perturb determinism -------------------------------
+
+def test_memscope_observation_does_not_perturb_digest(tmp_path):
+    """Acceptance: a fully-observed run (metrics + trace + heartbeat +
+    the always-on watermark/census/capture) produces a digest chain
+    byte-identical to a plain run's."""
+    from shadow_tpu.engine.sim import Simulation
+    from shadow_tpu.obs import trace as TR
+    from test_phold import phold_scenario
+
+    plain = str(tmp_path / "plain.jsonl")
+    observed = str(tmp_path / "observed.jsonl")
+    Simulation(phold_scenario(n=16, stop=5)).run(digest=plain)
+    TR.install(None)
+    try:
+        Simulation(phold_scenario(n=16, stop=5)).run(
+            digest=observed, heartbeat_s=1.0,
+            metrics=str(tmp_path / "m.json"))
+    finally:
+        TR.finish()
+    assert (open(plain, "rb").read() == open(observed, "rb").read()), \
+        "memory observation perturbed the digest chain"

@@ -378,10 +378,21 @@ def test_state_matrix_json_and_markdown(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
     with open(out) as f:
         data = json.load(f)
-    assert sorted(data) == ["cold_fields", "cold_when",
-                            "drain_hot_columns", "entries", "fields",
-                            "hot_counts", "hot_fields", "root",
-                            "sections", "version"]
+    assert sorted(data) == ["bytes_per_host", "cold_fields",
+                            "cold_when", "drain_hot_columns",
+                            "entries", "fields", "hot_counts",
+                            "hot_fields", "root", "sections",
+                            "version"]
+    # the memscope-sourced bytes column (obs.memscope stdlib dims
+    # table, pinned exact by tests/test_memscope.py): per-field and
+    # rolled up, at the EngineConfig defaults
+    assert data["fields"]["hosts"]["eq_time"]["bytes_per_host"] == 256
+    assert data["fields"]["hp"]["hid"]["bytes_per_host"] == 4
+    bph = data["bytes_per_host"]
+    assert bph["hosts"] == sum(
+        v["bytes_per_host"] for v in data["fields"]["hosts"].values())
+    assert 0 < bph["hosts_hot"] <= bph["hosts"]
+    assert bph["hosts_drain"] == bph["hosts_hot"]
     # the drain's measured working set is exactly the declared hot set
     assert data["drain_hot_columns"] == sorted(data["hot_fields"])
     assert "drain" in data["entries"]
@@ -398,7 +409,7 @@ def test_state_matrix_json_and_markdown(tmp_path):
 
     r = run_cli(["tools.state_matrix", "--markdown"])
     assert r.returncode == 0
-    assert "| `eq_time` | i64 | event_queue |" in r.stdout
+    assert "| `eq_time` | i64 | event_queue | 256 |" in r.stdout
 
 
 # --- the hot/cold split declaration (HOT_FIELDS / COLD_WHEN) ---------

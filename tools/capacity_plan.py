@@ -1,0 +1,290 @@
+#!/usr/bin/env python3
+"""capacity_plan: predict how many hosts fit a chip, from measured
+bytes — the planning table for ROADMAP item 2's 100k -> 1M-host push.
+
+The blocker that item names is memory layout ("host-table sharding,
+topology-oracle compression") — but before anyone refactors layout,
+the repo needs to SEE where the bytes go and how they scale. This
+tool closes the loop the memory observatory (obs.memscope,
+docs/observability.md) opened:
+
+1. **Measure**: build the scenario at a measurable size, take the
+   static byte census of its ``Hosts``/``HostParams``/``Shared``
+   pytrees, run it, and capture the compiled window program's XLA
+   ``memory_analysis`` (argument/temp/output bytes) plus the live
+   device-buffer watermark.
+2. **Validate**: the census PREDICTS the program's argument bytes
+   (state pytrees + the two window scalars); the run MEASURES them.
+   The prediction must land within ``--tolerance`` (default 10%) of
+   the measured figure or the tool exits 1 — a planner whose model
+   disagrees with the compiler's own accounting plans nothing.
+3. **Extrapolate**: per-host bytes (census) + per-host temp/output
+   footprint (measured, scaled from the run) + fixed cost (topology
+   oracle, generated code) give predicted total bytes at each ladder
+   target (default 100k/250k/500k/1M hosts), the max hosts one chip's
+   ``--hbm-gb`` budget holds, and the chips needed per target — the
+   markdown scale ladder the 1M push is planned from
+   (docs/performance.md "Sizing the 1M push").
+
+The linear model is deliberate: every engine array is O(H) with fixed
+trailing dims (the census proves it field by field), the topology
+oracle is the one O(V^2) fixed cost, and XLA temps for the window
+program are gather/scatter buffers sized by H — so bytes(H) =
+fixed + per_host * H is not an assumption, it is the layout. What the
+model canNOT see (and says so): a future topology whose V grows with
+H, and allocator fragmentation above the analytical footprint.
+
+Usage:
+  python tools/capacity_plan.py phold --n 1024 --stop 2 --cpu
+  python tools/capacity_plan.py socks10k --n 400 --stop 5 --cpu \
+      --hbm-gb 16 [--targets 100000,1000000] [--json] [--markdown]
+
+Exit: 0 prediction within tolerance / 1 out of tolerance /
+2 usage / 3 backend provides no memory_analysis (nothing to validate
+against — the census and ladder still print, labeled unvalidated).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# the scalars the window program takes beside the three state pytrees
+# (wstart, wend: two i64)
+SCALAR_ARG_BYTES = 16
+
+DEFAULT_TARGETS = (100_000, 250_000, 500_000, 1_000_000)
+
+
+def _gib(n) -> float:
+    return n / (1 << 30)
+
+
+def measure(config: str, n: int = None, stop: int = 2,
+            runahead_ms: int = 0, seed: int = None) -> dict:
+    """Build, census, run and capture one scenario at a measurable
+    size. Returns the raw figures plan() extrapolates from."""
+    from shadow_tpu.engine.sim import Simulation
+    from shadow_tpu.obs import memscope as MS
+    from tools.baseline_configs import apply_runahead
+    from tools.perf_report import build_config
+
+    scen, cfg, n = build_config(config, n, stop)
+    if seed is not None:
+        scen.seed = seed
+    sim = apply_runahead(Simulation(scen, engine_cfg=cfg), runahead_ms)
+    census = MS.state_census(sim.cfg, hosts=sim.hosts, hp=sim.hp,
+                             sh=sim.sh)
+    report = sim.run()
+    return {
+        "config": config, "hosts": n, "stop_s": stop,
+        "census": census,
+        "memory": report.memory,
+        "events": report.events,
+    }
+
+
+def plan(measured: dict, hbm_gb: float, targets=DEFAULT_TARGETS,
+         tolerance: float = 0.10) -> dict:
+    """The prediction + validation + ladder, from measure()'s output.
+
+    Pure arithmetic (no jax) so tests can drive it with synthetic
+    measurements and the validation semantics stay inspectable."""
+    census = measured["census"]
+    mem = measured["memory"]
+    xla = mem.get("xla") or {}
+    H = measured["hosts"]
+    budget = int(hbm_gb * (1 << 30))
+
+    per_host_state = census["per_host"]
+    fixed = census["fixed_bytes"]
+
+    # validation: the census predicts the compiled program's argument
+    # bytes — the compiler's own accounting of the state it was handed
+    pred_args = census["bytes"] + SCALAR_ARG_BYTES
+    meas_args = xla.get("argument_bytes")
+    validation = {"predicted_argument_bytes": pred_args,
+                  "measured_argument_bytes": meas_args,
+                  "tolerance": tolerance}
+    if meas_args is not None:
+        # `is not None`, not truthiness: a degenerate backend
+        # reporting 0 argument bytes must FAIL validation (exit 1),
+        # not sail through as merely "unvalidated" (exit 3)
+        err = abs(pred_args - meas_args) / max(meas_args, 1)
+        validation["rel_error"] = round(err, 6)
+        validation["ok"] = err <= tolerance
+    else:
+        validation["ok"] = None
+        validation["why"] = ("backend provides no memory_analysis — "
+                             "census unvalidated "
+                             + str((xla.get("errors") or {})
+                                   .get("memory_analysis", "")))
+
+    # measured per-host overheads beyond the state census: XLA temp
+    # buffers and non-aliased outputs scale with H (gather/scatter
+    # workspace over [H,*] arrays); generated code is fixed
+    temp_ph = (xla["temp_bytes"] / H
+               if xla.get("temp_bytes") is not None else 0.0)
+    out_ph = (max(xla["output_bytes"] - (xla.get("alias_bytes") or 0),
+                  0) / H
+              if xla.get("output_bytes") is not None else 0.0)
+    gen = xla.get("generated_code_bytes") or 0
+    per_host_total = per_host_state + temp_ph + out_ph
+    fixed_total = fixed + gen
+
+    headroom = budget - fixed_total
+    max_hosts = int(headroom // per_host_total) if headroom > 0 else 0
+
+    ladder = []
+    for tgt in targets:
+        total = fixed_total + per_host_total * tgt
+        # sharding divides the per-host state/temp across chips but
+        # replicates the fixed cost (topology oracle, program) on
+        # every chip — chips solve per-chip budget >= fixed +
+        # per_host * (H / chips)
+        chips = (max(-(-int(per_host_total * tgt) // int(headroom)), 1)
+                 if headroom > 0 else None)
+        ladder.append({
+            "hosts": tgt,
+            "state_gib": round(_gib(per_host_state * tgt), 3),
+            "temp_gib": round(_gib((temp_ph + out_ph) * tgt), 3),
+            "total_gib": round(_gib(total), 3),
+            "fits_one_chip": bool(total <= budget),
+            "chips_at_budget": chips,
+        })
+
+    return {
+        "config": measured["config"],
+        "measured_hosts": H,
+        "hbm_budget_gib": round(_gib(budget), 3),
+        "per_host_state_bytes": per_host_state,
+        "per_host_temp_bytes": round(temp_ph + out_ph, 1),
+        "per_host_total_bytes": round(per_host_total, 1),
+        "fixed_bytes": fixed_total,
+        "hot_state_bytes_per_host":
+            census["hosts"]["hot"]["runtime_bytes"] // max(H, 1),
+        "watermark": {"peak_bytes": mem.get("peak_bytes"),
+                      "source": mem.get("source"),
+                      "per_device": mem.get("per_device")},
+        "validation": validation,
+        "max_hosts_per_chip": max_hosts,
+        "ladder": ladder,
+    }
+
+
+def render_markdown(p: dict) -> str:
+    v = p["validation"]
+    lines = [
+        f"## capacity plan: {p['config']} "
+        f"(measured at H={p['measured_hosts']}, budget "
+        f"{p['hbm_budget_gib']} GiB/chip)",
+        "",
+        f"- per-host state: **{p['per_host_state_bytes']} B** "
+        f"(hot working set {p['hot_state_bytes_per_host']} B); "
+        f"per-host temp+output: {p['per_host_temp_bytes']} B; "
+        f"fixed: {p['fixed_bytes']} B",
+        f"- max hosts on one chip: **{p['max_hosts_per_chip']:,}**",
+        f"- watermark: {p['watermark']['peak_bytes']} B "
+        f"({p['watermark']['source']})",
+    ]
+    if v["ok"] is None:
+        lines.append(f"- validation: UNVALIDATED — {v.get('why')}")
+    else:
+        lines.append(
+            f"- validation: census predicted "
+            f"{v['predicted_argument_bytes']} B of program arguments, "
+            f"XLA measured {v['measured_argument_bytes']} B — "
+            f"{v['rel_error'] * 100:.2f}% error "
+            f"({'within' if v['ok'] else 'OUTSIDE'} the "
+            f"{v['tolerance'] * 100:.0f}% tolerance)")
+    lines += [
+        "",
+        "| hosts | state GiB | temp GiB | total GiB | 1 chip? "
+        "| chips @ budget |",
+        "|---|---|---|---|---|---|",
+    ]
+    for row in p["ladder"]:
+        lines.append(
+            f"| {row['hosts']:,} | {row['state_gib']} "
+            f"| {row['temp_gib']} | {row['total_gib']} "
+            f"| {'yes' if row['fits_one_chip'] else 'no'} "
+            f"| {row['chips_at_budget']} |")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="predict max hosts per chip from measured bytes "
+                    "(docs/performance.md 'Sizing the 1M push')")
+    ap.add_argument("config", help="phold | socks10k | tor50k | bulk1k")
+    ap.add_argument("--n", type=int, default=None,
+                    help="hosts at the MEASUREMENT scale (default: "
+                         "the config's own)")
+    ap.add_argument("--stop", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--runahead-ms", type=int, default=0)
+    ap.add_argument("--hbm-gb", type=float, default=16.0,
+                    help="per-chip HBM budget in GiB (default 16, the "
+                         "v5e class)")
+    ap.add_argument("--targets", default=None,
+                    help="comma-separated ladder host counts (default "
+                         "100000,250000,500000,1000000)")
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="relative error the census prediction must "
+                         "stay within vs the measured program "
+                         "arguments (default 0.10)")
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--markdown", action="store_true",
+                    help="markdown only (default prints markdown AND "
+                         "a json line)")
+    ap.add_argument("-o", "--out", default=None,
+                    help="also write the markdown table to a file")
+    args = ap.parse_args(argv)
+
+    targets = DEFAULT_TARGETS
+    if args.targets:
+        try:
+            targets = tuple(int(t) for t in args.targets.split(",")
+                            if t.strip())
+        except ValueError:
+            ap.error(f"--targets {args.targets!r}: not integers")
+        if not targets:
+            ap.error("--targets names no host counts")
+
+    if args.cpu:
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    measured = measure(args.config, n=args.n, stop=args.stop,
+                       runahead_ms=args.runahead_ms, seed=args.seed)
+    p = plan(measured, args.hbm_gb, targets=targets,
+             tolerance=args.tolerance)
+
+    if args.json:
+        print(json.dumps(p, indent=1))
+    else:
+        md = render_markdown(p)
+        print(md)
+        if not args.markdown:
+            print(json.dumps({k: p[k] for k in
+                              ("config", "measured_hosts",
+                               "max_hosts_per_chip",
+                               "per_host_total_bytes")}))
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(md + "\n")
+
+    ok = p["validation"]["ok"]
+    if ok is None:
+        return 3
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

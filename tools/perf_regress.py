@@ -18,6 +18,13 @@ Policy (docs/performance.md):
   gate at 15% (CPU-container runs are noisy; chip runs are tight);
 - trajectories never mix platforms or fingerprints — a config change
   or a CPU-vs-TPU comparison starts a new series by construction;
+- MEMORY gate (obs.memscope, docs/observability.md): entries carrying
+  ``mem_peak_bytes`` (the run's device-buffer watermark) are also
+  compared against their history median — a peak GROWING past
+  ``baseline * (1 + band)`` is a regression exactly like a rate drop
+  (the direction flips; the band policy is the same). Entries without
+  the field (pre-memscope trajectories) neither gate nor feed a
+  baseline, so the committed history stays untouched;
 - groups with fewer than ``--min-history + 1`` entries are reported
   as "insufficient history", never failed — but a candidate whose
   rate is zero/absent against REAL history is a failed comparison
@@ -116,10 +123,37 @@ def check(entries, band=DEFAULT_BAND, min_history=1, candidate=None):
                "fingerprint": fp, "entries": len(hist) + 1}
         cr = LG.entry_rate(cand) or 0.0
         if compile_bound(cand):
+            # no throughput OR memory signal: a compile-bound run's
+            # peak bytes measure the XLA build's transient footprint
+            # (cache state), not the simulation's
             row["status"] = "compile-bound"
             row["candidate_rate"] = round(cr, 1) if cr else None
             results.append(row)
             continue
+        # memory gate: peak-bytes growth past the band is a
+        # regression like a rate drop (direction flipped — memory
+        # regresses UP). Evaluated independently of the rate gate so
+        # a flat-rate run that doubled its footprint still fails.
+        cm = cand.get("mem_peak_bytes")
+        mems = [m for m in (e.get("mem_peak_bytes") for e in hist
+                            if not compile_bound(e)) if m]
+        if cm and len(mems) >= min_history:
+            mbase = median(mems)
+            mspread = ((max(mems) - min(mems)) / mbase
+                       if len(mems) >= 2 and mbase else 0.0)
+            mband = min(max(band, mspread), MAX_BAND)
+            mthresh = mbase * (1.0 + mband)
+            mem_reg = cm > mthresh
+            row.update({
+                "mem_status": "REGRESSION" if mem_reg else "ok",
+                "mem_peak_bytes": int(cm),
+                "mem_baseline": round(mbase, 1),
+                "mem_band": round(mband, 3),
+                "mem_threshold": round(mthresh, 1),
+                "mem_delta_frac": (round(cm / mbase - 1.0, 4)
+                                   if mbase else None),
+            })
+            any_reg = any_reg or mem_reg
         rates = [r for r in (LG.entry_rate(e) for e in hist
                              if not compile_bound(e)) if r]
         if len(rates) < min_history or not rates:
@@ -205,13 +239,22 @@ def main(argv):
                       f"(rate {r['candidate_rate']} is cache state, "
                       "not throughput — not gated)")
             else:
-                mark = "!!" if r["status"] == "REGRESSION" else "ok"
+                reg = (r["status"] == "REGRESSION"
+                       or r.get("mem_status") == "REGRESSION")
+                mark = "!!" if reg else "ok"
                 print(f"{mark} {r['scenario']} [{r['platform']}] "
                       f"{r['fingerprint']}: {r['candidate_rate']} "
                       f"vs median {r['baseline_median']} "
                       f"(band {r['band'] * 100:.0f}%, "
                       f"threshold {r['threshold']}, "
                       f"delta {r['delta_frac'] * 100:+.1f}%)")
+            if r.get("mem_status"):
+                mmark = "!!" if r["mem_status"] == "REGRESSION" else "ok"
+                print(f"   {mmark} memory: peak "
+                      f"{r['mem_peak_bytes']} vs median "
+                      f"{r['mem_baseline']} (band "
+                      f"{r['mem_band'] * 100:.0f}%, delta "
+                      f"{r['mem_delta_frac'] * 100:+.1f}%)")
         if any_reg:
             print("PERF REGRESSION — see rows marked !! "
                   "(docs/performance.md for the protocol)")

@@ -132,8 +132,29 @@ def report_live(config, n=None, stop=10, runahead_ms=0, chunk=0,
         "events_per_sec": round(s["events_per_sec"], 1),
         "realtime_x": round(s["speedup"], 4),
         "roofline_frac": round(cost.get("roofline_frac", 0.0), 5),
+        # modeled-vs-measured HBM traffic side by side (obs.memscope:
+        # XLA bytes-accessed x chunk calls when the backend provides
+        # it; `measured` says which figure roofline_frac used)
+        "roofline_frac_modeled": round(
+            cost.get("roofline_frac_modeled", 0.0), 5),
+        "roofline_measured": bool(cost.get("measured")),
         "passes_per_window": round(
             cost.get("passes_per_window", 0.0), 2),
+        # the memory section (obs.memscope): watermark + census + the
+        # window program's captured XLA analysis — the report's
+        # memory table (docs/observability.md "Memory observatory")
+        "memory": {
+            "peak_bytes": report.memory.get("peak_bytes"),
+            "source": report.memory.get("source"),
+            "per_device": report.memory.get("per_device"),
+            "state_bytes": report.memory.get("state_bytes"),
+            "state_bytes_per_host":
+                report.memory.get("state_bytes_per_host"),
+            "hot_state_bytes": report.memory.get("hot_state_bytes"),
+            "cold_state_bytes": report.memory.get("cold_state_bytes"),
+            "sections": report.memory.get("sections"),
+            "xla": report.memory.get("xla"),
+        },
         "attribution": att,
     }
     if device_phases:

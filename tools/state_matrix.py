@@ -40,8 +40,35 @@ from __future__ import annotations
 
 import argparse
 import importlib
+import importlib.util
 import json
+import os
 import sys
+
+
+def _memscope():
+    """obs.memscope's pure-stdlib byte tables, loaded by FILE PATH —
+    this tool must stay jax-free (module docstring), and importing
+    shadow_tpu.obs would trigger the package's jax import. Only the
+    stdlib census helpers (table_row_bytes / dims_of) are touched."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(os.path.dirname(here), "shadow_tpu", "obs",
+                        "memscope.py")
+    spec = importlib.util.spec_from_file_location("_memscope", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def field_bytes_per_host() -> dict:
+    """{kind: {field: bytes/host}} at the EngineConfig DEFAULTS — the
+    bytes column of the tables (obs.memscope's stdlib dims table,
+    pinned exact against the real alloc_hosts shapes by
+    tests/test_memscope.py). Config-dependent sizes (a run's actual
+    qcap/obcap) come from the live census, not this tool."""
+    ms = _memscope()
+    return {"hosts": ms.table_row_bytes(None, ms.HOSTS_DIMS),
+            "hp": ms.table_row_bytes(None, ms.HP_DIMS)}
 
 
 def build(root: str):
@@ -71,8 +98,9 @@ def _cell(entry_acc, kind, field):
     return ""
 
 
-def _rows(matrix, model, kind):
+def _rows(matrix, model, kind, bytes_map=None):
     entries = list(matrix)
+    byt = (bytes_map or {}).get(kind)
     rows = []
     for field in model.fields[kind]:
         label = field + ("*" if kind == "hosts"
@@ -80,8 +108,18 @@ def _rows(matrix, model, kind):
         rows.append([label, model.dtype_of(kind, field)]
                     + ([model.section_of(field) or "other"]
                        if kind == "hosts" else [])
+                    + ([byt.get(field, "?")] if byt is not None
+                       else [])
                     + [_cell(matrix[e], kind, field) for e in entries])
     return entries, rows
+
+
+def _header(matrix, kind, bytes_map=None):
+    return (["field", "dtype"]
+            + (["section"] if kind == "hosts" else [])
+            + (["B/host"] if (bytes_map or {}).get(kind) is not None
+               else [])
+            + list(matrix))
 
 
 _KIND_TITLES = (("hosts", "Hosts (mutable per-host state)"),
@@ -90,12 +128,11 @@ _KIND_TITLES = (("hosts", "Hosts (mutable per-host state)"),
 
 
 def render_text(matrix, model) -> str:
+    bm = field_bytes_per_host()
     out = []
     for kind, title in _KIND_TITLES:
-        entries, rows = _rows(matrix, model, kind)
-        header = (["field", "dtype"]
-                  + (["section"] if kind == "hosts" else [])
-                  + entries)
+        entries, rows = _rows(matrix, model, kind, bm)
+        header = _header(matrix, kind, bm)
         widths = [max(len(str(r[i])) for r in [header] + rows)
                   for i in range(len(header))]
         out.append(f"## {title}")
@@ -145,12 +182,11 @@ def hot_summary_text(matrix, model) -> str:
 
 
 def render_markdown(matrix, model) -> str:
+    bm = field_bytes_per_host()
     out = []
     for kind, title in _KIND_TITLES:
-        entries, rows = _rows(matrix, model, kind)
-        header = (["field", "dtype"]
-                  + (["section"] if kind == "hosts" else [])
-                  + entries)
+        entries, rows = _rows(matrix, model, kind, bm)
+        header = _header(matrix, kind, bm)
         out.append(f"### {title}\n")
         out.append("| " + " | ".join(header) + " |")
         out.append("|" + "---|" * len(header))
@@ -163,27 +199,45 @@ def render_markdown(matrix, model) -> str:
 
 
 def render_json(matrix, model, root) -> str:
+    bm = field_bytes_per_host()
     fields = {}
     for kind, _ in _KIND_TITLES:
+        byt = bm.get(kind)
         fields[kind] = {
             name: {"dtype": model.dtype_of(kind, name),
+                   **({"bytes_per_host": byt.get(name)}
+                      if byt is not None else {}),
                    **({"section": model.section_of(name) or "other",
                        "cold": name in model.cold,
                        "line": model.linenos.get(name, 0)}
                       if kind == "hosts" else {})}
             for name in model.fields[kind]}
     drain = matrix.get("drain", {}).get("hosts", {})
+    # per-host byte rollups at the EngineConfig defaults (the memscope
+    # census — docs/observability.md "Memory observatory"): total, the
+    # declared-hot subset, and what the drain subgraph measured
+    hot = set(model.hot_set())
+    drain_cols = sorted(set(drain.get("reads", {}))
+                        | set(drain.get("writes", {})))
+    bytes_per_host = {
+        "config": "EngineConfig defaults",
+        "hosts": sum(bm["hosts"].values()),
+        "hosts_hot": sum(b for f, b in bm["hosts"].items() if f in hot),
+        "hosts_drain": sum(b for f, b in bm["hosts"].items()
+                           if f in drain_cols),
+        "hp": sum(bm["hp"].values()),
+    }
     return json.dumps({
-        "version": 2,
+        "version": 3,
         "root": root,
         "entries": matrix,
         "fields": fields,
+        "bytes_per_host": bytes_per_host,
         "cold_fields": sorted(model.cold),
         "hot_fields": list(model.hot_set()),
         "cold_when": [[g, list(f)] for g, f in model.cold_when],
         "hot_counts": [list(r) for r in hot_counts(model)],
-        "drain_hot_columns": sorted(set(drain.get("reads", {}))
-                                    | set(drain.get("writes", {}))),
+        "drain_hot_columns": drain_cols,
         "sections": [list(s) for s in model.sections],
     }, indent=1, sort_keys=False) + "\n"
 
