@@ -105,6 +105,19 @@ def _phold_cfg(num_hosts):
                         incap=16, chunk_windows=512)
 
 
+def _netscope_cfg(cfg):
+    """SHADOW_TPU_NETSCOPE=1 runs every compiled config with the
+    network observatory histograms on (obs.netscope) — the bench line
+    and its ledger entry then carry rtt_p50_us/rtt_p99_us/
+    completion_p99_s, so the trajectory gates tail behavior next to
+    the rate. Applied to the ledger fingerprint too: the knob changes
+    the compiled shape, so it starts its own trajectory."""
+    if os.environ.get("SHADOW_TPU_NETSCOPE", "") not in ("", "0"):
+        import dataclasses
+        return dataclasses.replace(cfg, netscope=True)
+    return cfg
+
+
 def _run_compiled(scen, cfg, warm_stop_ns=int(1.2 * 10**9), reps=1,
                   runahead_ms=0):
     """Warm-up at identical shapes (tiny stop; stop_time is a dynamic
@@ -118,6 +131,8 @@ def _run_compiled(scen, cfg, warm_stop_ns=int(1.2 * 10**9), reps=1,
     from shadow_tpu.engine.sim import Simulation
     from shadow_tpu.serving import aotcache as _AC
     from tools.baseline_configs import apply_runahead
+
+    cfg = _netscope_cfg(cfg)
 
     def build(s):
         return apply_runahead(Simulation(s, engine_cfg=cfg),
@@ -264,6 +279,12 @@ def _emit(metric, summary, baseline, baseline_cfg, baseline_c=None,
     if "rep_rates" in summary:
         line["rep_rates"] = summary["rep_rates"]
         line["rep_spread"] = summary["rep_spread"]
+    if "rtt_p50_us" in summary:
+        # network observatory tails (obs.netscope, SHADOW_TPU_NETSCOPE
+        # runs): exact percentile read-outs beside the rate
+        line["rtt_p50_us"] = summary["rtt_p50_us"]
+        line["rtt_p99_us"] = summary["rtt_p99_us"]
+        line["completion_p99_s"] = summary.get("completion_p99_s")
     if baseline_c:
         line["baseline_c"] = baseline_c
         if baseline_c.get("events_per_sec"):
@@ -278,6 +299,7 @@ def _emit(metric, summary, baseline, baseline_cfg, baseline_c=None,
         # one (SHADOW_TPU_LEDGER=off disables)
         try:
             from shadow_tpu.obs import ledger as LG
+            ledger_cfg = _netscope_cfg(ledger_cfg)
             entry = LG.make_entry(
                 scenario=metric.split(" ")[0],
                 fingerprint=LG.fingerprint_of(ledger_cfg,

@@ -163,6 +163,16 @@ def main(argv=None):
     p.add_argument("--metrics", default=None, metavar="FILE",
                    help="write a metrics snapshot to FILE and "
                         "per-chunk JSON lines to FILE.chunks.jsonl")
+    p.add_argument("--netscope", default=None, metavar="FILE",
+                   help="network observatory (obs.netscope): count "
+                        "RTT/completion/queue/retransmit latency "
+                        "histograms on device and stream a per-chunk "
+                        "network time-series to FILE as JSON lines; "
+                        "the summary carries exact p50/p99 read-outs "
+                        "and --metrics grows a `net` section. "
+                        "Deterministic; changes the compiled shape "
+                        "(and so the config fingerprint), never the "
+                        "simulation results")
     p.add_argument("--perf", nargs="?", const="", default=None,
                    metavar="LEDGER",
                    help="per-phase wall attribution + perf ledger: "
@@ -378,15 +388,21 @@ def main(argv=None):
                    f"stop={scenario.stop_time / 1e9:.1f}s")
 
     engine_cfg = None
-    if args.engine_caps:
+    if args.engine_caps or args.netscope:
+        # knobs that must be set BEFORE Simulation.__init__ (they
+        # change the allocated state shapes): build the auto config
+        # ourselves and override it
         from .engine.sim import auto_engine_config
         from .routing.topology import build_topology
         import dataclasses
         topo = build_topology(scenario.topology_graphml or
                               scenario.topology_path)
         engine_cfg = auto_engine_config(scenario, topo)
+        if args.netscope:
+            engine_cfg = dataclasses.replace(engine_cfg, netscope=True)
         names = {"chunk": "chunk_windows"}
-        for kv in args.engine_caps.split(","):
+        for kv in (args.engine_caps.split(",")
+                   if args.engine_caps else ()):
             k, _, v = kv.partition("=")
             k = names.get(k.strip(), k.strip())
             if k not in {"qcap", "scap", "obcap", "incap", "txqcap",
@@ -521,7 +537,8 @@ def main(argv=None):
                          metrics=args.metrics,
                          digest=args.digest,
                          digest_every=args.digest_every,
-                         digest_context=dg_ctx)
+                         digest_context=dg_ctx,
+                         netscope=args.netscope)
     except Preempted as pe:
         from .engine.supervisor import EXIT_PREEMPTED
         logger.message(pe.sim_ns, "main",
@@ -570,6 +587,15 @@ def main(argv=None):
                    f"done: {s['events']} events in {s['wall_seconds']:.2f}s "
                    f"wall ({s['events_per_sec']:.0f} ev/s, "
                    f"speedup x{s['speedup']:.2f})")
+    if report.network:
+        # network observatory read-out: per-kind sample count + exact
+        # p50/p99 from the device histograms
+        for kind, kk in report.network.get("kinds", {}).items():
+            if kk["count"]:
+                logger.message(
+                    report.sim_time_ns, "main",
+                    f"netscope {kind}: n={kk['count']} "
+                    f"p50={kk['p50_us']}us p99={kk['p99_us']}us")
     # robustness accounting: applied faults + hosted-process exits
     for rec in report.faults:
         logger.message(report.sim_time_ns, "main",

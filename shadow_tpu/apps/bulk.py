@@ -26,6 +26,7 @@ from ..engine.defs import (WAKE_START, WAKE_TIMER, WAKE_SOCKET,
                            ST_XFER_DONE, ST_APP_DONE)
 from ..net import packet as P
 from ..net.tcp import tcp_connect, tcp_listen, tcp_write, tcp_close_call
+from ..obs import netscope
 from .base import timer
 
 
@@ -47,11 +48,15 @@ def app_bulk(row, hp, sh, now, wake):
         return tcp_write(r, now, sock, hp.app_cfg[2])
 
     def on_sent(r):
-        # all bytes acked: transfer complete; close and maybe go again
+        # all bytes acked: transfer complete — completion time runs
+        # from the handshake stamp (sk_hs_time, which close leaves in
+        # place until the slot is freed)
+        dur_us = jnp.maximum(now - rget(r.sk_hs_time, sock), 0) // 1000
         r = tcp_close_call(r, now, sock)
         r = r.replace(
             app_r=radd(r.app_r, 1, 1),
             stats=radd(r.stats, ST_XFER_DONE, 1))
+        r = netscope.observe(r, netscope.NS_COMPLETION, dur_us)
         done = (hp.app_cfg[3] > 0) & (r.app_r[1] >= hp.app_cfg[3])
         return jax.lax.cond(
             done,

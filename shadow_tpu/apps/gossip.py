@@ -37,6 +37,7 @@ from ..engine.defs import (WAKE_START, ST_XFER_DONE, ST_RTT_SUM_US,
                            ST_RTT_COUNT)
 from ..net import packet as P
 from ..net.udp import udp_open, udp_sendto
+from ..obs import netscope
 from .base import draw, timer
 
 MAX_FANOUT = 8
@@ -104,6 +105,7 @@ def app_gossip(row, hp, sh, now, wake):
                 stats=radd(radd(rr.stats, ST_XFER_DONE, 1),
                            ST_RTT_SUM_US, delay_us))
             rr = rr.replace(stats=radd(rr.stats, ST_RTT_COUNT, 1))
+            rr = netscope.observe(rr, netscope.NS_RTT, delay_us)
             return _relay(rr, hp, sh, now, h)
 
         return jax.lax.cond(fresh, first_sight, lambda rr: rr, r)

@@ -23,6 +23,7 @@ from ..engine.defs import (WAKE_START, WAKE_TIMER, WAKE_SOCKET,
                            ST_RTT_SUM_US, ST_RTT_COUNT, ST_XFER_DONE, ST_APP_DONE)
 from ..net import packet as P
 from ..net.udp import udp_open, udp_sendto
+from ..obs import netscope
 from .base import timer
 
 _US_MOD = 2**31  # python int: device consts would be hoisted as const_args
@@ -64,6 +65,9 @@ def app_ping(row, hp, sh, now, wake):
             app_r=radd(r.app_r, 2, 1),
             stats=radd(radd(radd(r.stats, ST_RTT_SUM_US, rtt_us),
                             ST_RTT_COUNT, 1), ST_XFER_DONE, 1))
+        # a ping's echo is both its RTT sample and its completion
+        r = netscope.observe(r, netscope.NS_RTT, rtt_us)
+        r = netscope.observe(r, netscope.NS_COMPLETION, rtt_us)
         limit = hp.app_cfg[4]
         done = (limit > 0) & (r.app_r[2] >= limit)
         return r.replace(stats=radd(r.stats, ST_APP_DONE,

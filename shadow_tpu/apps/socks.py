@@ -49,6 +49,7 @@ from ..engine.defs import (ST_XFER_DONE, ST_APP_DONE, ST_RTT_SUM_US,
                            ST_RTT_COUNT, ST_CHAIN_SHORT)
 from ..net import packet as P
 from ..net.tcp import tcp_connect, tcp_listen, tcp_write, tcp_close_call
+from ..obs import netscope
 from .base import draw, timer
 
 _I32 = jnp.int32
@@ -122,6 +123,11 @@ def app_socks_client(row, hp, sh, now, wake):
                 stats=radd(radd(radd(rr.stats, ST_XFER_DONE, 1),
                                 ST_RTT_SUM_US, delay_us),
                            ST_RTT_COUNT, 1))
+            # the fetch delay is the chain's end-to-end figure: both
+            # the RTT sample (as ST_RTT_SUM_US counts it) and the
+            # client-observed completion time
+            rr = netscope.observe(rr, netscope.NS_RTT, delay_us)
+            rr = netscope.observe(rr, netscope.NS_COMPLETION, delay_us)
             fin = (hp.app_cfg[6] > 0) & (rr.app_r[1] >= hp.app_cfg[6])
             return jax.lax.cond(
                 fin,

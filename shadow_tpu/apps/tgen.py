@@ -64,6 +64,7 @@ from ..engine.defs import (WAKE_START, WAKE_TIMER, WAKE_SOCKET,
                            ST_TGEN_ABORT)
 from ..net import packet as P
 from ..net.tcp import tcp_connect, tcp_listen, tcp_write, tcp_close_call
+from ..obs import netscope
 from .base import draw, timer, schedule_wake
 
 # --- node table encoding (Shared.tgen_nodes: int64 [N, 10]) ---
@@ -580,11 +581,15 @@ def _finish_transfer(row, hp, sh, now, sock):
     on from its owning node."""
     node = rget(row.sk_app_ref, sock)
     nd = _node(sh, node)
+    # completion time runs from the handshake stamp; read it before
+    # the close path touches the slot
+    dur_us = jnp.maximum(now - rget(row.sk_hs_time, sock), 0) // 1000
     row = row.replace(sk_app_ref=rset(row.sk_app_ref, sock, -1))
     row = tcp_close_call(row, now, sock)
     row = row.replace(
         app_r=radd(radd(row.app_r, REG_COUNT, 1), REG_BYTES, nd[COL_B]),
         stats=radd(row.stats, ST_XFER_DONE, 1))
+    row = netscope.observe(row, netscope.NS_COMPLETION, dur_us)
     return _walk_succ(row, hp, sh, now, node)
 
 

@@ -160,6 +160,13 @@ class PyEngine:
         self.tg_edges = np.asarray(sim.sh.tgen_edges)
 
         self.stats = np.zeros((H, defs.N_STATS), dtype=np.int64)
+        # netscope mirror: always counted here (the python engine has
+        # no shape cost); the differential test compares it against
+        # the device histograms when cfg.netscope is on
+        from ..obs import netscope as _NS
+        self._ns = _NS
+        self.ns_hist = np.zeros((H, _NS.NS_KINDS, _NS.NS_BUCKETS),
+                                dtype=np.int64)
         self.hosts = [_Host(h, cfg.qcap, cfg.scap, cfg.txqcap, cfg.obcap,
                             procs=cfg.procs_per_host)
                       for h in range(H)]
@@ -439,6 +446,10 @@ class PyEngine:
         self.stats[host.hid, defs.ST_PKTS_SENT] += 1
         host.pkt_ctr += 1
 
+    def _ns_observe(self, hid, kind, value_us):
+        """Host-side mirror of obs.netscope.observe (same bucketing)."""
+        self.ns_hist[hid, kind, self._ns.bucket_of(value_us)] += 1
+
     def _on_pkt(self, host, now, pkt):
         wire = self._wire_bytes(pkt)
         bw = max(int(self.hp_bw_down[host.hid]), 1)
@@ -447,6 +458,7 @@ class PyEngine:
         if backlog_bytes + wire > int(self.hp_nic_buf[host.hid]):
             self.stats[host.hid, defs.ST_PKTS_DROP_BUF] += 1
             return
+        self._ns_observe(host.hid, self._ns.NS_QUEUE, backlog_ns // 1000)
         host.nic_rx_until = max(host.nic_rx_until, now) + \
             self._tx_dur(wire, bw)
         self.stats[host.hid, defs.ST_PKTS_RECV] += 1
@@ -650,6 +662,8 @@ class PyEngine:
                 new_nxt - max(snd_max, snd_nxt)
         if is_rex or gbn:
             self.stats[host.hid, defs.ST_RETRANSMIT] += 1
+            self._ns_observe(host.hid, self._ns.NS_RETX,
+                             sk["rto"] // 1000)
         time_it = is_data and not is_rex and not gbn and sk["rtt_seq"] < 0
         if is_data and not is_rex:
             sk["snd_nxt"] = new_nxt
@@ -1061,6 +1075,8 @@ class PyEngine:
             self.stats[host.hid, defs.ST_RTT_SUM_US] += rtt
             self.stats[host.hid, defs.ST_RTT_COUNT] += 1
             self.stats[host.hid, defs.ST_XFER_DONE] += 1
+            self._ns_observe(host.hid, self._ns.NS_RTT, rtt)
+            self._ns_observe(host.hid, self._ns.NS_COMPLETION, rtt)
             limit = int(cfg[4])
             if limit > 0 and host.app_r[2] >= limit:
                 self.stats[host.hid, defs.ST_APP_DONE] += 1
@@ -1151,6 +1167,7 @@ class PyEngine:
                 self.stats[host.hid, defs.ST_XFER_DONE] += 1
                 self.stats[host.hid, defs.ST_RTT_SUM_US] += delay_us
                 self.stats[host.hid, defs.ST_RTT_COUNT] += 1
+                self._ns_observe(host.hid, self._ns.NS_RTT, delay_us)
                 self._relay_gossip(host, now, h)
 
     # --- apps: TCP tier (apps.bulk / apps.tgen mirrors) ---------------------
@@ -1165,9 +1182,12 @@ class PyEngine:
         elif reason == 3:           # connected
             self._tcp_write(host, now, sock, int(cfg[2]))
         elif reason == 6:           # sent: all bytes acked
+            dur_us = max(now - self._rg(host, sock, "hs_time", 0), 0) \
+                // 1000
             self._tcp_close_call(host, now, sock)
             host.app_r[1] += 1
             self.stats[host.hid, defs.ST_XFER_DONE] += 1
+            self._ns_observe(host.hid, self._ns.NS_COMPLETION, dur_us)
             done = int(cfg[3]) > 0 and host.app_r[1] >= int(cfg[3])
             if done:
                 self.stats[host.hid, defs.ST_APP_DONE] += 1
@@ -1247,6 +1267,9 @@ class PyEngine:
                 self.stats[host.hid, defs.ST_XFER_DONE] += 1
                 self.stats[host.hid, defs.ST_RTT_SUM_US] += delay_us
                 self.stats[host.hid, defs.ST_RTT_COUNT] += 1
+                self._ns_observe(host.hid, self._ns.NS_RTT, delay_us)
+                self._ns_observe(host.hid, self._ns.NS_COMPLETION,
+                                 delay_us)
                 fin = int(cfg[6]) > 0 and host.app_r[1] >= int(cfg[6])
                 if fin:
                     self.stats[host.hid, defs.ST_APP_DONE] += 1
@@ -1438,11 +1461,13 @@ class PyEngine:
     def _tg_finish_transfer(self, host, now, sock):
         node = host.socks[sock]["app_ref"]
         nd = self._tg_node(node)
+        dur_us = max(now - host.socks[sock]["hs_time"], 0) // 1000
         host.socks[sock]["app_ref"] = -1
         self._tcp_close_call(host, now, sock)
         host.app_r[TG.REG_COUNT] += 1
         host.app_r[TG.REG_BYTES] += int(nd[TG.COL_B])
         self.stats[host.hid, defs.ST_XFER_DONE] += 1
+        self._ns_observe(host.hid, self._ns.NS_COMPLETION, dur_us)
         self._tg_walk_succ(host, now, node)
 
     def _app_tgen(self, host, now, wake):

@@ -87,6 +87,12 @@ class SimReport:
     #   window program's captured XLA analysis under "xla" (flops,
     #   bytes_accessed, argument/temp/output bytes — None entries
     #   where the backend refused)
+    network: dict = field(default_factory=dict)  # network observatory
+    #   record (obs.netscope, cfg.netscope runs only): per-kind
+    #   (rtt/completion/queue/retx) bucket counts with exact
+    #   p50/p90/p99 read-outs from the device-side histograms, plus
+    #   the bucket bounds and — when run(netscope=...) streamed a
+    #   JSONL time-series — the record count and path
     hosted: dict = field(default_factory=dict)  # hosted-process exit
     #   report: host name -> {"exit_status", "cause", "sim_ns"} from
     #   the shim supervisor (hosting.runtime.exit_info) — the per-host
@@ -280,6 +286,16 @@ class SimReport:
             s["mem_source"] = self.memory.get("source")
             s["state_bytes_per_host"] = int(
                 self.memory.get("state_bytes_per_host", 0))
+        # network observatory figures (obs.netscope): exact tail
+        # read-outs from the device histograms — the p50/p99 fields
+        # ledger entries and bench lines carry so perf trajectories
+        # can track tail behavior, not just means
+        if self.network:
+            kinds = self.network.get("kinds", {})
+            s["rtt_p50_us"] = kinds.get("rtt", {}).get("p50_us", 0)
+            s["rtt_p99_us"] = kinds.get("rtt", {}).get("p99_us", 0)
+            s["completion_p99_s"] = (
+                kinds.get("completion", {}).get("p99_us", 0) / 1e6)
         # robustness figures appear only when the features were used —
         # keeps the BENCH-diffable section stable for plain runs
         if self.faults:
@@ -730,7 +746,8 @@ class Simulation:
             trace: str = None, metrics: str = None,
             digest: str = None, digest_every: int = 0,
             digest_context: dict = None, digest_rewind: bool = True,
-            resume_unchecked: bool = False) -> SimReport:
+            resume_unchecked: bool = False,
+            netscope: str = None) -> SimReport:
         """Run to the stop time. With `mesh` (a 1-D jax Mesh over a
         "hosts" axis) the window program runs under shard_map with the
         host dimension block-sharded — same results, N chips.
@@ -747,6 +764,15 @@ class Simulation:
         apps (checkpointed runs journal each child's shim protocol
         stream; resume respawns children and fast-forwards them by
         deterministic replay — docs/durability.md).
+
+        `netscope` streams the network observatory's per-chunk
+        time-series (obs.netscope: stat totals/deltas, active
+        connections, histogram deltas) as JSON lines to that path —
+        requires ``EngineConfig.netscope`` (the device histograms are
+        allocated at Simulation construction). With the knob on and no
+        path, records are kept in memory only; either way
+        ``SimReport.network`` carries the exact percentile read-outs
+        and, with metrics enabled, ``net.*`` gauges are published.
 
         `trace` writes a Chrome trace-event JSON timeline (obs.trace:
         per-chunk spans with sim-time args, compile/hosting/tracker/
@@ -842,7 +868,7 @@ class Simulation:
                 checkpoint_keep=checkpoint_keep,
                 resume_from=resume_from, pcap_dir=pcap_dir,
                 resume_unchecked=resume_unchecked,
-                digest_rewind=digest_rewind)
+                digest_rewind=digest_rewind, netscope=netscope)
         finally:
             if own_tr:
                 TR.finish()
@@ -854,7 +880,8 @@ class Simulation:
     def _run_impl(self, verbose, mesh, heartbeat_s, logger,
                   checkpoint_path, checkpoint_every_s, resume_from,
                   pcap_dir, resume_unchecked=False,
-                  checkpoint_keep=0, digest_rewind=True) -> SimReport:
+                  checkpoint_keep=0, digest_rewind=True,
+                  netscope=None) -> SimReport:
         from ..obs import digest as DG
         from ..obs import metrics as MT
         from ..obs import trace as TR
@@ -898,6 +925,22 @@ class Simulation:
             from ..obs.tracker import Tracker
             tracker = Tracker(int(heartbeat_s * 10**9), self.host_names,
                               logger)
+
+        from ..obs import netscope as NSC
+        nsrec = None
+        if self.cfg.netscope:
+            # with the knob on, records always accumulate in memory
+            # (SimReport.network reads them); the path adds the JSONL
+            # stream. Under a multi-process mesh every process samples
+            # (the hist pull is a collective) but only process 0 writes.
+            nsrec = NSC.NetScope(
+                netscope, writer=(not multiproc
+                                  or jax.process_index() == 0))
+        elif netscope:
+            raise ValueError(
+                "run(netscope=...) requires EngineConfig.netscope=True "
+                "(the device histograms are allocated at Simulation "
+                "construction)")
 
         pcap = None
         pcap_on_run = bool(self.cfg.tracecap) and pcap_dir is not None
@@ -1345,6 +1388,23 @@ class Simulation:
                     dev_peak=wm.peak_bytes)
                 if TR.ENABLED:
                     TR.TRACER.complete("tracker.heartbeat", _t0)
+            if nsrec is not None:
+                # network time-series sample: one record per chunk,
+                # derived from device state + sim time only (dual-run
+                # byte-identity). The hist/stats pulls are collectives
+                # under a multi-process mesh — must run uniformly;
+                # active-conn counting reads per-process socket state,
+                # so it is single-process only (like [socket] lines)
+                if TR.ENABLED:
+                    _n0 = TR.TRACER.now()
+                nsrec.sample(
+                    total_windows, min(ws, stop_ns),
+                    np.asarray(dist.gather_stats(hosts.ns_hist))[:H],
+                    np.asarray(dist.gather_stats(hosts.stats))[:H],
+                    conns=(None if multiproc else
+                           int(np.asarray(hosts.sk_used).sum())))
+                if TR.ENABLED:
+                    TR.TRACER.complete("netscope.sample", _n0)
             if checkpoint_path and ckpt_at is not None and ws >= ckpt_at:
                 if TR.ENABLED:
                     _k0 = TR.TRACER.now()
@@ -1505,6 +1565,17 @@ class Simulation:
         else:
             from ..parallel.shard import run_windows_sharded_aot
             xla = run_windows_sharded_aot(cfg, chunk, mesh).analysis
+        # network observatory report (obs.netscope): exact percentile
+        # read-outs from the FINAL device histograms (not the last
+        # sample — a zero-chunk run still reports)
+        network = {}
+        if nsrec is not None:
+            network = NSC.report(
+                np.asarray(dist.gather_stats(hosts.ns_hist))[:H])
+            network["records"] = len(nsrec.records)
+            if nsrec.path:
+                network["path"] = nsrec.path
+            nsrec.close()
         memrec = dict(wm_snap)
         memrec["state_bytes"] = census["bytes"]
         memrec["state_bytes_per_host"] = census["per_host"]
@@ -1519,7 +1590,7 @@ class Simulation:
                            windows=total_windows,
                            heartbeats=(tracker.lines if tracker else []),
                            capacity=capacity, cost=cost,
-                           memory=memrec,
+                           memory=memrec, network=network,
                            hosted=(self.hosting.exit_info()
                                    if self.hosting is not None else {}),
                            faults=(inj.log if inj is not None else []))
@@ -1532,6 +1603,10 @@ class Simulation:
             # section (watermark + census + captured XLA analysis)
             MS.publish(MT.REGISTRY, watermark=wm_snap, census=census,
                        xla=xla)
+            if network:
+                # network observatory gauges -> the metrics.json `net`
+                # section (per-kind counts, percentiles, buckets)
+                NSC.publish(MT.REGISTRY, network)
             if shard_pass_acc is not None and shard_pass_acc.any():
                 # per-shard pass totals + rung mix: which shard went
                 # dense while its peers rode the small rungs — the

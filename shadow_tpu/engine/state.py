@@ -30,6 +30,7 @@ from ..core.simtime import SIMTIME_MAX
 from ..core import constants as C
 from ..net.packet import PKT_WORDS
 from ..net.sack import K as SACK_K
+from ..obs.netscope import NS_BUCKETS, NS_KINDS
 from .defs import N_STATS
 
 
@@ -140,6 +141,13 @@ class EngineConfig:
     #   provably never touches cold columns (simlint STF303 statically;
     #   COLD_WHEN columns hold their alloc defaults in the gating
     #   configs, so the row prototype reads are exact — see row_proto).
+    netscope: bool = False  # network observatory (obs.netscope):
+    #   allocate the per-host latency histograms (ns_hist —
+    #   [H, NS_KINDS, NS_BUCKETS] i64) and count RTT / completion /
+    #   queue-delay / retransmit samples into them inside the jitted
+    #   passes. Off (default) allocates the bucket axis at ZERO, so
+    #   shapes, digests and checkpoints of existing runs are
+    #   untouched and every observe() call is a static no-op.
 
 
 # Digest sections (obs.digest): Hosts field prefix -> the named state
@@ -162,6 +170,7 @@ STATE_SECTIONS = (
     ("hw_", "hosted_wakes"),
     ("tr_", "trace_ring"),
     ("stats", "stats"),
+    ("ns_", "netscope"),
     ("cap_peaks", "stats"),
 )
 
@@ -234,7 +243,7 @@ HOT_FIELDS = (
     "app_node", "app_r", "app_proc", "tgen_sync",
     "ob_pkt", "ob_time", "ob_cnt",
     "hw_time", "hw_pkt", "hw_cnt", "hw_drop",
-    "stats",
+    "stats", "ns_hist",
 )
 
 # Config-gated cold columns (the level-2 split): (guard, fields) —
@@ -288,6 +297,12 @@ COLD_WHEN = (
     # branch); single-process no-TCP configs only ever write the
     # default 0 (sock_alloc stamps app_proc, which is 0 there)
     ("no_tcp_single_proc", ("sk_proc",)),
+    # network observatory off: ns_hist is written only by
+    # obs.netscope.observe, which is a static no-op when the bucket
+    # axis is allocated at zero (cfg.netscope False) — the column is
+    # then zero-size anyway, but gating it keeps the hot-column count
+    # honest for the ledger's config_extras
+    ("netscope_off", ("ns_hist",)),
 )
 
 
@@ -312,6 +327,8 @@ def _guard_holds(guard: str, cfg: "EngineConfig") -> bool:
     if guard == "no_tcp_single_proc":
         return (not cfg.uses_tcp and no_hosted
                 and cfg.procs_per_host <= 1)
+    if guard == "netscope_off":
+        return not cfg.netscope
     raise KeyError(f"unknown COLD_WHEN guard {guard!r}")
 
 
@@ -485,6 +502,11 @@ class Hosts:
     tr_drop: jnp.ndarray   # [H] i32 records lost to ring overflow
     # --- observability ---
     stats: jnp.ndarray     # [H, N_STATS] i64
+    ns_hist: jnp.ndarray   # [H, NSK, NSB] i64 network-observatory
+    #   latency histograms (obs.netscope): per kind (RTT, completion,
+    #   queue delay, retransmit interval), power-of-two µs buckets.
+    #   NSB is NS_BUCKETS with cfg.netscope on, else ZERO — disabled
+    #   runs keep their pre-netscope shapes and digests bit-for-bit.
     cap_peaks: jnp.ndarray  # [H, 4] i32 peak occupancy of the fixed
     #   capacity arrays (0=event queue, 1=socket table, 2=outbox,
     #   3=NIC tx ring) — the TPU analogue of the reference's
@@ -635,6 +657,9 @@ def alloc_hosts(cfg: EngineConfig) -> Hosts:
         tr_cnt=full((H,), 0, jnp.int32),
         tr_drop=full((H,), 0, jnp.int32),
         stats=full((H, N_STATS), 0, jnp.int64),
+        ns_hist=full((H, NS_KINDS,
+                      NS_BUCKETS if cfg.netscope else 0), 0,
+                     jnp.int64),
         cap_peaks=full((H, 4), 0, jnp.int32),
     )
 

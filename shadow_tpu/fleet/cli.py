@@ -121,6 +121,13 @@ def main(argv=None) -> int:
                          "path unless LEDGER given). Resumed "
                          "attempts skip the append, as documented in "
                          "docs/performance.md")
+    ps.add_argument("--netscope", action="store_true",
+                    help="network observatory (obs.netscope): the "
+                         "child streams its per-window network "
+                         "time-series into the run directory "
+                         "(<run>/netscope.jsonl); `fleet status "
+                         "--ensemble` folds the streams into "
+                         "cross-run percentile curves")
     ps.add_argument("--batch", default=None, metavar="GROUP",
                     help="vmapped-batch group (serving.batch): every "
                          "member submitted under GROUP executes in "
@@ -184,6 +191,12 @@ def main(argv=None) -> int:
     pt = sub.add_parser("status", help="fold the journal into a table")
     pt.add_argument("queue")
     pt.add_argument("--json", action="store_true")
+    pt.add_argument("--ensemble", action="store_true",
+                    help="fold the runs' netscope streams "
+                         "(<run>/netscope.jsonl — submit --netscope) "
+                         "into cross-run percentile curves: pooled "
+                         "p50/p90/p99 + per-run tails per kind "
+                         "(obs.netscope.ensemble)")
 
     head, rest = _split_rest(list(argv) if argv is not None
                              else sys.argv[1:])
@@ -216,11 +229,13 @@ def main(argv=None) -> int:
             # silently accepting them here would e.g. drop the user's
             # expected ledger entries without a trace
             if (args.checkpoint_every != 10.0 or args.no_digest
-                    or args.digest_every or args.perf is not None):
+                    or args.digest_every or args.perf is not None
+                    or args.netscope):
                 p.error("--cmd runs execute the command verbatim: "
                         "--checkpoint-every/--no-digest/--digest-every"
-                        "/--perf apply to config runs only (put the "
-                        "equivalent flags in the command itself)")
+                        "/--perf/--netscope apply to config runs only "
+                        "(put the equivalent flags in the command "
+                        "itself)")
             if args.config:
                 rest = [args.config] + rest
             rid = args.id or _auto_id(q, "cmd")
@@ -328,9 +343,16 @@ def main(argv=None) -> int:
                         group_knobs = {
                             "digest": not args.no_digest,
                             "digest_every": int(args.digest_every),
-                            "perf": args.perf, "env": env}
-                        prior_knobs = {k: prior[0].get(k)
-                                       for k in group_knobs}
+                            "perf": args.perf,
+                            "netscope": bool(args.netscope),
+                            "env": env}
+                        prior_knobs = {
+                            # bool-normalized: a pre-netscope journal
+                            # spec has no key at all (None == off)
+                            k: (bool(prior[0].get(k))
+                                if k == "netscope"
+                                else prior[0].get(k))
+                            for k in group_knobs}
                         if prior_knobs != group_knobs:
                             diff = [k for k in group_knobs
                                     if group_knobs[k]
@@ -353,8 +375,8 @@ def main(argv=None) -> int:
                         max_retries=args.max_retries,
                         digest=not args.no_digest,
                         digest_every=args.digest_every,
-                        perf=args.perf, batch=args.batch,
-                        batch_seed=seed)
+                        perf=args.perf, netscope=args.netscope,
+                        batch=args.batch, batch_seed=seed)
                     try:
                         q.submit(spec)
                     except (ValueError, OSError) as e:
@@ -371,7 +393,8 @@ def main(argv=None) -> int:
                 max_retries=args.max_retries,
                 checkpoint_every=args.checkpoint_every,
                 digest=not args.no_digest,
-                digest_every=args.digest_every, perf=args.perf)
+                digest_every=args.digest_every, perf=args.perf,
+                netscope=args.netscope)
         try:
             q.submit(spec)
         except (ValueError, OSError) as e:
@@ -418,6 +441,29 @@ def main(argv=None) -> int:
     q = Queue(args.queue)
     states = q.fold()
     pw = q.prewarm_fold()
+    ens = None
+    if args.ensemble:
+        # fold every run's netscope stream (its last record carries
+        # the run's cumulative histogram) into cross-run curves —
+        # runs without a stream (not submitted --netscope, or not
+        # started yet) are skipped, and named
+        from ..obs import netscope as NSC
+        tables, members, missing = [], [], []
+        for rid in states:
+            path = q.netscope_path(rid)
+            _, recs = (NSC.read_stream(path)
+                       if os.path.exists(path) else ({}, []))
+            if recs:
+                tables.append(recs[-1]["hist"])
+                members.append(rid)
+            else:
+                missing.append(rid)
+        ens = NSC.ensemble(tables)
+        if ens:
+            ens["members"] = members
+        if missing:
+            ens = ens or {}
+            ens["missing"] = missing
     if args.json:
         out = {rid: {**st.spec, "state": st.state,
                      "started": st.started, "crashes": st.crashes,
@@ -432,6 +478,8 @@ def main(argv=None) -> int:
             # records); "_shapes" cannot collide with a run id — the
             # table is keyed by path-safe ids the submitter chose
             out["_shapes"] = pw
+        if ens is not None:
+            out["_ensemble"] = ens
         print(json.dumps(out, indent=1, sort_keys=True))
         return 0
     if not states:
@@ -466,6 +514,20 @@ def main(argv=None) -> int:
                   + (" ".join(members[:6])
                      + (f" +{len(members) - 6}" if len(members) > 6
                         else "")))
+    if ens is not None:
+        if ens.get("kinds"):
+            print(f"ensemble: {ens['runs']} runs "
+                  f"({' '.join(ens['members'])})")
+            for name, k in ens["kinds"].items():
+                lanes = " ".join(str(v) for v in k["lane_p99_us"])
+                print(f"  {name:<12}n={k['count']:<9}"
+                      f"p50={k['p50_us']}us p90={k['p90_us']}us "
+                      f"p99={k['p99_us']}us  per-run p99: {lanes}")
+        else:
+            print("ensemble: no netscope streams (submit with "
+                  "--netscope)")
+        if ens.get("missing"):
+            print("  no stream: " + " ".join(ens["missing"]))
     return 0
 
 

@@ -51,14 +51,17 @@ def make_spec(run_id: str, config: str = None, cmd: list = None,
               rss_mb: int = 0, max_retries: int = 3,
               checkpoint_every: float = 10.0, digest: bool = True,
               digest_every: int = 0, perf: str = None,
-              batch: str = None, batch_seed: int = None) -> dict:
+              netscope: bool = False, batch: str = None,
+              batch_seed: int = None) -> dict:
     """One run spec (a journal ``submit`` payload). Exactly one of
     `config` (scenario XML path — managed durability) and `cmd`
     (arbitrary argv — rerun-from-scratch retries) must be set.
     `hosts`/`rss_mb` are the admission-control weights; `args` extra
     CLI arguments for config runs (seed, faults, engine caps...);
     `perf` non-None appends a per-run perf-ledger entry on completion
-    ("" = the default ledger path). `batch` names a vmapped-batch
+    ("" = the default ledger path); `netscope` streams the child's
+    network observatory time-series into the run directory
+    (obs.netscope — ``fleet status --ensemble`` folds them). `batch` names a vmapped-batch
     group (serving.batch): every member of the group executes in ONE
     child (``python -m shadow_tpu batch``) while keeping its own
     journal state; `batch_seed` is the member's seed in the
@@ -87,6 +90,7 @@ def make_spec(run_id: str, config: str = None, cmd: list = None,
         "digest": bool(digest),
         "digest_every": int(digest_every),
         "perf": perf,
+        "netscope": bool(netscope),
         "batch": batch,
         "batch_seed": batch_seed,
     }
@@ -265,6 +269,9 @@ class Queue:
 
     def digest_path(self, run_id: str) -> str:
         return os.path.join(self.run_dir(run_id), "digest.jsonl")
+
+    def netscope_path(self, run_id: str) -> str:
+        return os.path.join(self.run_dir(run_id), "netscope.jsonl")
 
     def log_path(self, run_id: str) -> str:
         return os.path.join(self.run_dir(run_id), "run.log")

@@ -71,6 +71,9 @@ def build_child_argv(queue: Queue, spec: dict, resume: bool,
     if spec.get("perf") is not None:
         argv += (["--perf", spec["perf"]] if spec["perf"]
                  else ["--perf"])
+    if spec.get("netscope"):
+        argv += ["--netscope",
+                 os.path.abspath(queue.netscope_path(rid))]
     if aot_cache:
         argv += ["--aot-cache", os.path.abspath(aot_cache)]
     if resume:
@@ -132,6 +135,13 @@ def build_batch_argv(queue: Queue, specs: list, python: str = None,
     if specs[0].get("perf") is not None:
         argv += (["--perf", specs[0]["perf"]] if specs[0]["perf"]
                  else ["--perf"])
+    if specs[0].get("netscope"):
+        # per-lane time-series land in each member's run directory,
+        # exactly where an individual run's would (like the digest
+        # chains above)
+        argv += ["--netscope-paths",
+                 ",".join(os.path.abspath(
+                     queue.netscope_path(s["id"])) for s in specs)]
     if aot_cache:
         argv += ["--aot-cache", os.path.abspath(aot_cache)]
     return argv

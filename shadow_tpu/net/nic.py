@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from ..core.rowops import radd, rget, rset, rset_where
 from ..core.simtime import SIMTIME_ONE_SECOND
 from ..engine import equeue
+from ..obs import netscope
 from ..engine.defs import (EV_NIC_TX, EV_PKT, ST_PKTS_SENT, ST_PKTS_DROP_BUF,
                            ST_OUTBOX_DROP, ST_TXQ_DROP)
 from . import packet as P
@@ -208,4 +209,8 @@ def rx_admit(row, hp, now, pkt):
         nic_rx_until=jnp.where(keep, new_until, row.nic_rx_until),
         stats=radd(row.stats, ST_PKTS_DROP_BUF, jnp.where(keep, 0, 1)),
     )
+    # queue-delay distribution: the rx backlog each ADMITTED packet
+    # waits behind (netscope; dropped packets add zero)
+    row = netscope.observe(row, netscope.NS_QUEUE, backlog_ns // 1000,
+                           on=keep)
     return row, keep

@@ -56,6 +56,7 @@ from ..engine.defs import (EV_APP, EV_TCP_TIMER, EV_TCP_CLOSE,
                            WAKE_EOF, WAKE_SENT,
                            ST_BYTES_RECV, ST_BYTES_SENT, ST_RETRANSMIT,
                            ST_SOCK_FAIL, ST_SACK_RENEGE)
+from ..obs import netscope
 from . import congestion as CC
 from . import nic
 from . import packet as P
@@ -386,6 +387,11 @@ def tcp_pull(row, hp, sh, now, slot):
     row = row.replace(stats=radd(radd(row.stats, ST_BYTES_SENT,
                                       fresh_bytes), ST_RETRANSMIT,
                                  jnp.where(is_rex | gbn, 1, 0)))
+    # retransmit-interval distribution: the RTO in force at each
+    # retransmission (netscope; a non-retransmit send adds zero)
+    row = netscope.observe(row, netscope.NS_RETX,
+                           rget(row.sk_rto, slot) // 1000,
+                           on=is_rex | gbn)
     time_it = is_data & ~is_rex & ~gbn & (rget(row.sk_rtt_seq, slot) < 0)
     row = _set(row, slot,
                sk_snd_nxt=jnp.where(is_data & ~is_rex, new_nxt, snd_nxt),

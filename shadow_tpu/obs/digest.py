@@ -88,6 +88,15 @@ def _hash_arrays(arrs: dict, H: int, host_detail: int):
                     if 0 < H <= host_detail else None)
     nbytes = 0
     for name, a in arrs.items():
+        if a.size == 0:
+            # zero-capacity column (a disabled config-gated feature,
+            # e.g. netscope off allocates ns_hist with a zero bucket
+            # axis): skip it entirely — header included — so chains
+            # from disabled runs stay byte-identical to chains
+            # recorded before the column existed. No enabled feature
+            # allocates at zero (rings use max(cap, 1)), so a real
+            # value change can never hide here.
+            continue
         sec = sections.get(section_of(name))
         if sec is None:
             sec = sections[section_of(name)] = hashlib.blake2b(

@@ -151,6 +151,14 @@ def make_entry(scenario: str, fingerprint: str, platform: str,
             e["mem_source"] = summary["mem_source"]
     if summary.get("state_bytes_per_host"):
         e["state_bytes_per_host"] = int(summary["state_bytes_per_host"])
+    # network observatory tail fields (obs.netscope): exact p50/p99
+    # read-outs from the device histograms — present only on
+    # cfg.netscope runs, so perf_regress trajectories can gate tail
+    # behavior (not just means) without touching older entries
+    if "rtt_p50_us" in summary:
+        e["rtt_p50_us"] = int(summary["rtt_p50_us"])
+        e["rtt_p99_us"] = int(summary["rtt_p99_us"])
+        e["completion_p99_s"] = summary.get("completion_p99_s")
     if rep_rates:
         e["rep_rates"] = list(rep_rates)
     if rep_spread is not None:

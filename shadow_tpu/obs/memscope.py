@@ -93,10 +93,13 @@ DTYPE_NAMES = {"i64": "int64", "i32": "int32", "u32": "uint32",
                "f32": "float32", "bool": "bool"}
 
 # constant dims mirrored from their owning modules (pinned by the
-# exactness test): net.packet.PKT_WORDS, net.sack.K, engine.defs.N_STATS
+# exactness test): net.packet.PKT_WORDS, net.sack.K,
+# engine.defs.N_STATS, obs.netscope.NS_KINDS/NS_BUCKETS
 PKT_WORDS = 13
 SACK_K = 4
 N_STATS = 24
+NS_KINDS = 4
+NS_BUCKETS = 32
 
 HOSTS_DIMS = (
     ("eq_time", ("Q",), "i64"),
@@ -179,6 +182,7 @@ HOSTS_DIMS = (
     ("tr_cnt", (), "i32"),
     ("tr_drop", (), "i32"),
     ("stats", ("NST",), "i64"),
+    ("ns_hist", ("NSK", "NSB"), "i64"),
     ("cap_peaks", (4,), "i32"),
 )
 
@@ -223,6 +227,10 @@ def dims_of(cfg=None) -> dict:
         "HW": max(cap("hostedcap", 1), 1),
         "TC": max(cap("tracecap", 0), 1),
         "K": SACK_K, "PKT": PKT_WORDS, "NST": N_STATS,
+        # netscope's bucket axis is zero-capacity when the knob is off
+        # (engine.state.alloc_hosts) — the census must agree
+        "NSK": NS_KINDS,
+        "NSB": NS_BUCKETS if cap("netscope", 0) else 0,
     }
 
 

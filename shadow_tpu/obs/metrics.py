@@ -203,6 +203,14 @@ class Registry:
                     if k.startswith("cost.")}
         if xla_cost:
             memory["cost"] = xla_cost
+        # network view (obs.netscope): per-kind sample counts, exact
+        # percentile read-outs and the non-zero histogram buckets
+        # (``<kind>.bucket.<i>`` families fold into per-index lists,
+        # missing indices None = empty bucket) — assembled like the
+        # perf/memory sections
+        net = _assemble_indexed(
+            {k[len("net."):]: v for k, v in gauges.items()
+             if k.startswith("net.")})
         # fleet view (shadow_tpu.fleet scheduler): queue depth by
         # state plus lifetime start/retry/preempt/watchdog counters —
         # the sweep-health section of a ``fleet run --metrics`` file
@@ -223,6 +231,8 @@ class Registry:
             out["perf"] = perf
         if memory:
             out["memory"] = memory
+        if net:
+            out["net"] = net
         if fleet:
             out["fleet"] = fleet
         return out

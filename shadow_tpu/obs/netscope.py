@@ -1,0 +1,308 @@
+"""Network observatory: device-side latency histograms, a per-window
+network time-series stream, and ensemble percentile curves.
+
+The engine's stats table (``Hosts.stats``, [H, N_STATS] of per-host
+sums) can only ever yield means — the reference Shadow's heartbeats
+and tgen reports carry full distributions, and cross-seed sweeps need
+percentile *curves*, not N separate means. Netscope closes that gap in
+three tiers:
+
+1. **Device-side streaming histograms** — ``Hosts.ns_hist``
+   ([H, NS_KINDS, NS_BUCKETS] i64) counts samples into fixed
+   power-of-two microsecond buckets at the existing measurement sites
+   (the ST_RTT_SUM_US update points, app completion paths, NIC queue
+   admit, TCP retransmit), inside the jitted passes. O(1) work and
+   O(buckets) bytes per host, fully deterministic, and opt-in: with
+   ``EngineConfig.netscope`` off the bucket axis is allocated at ZERO
+   so shapes, digests and checkpoints of existing runs are untouched
+   (``observe`` is a static no-op the compiler never sees).
+
+2. **Per-window time-series** — the :class:`NetScope` recorder samples
+   network health (stat totals + deltas, active connections, histogram
+   deltas) at every window-chunk boundary into a JSONL stream beside
+   the tracker heartbeat. Every value derives from device state and
+   sim time only, so same-seed runs produce byte-identical streams.
+
+3. **Ensemble aggregation** — under ``serving/batch.py`` vmapped lanes
+   the accumulator is [lanes, H, NS_KINDS, NS_BUCKETS] for free;
+   :func:`fold`/:func:`ensemble` reduce any nesting of per-run tables
+   into pooled percentiles, per-lane tails and a CDF curve
+   (``fleet status --ensemble``, ``tools/netreport.py``).
+
+Bucket scheme: integer power-of-two microsecond ladder. Bucket 0 holds
+values < 1 µs, bucket i (1..30) holds [2^(i-1), 2^i) µs, bucket 31 is
+the overflow (>= 2^30 µs ≈ 17.9 min). Bucketing is a comparison count
+against integer bounds — no logs, no floats — so device and host
+agree bit-for-bit on every platform.
+
+Module-level imports are stdlib-only (the memscope convention): tools
+and tests may load this file standalone; jax is imported lazily inside
+:func:`observe`.
+"""
+
+from __future__ import annotations
+
+import json
+
+# kind indices into the ns_hist kind axis (order is the wire format:
+# the JSONL `hist` tables and the metrics `net` section use it)
+NS_RTT = 0         # round-trip / one-way propagation time (µs)
+NS_COMPLETION = 1  # client-observed transfer/fetch completion (µs)
+NS_QUEUE = 2       # NIC rx-queue delay at admit (µs)
+NS_RETX = 3        # RTO in force at each retransmission (µs)
+NS_KINDS = 4
+NS_BUCKETS = 32
+KIND_NAMES = ("rtt", "completion", "queue", "retx")
+
+# power-of-two µs bucket bounds: value v lands in bucket
+# sum(v >= BOUNDS_US) — 31 bounds, 32 buckets, overflow at >= 2^30 µs
+BOUNDS_US = tuple(1 << k for k in range(NS_BUCKETS - 1))
+
+FORMAT = "shadow_tpu.netscope.v1"
+
+
+def observe(row, kind: int, value_us, on=True):
+    """Count one sample into ``row.ns_hist[kind]`` inside a jitted
+    row handler. ``value_us`` is an integer (or traced i64) number of
+    microseconds; ``on`` may be a traced predicate — a False sample
+    adds zero (the increment happens either way, keeping the pass
+    shape fixed). With the netscope knob off the bucket axis has zero
+    capacity and this returns ``row`` untouched — a *static* no-op, so
+    disabled runs compile the exact pre-netscope program."""
+    if row.ns_hist.shape[-1] == 0:
+        return row
+    import jax.numpy as jnp
+    v = jnp.asarray(value_us, jnp.int64)
+    idx = jnp.sum((v >= jnp.asarray(BOUNDS_US, jnp.int64))
+                  .astype(jnp.int32))
+    inc = jnp.where(on, jnp.int64(1), jnp.int64(0))
+    return row.replace(ns_hist=row.ns_hist.at[kind, idx].add(inc))
+
+
+def bucket_of(value_us: int) -> int:
+    """Host-side mirror of the device bucketing (pyengine, tests):
+    same integer ladder, same answer for every value."""
+    v = int(value_us)
+    if v <= 0:
+        return 0
+    return min(v.bit_length(), NS_BUCKETS - 1)
+
+
+def bucket_edge_us(i: int) -> int:
+    """Upper edge of bucket i in µs (the overflow bucket reports the
+    saturated edge 2^31 — consumers treat it as 'off the ladder')."""
+    return 1 << min(int(i), NS_BUCKETS - 1)
+
+
+def _tolist(h):
+    return h.tolist() if hasattr(h, "tolist") else h
+
+
+def _add(a, b):
+    if isinstance(a, list):
+        return [_add(x, y) for x, y in zip(a, b)]
+    return a + b
+
+
+def fold(hist):
+    """Sum any leading axes of a histogram down to one
+    [NS_KINDS][NS_BUCKETS] table of ints: accepts [K][B] (already a
+    table), [H][K][B] (one run's per-host device state), [N][K][B]
+    (per-run tables) or [L][H][K][B] (vmapped lanes) — pure python,
+    works on numpy/jax arrays (via tolist) and nested lists alike."""
+    h = _tolist(hist)
+    if not h or not h[0]:
+        return []
+    while h[0] and isinstance(h[0][0], list):
+        acc = h[0]
+        for t in h[1:]:
+            acc = _add(acc, t)
+        h = acc
+    return [[int(c) for c in r] for r in h]
+
+
+def percentile(counts, q: int) -> int:
+    """Exact percentile read-out from one bucket row: the upper edge
+    (µs) of the smallest bucket whose cumulative count reaches
+    ceil(q/100 · N). Pure integer math — no interpolation, so two
+    hosts computing it from the same counts always agree. Returns 0
+    for an empty row."""
+    counts = [int(c) for c in counts]
+    n = sum(counts)
+    if n <= 0:
+        return 0
+    rank = max(1, -((-n * q) // 100))
+    acc = 0
+    for i, c in enumerate(counts):
+        acc += c
+        if acc >= rank:
+            return 1 << i
+    return 1 << (NS_BUCKETS - 1)
+
+
+def kind_summary(counts) -> dict:
+    """One kind's headline figures + raw buckets."""
+    counts = [int(c) for c in counts]
+    return {
+        "count": sum(counts),
+        "p50_us": percentile(counts, 50),
+        "p90_us": percentile(counts, 90),
+        "p99_us": percentile(counts, 99),
+        "buckets": counts,
+    }
+
+
+def report(hist) -> dict:
+    """SimReport.network payload from a final histogram (any nesting
+    :func:`fold` accepts)."""
+    table = fold(hist)
+    if not table:
+        return {}
+    return {
+        "bounds_us": list(BOUNDS_US),
+        "kinds": {name: kind_summary(table[k])
+                  for k, name in enumerate(KIND_NAMES)},
+    }
+
+
+def ensemble(tables) -> dict:
+    """Cross-run (or cross-lane) percentile curves: pooled
+    distribution + per-run tails per kind. ``tables`` is a list of
+    per-run histograms (each any nesting :func:`fold` accepts)."""
+    tables = [fold(t) for t in tables]
+    tables = [t for t in tables if t]
+    if not tables:
+        return {}
+    pooled = fold(tables)
+    out = {"runs": len(tables), "bounds_us": list(BOUNDS_US),
+           "kinds": {}}
+    for k, name in enumerate(KIND_NAMES):
+        tot = sum(pooled[k])
+        cum, cdf = 0, []
+        for c in pooled[k]:
+            cum += c
+            cdf.append(round(cum / tot, 6) if tot else 0.0)
+        out["kinds"][name] = {
+            "count": tot,
+            "p50_us": percentile(pooled[k], 50),
+            "p90_us": percentile(pooled[k], 90),
+            "p99_us": percentile(pooled[k], 99),
+            "lane_p50_us": [percentile(t[k], 50) for t in tables],
+            "lane_p99_us": [percentile(t[k], 99) for t in tables],
+            "cdf": cdf,
+            "buckets": pooled[k],
+        }
+    return out
+
+
+class NetScope:
+    """Per-window network time-series recorder.
+
+    Fed at every window-chunk boundary with the current cumulative
+    device state; keeps records in memory (``.records``) and, given a
+    path, streams them as JSON lines (compact, sorted keys — the
+    dual-run byte-identity contract). The first line is a header
+    carrying the format tag, kind names and bucket bounds so the
+    stream is self-describing."""
+
+    def __init__(self, path: str | None = None, writer: bool = True):
+        from ..engine import defs as _d
+        self._stat_cols = (
+            ("events", _d.ST_EVENTS),
+            ("pkts_sent", _d.ST_PKTS_SENT),
+            ("pkts_recv", _d.ST_PKTS_RECV),
+            ("bytes_sent", _d.ST_BYTES_SENT),
+            ("bytes_recv", _d.ST_BYTES_RECV),
+            ("retransmits", _d.ST_RETRANSMIT),
+            ("drop_net", _d.ST_PKTS_DROP_NET),
+            ("drop_buf", _d.ST_PKTS_DROP_BUF),
+            ("xfers_done", _d.ST_XFER_DONE),
+        )
+        self.path = path if writer else None
+        self.records = []
+        self._prev_tot = None
+        self._prev_hist = None
+        self._last_table = None
+        self._fh = None
+        if self.path:
+            self._fh = open(self.path, "w")
+            self._write({"format": FORMAT, "kinds": list(KIND_NAMES),
+                         "bounds_us": list(BOUNDS_US)})
+
+    def _write(self, obj: dict):
+        self._fh.write(json.dumps(obj, sort_keys=True,
+                                  separators=(",", ":")) + "\n")
+        self._fh.flush()
+
+    def sample(self, window: int, sim_ns: int, hist, stats,
+               conns: int | None = None):
+        """One chunk-boundary sample. ``hist`` is the cumulative
+        device histogram (any :func:`fold` nesting), ``stats`` the
+        cumulative [H, N_STATS] table; both arrive as numpy. Every
+        emitted value is cumulative-or-delta of device state — no
+        wall-clock anywhere, by contract."""
+        table = fold(hist)
+        tot = {name: int(stats[:, col].sum())
+               for name, col in self._stat_cols}
+        prev_t = self._prev_tot or {k: 0 for k in tot}
+        prev_h = (self._prev_hist or
+                  [[0] * len(r) for r in table])
+        rec = {
+            "window": int(window),
+            "sim_ns": int(sim_ns),
+            "totals": tot,
+            "delta": {k: tot[k] - prev_t[k] for k in tot},
+            "hist": table,
+            "hist_delta": [[a - b for a, b in zip(ra, rb)]
+                           for ra, rb in zip(table, prev_h)],
+        }
+        if conns is not None:
+            rec["conns"] = int(conns)
+        self._prev_tot, self._prev_hist = tot, table
+        self._last_table = table
+        self.records.append(rec)
+        if self._fh:
+            self._write(rec)
+
+    def summary(self) -> dict:
+        """:func:`report` of the latest sampled histogram."""
+        return report(self._last_table) if self._last_table else {}
+
+    def close(self):
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+def read_stream(path: str) -> tuple[dict, list]:
+    """Parse a netscope JSONL stream -> (header, records). Tolerates a
+    missing header (synthesizes one) so partial streams still fold."""
+    header = {"format": FORMAT, "kinds": list(KIND_NAMES),
+              "bounds_us": list(BOUNDS_US)}
+    records = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            if "format" in obj:
+                header = obj
+            else:
+                records.append(obj)
+    return header, records
+
+
+def publish(registry, network: dict):
+    """Publish a :func:`report` payload as ``net.*`` gauges — the
+    metrics.json ``net`` section (obs.metrics assembles the
+    ``bucket.<i>`` families back into lists via _assemble_indexed,
+    parity with the perf/memory sections)."""
+    for name, k in (network or {}).get("kinds", {}).items():
+        registry.gauge(f"net.{name}.count").set(int(k["count"]))
+        registry.gauge(f"net.{name}.p50_us").set(int(k["p50_us"]))
+        registry.gauge(f"net.{name}.p90_us").set(int(k["p90_us"]))
+        registry.gauge(f"net.{name}.p99_us").set(int(k["p99_us"]))
+        for i, c in enumerate(k.get("buckets", ())):
+            if c:
+                registry.gauge(f"net.{name}.bucket.{i}").set(int(c))

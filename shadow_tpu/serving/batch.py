@@ -99,7 +99,7 @@ def check_same_shape(sims) -> None:
 
 
 def run_batch(sims, names=None, digest_paths=None, digest_every=0,
-              verbose=False):
+              netscope_paths=None, verbose=False):
     """Run N same-shape Simulations as one vmapped program.
 
     `digest_paths` (optional, len N) gives each lane its own digest
@@ -109,6 +109,15 @@ def run_batch(sims, names=None, digest_paths=None, digest_every=0,
     individually, so the chains are byte-comparable with
     tools/divergence.py. Returns a list of SimReport, one per lane
     (wall_seconds is the SHARED batch wall — ledger entries say so).
+
+    When the shared config carries ``netscope``, each lane gets its
+    own :class:`obs.netscope.NetScope` recorder sampled on its own
+    chunk boundaries (frozen lanes stop sampling, like a single run
+    stopping) and its SimReport carries a per-lane ``network`` report
+    from its slice of the stacked [lanes, H, K, B] accumulator —
+    byte-equal to the same scenario's individual run.
+    `netscope_paths` (optional, len N) streams each lane's records to
+    its own JSONL file.
     """
     import jax
     import jax.numpy as jnp
@@ -119,6 +128,7 @@ def run_batch(sims, names=None, digest_paths=None, digest_every=0,
     from ..engine.window import (pass_labels, run_windows_batch_aot,
                                  sparse_batch)
     from ..obs import digest as DG
+    from ..obs import netscope as NSC
 
     B = len(sims)
     assert B >= 1
@@ -128,6 +138,19 @@ def run_batch(sims, names=None, digest_paths=None, digest_every=0,
         assert not s._ran, "Simulation objects are single-use"
         s._ran = True
     names = list(names or [f"member{i}" for i in range(B)])
+
+    nsrecs = None
+    if cfg.netscope:
+        if netscope_paths is not None:
+            assert len(netscope_paths) == B
+        nsrecs = [NSC.NetScope(netscope_paths[i]
+                               if netscope_paths is not None else None)
+                  for i in range(B)]
+    elif netscope_paths is not None:
+        raise BatchShapeError(
+            "netscope_paths given but the members' EngineConfig has "
+            "netscope off — the device histograms are a compiled "
+            "shape, so enable it on every member")
 
     recorders = None
     if digest_paths is not None:
@@ -220,9 +243,22 @@ def run_batch(sims, names=None, digest_paths=None, digest_every=0,
         if first_chunk_wall is None:
             first_chunk_wall = time.perf_counter() - wall0
         w_np = np.asarray(ws)
+        if nsrecs is not None:
+            # per-lane network samples from the stacked accumulator:
+            # one record per chunk a lane was ACTIVE in (a frozen
+            # lane's carry no longer moves — sampling it would add
+            # records a single run never emits)
+            ns_b = np.asarray(hosts.ns_hist)
+            st_b = np.asarray(hosts.stats)
+            sk_b = np.asarray(hosts.sk_used)
         for i in range(B):
             if done[i]:
                 continue
+            if nsrecs is not None:
+                nsrecs[i].sample(
+                    int(total_windows[i]),
+                    min(int(w_np[i]), int(stops[i])),
+                    ns_b[i], st_b[i], conns=int(sk_b[i].sum()))
             # the single-run record order, per lane: cadence when due
             # after the chunk, then the final record when the lane
             # completes — so chains byte-match individual runs
@@ -250,6 +286,8 @@ def run_batch(sims, names=None, digest_paths=None, digest_every=0,
             and wall > first_chunk_wall * 1.05 else None)
     stats_b = np.asarray(hosts.stats)
     peaks_b = np.asarray(hosts.cap_peaks)
+    ns_final = (np.asarray(hosts.ns_hist)
+                if nsrecs is not None else None)
     reports = []
     for i in range(B):
         w = int(np.asarray(ws)[i])
@@ -274,11 +312,21 @@ def run_batch(sims, names=None, digest_paths=None, digest_every=0,
             "hbm_peak_gbps": float(os.environ.get(
                 "SHADOW_TPU_HBM_GBPS", "819")),
         }
+        network = {}
+        if nsrecs is not None:
+            # per-lane network report from this lane's slice of the
+            # FINAL device histogram (not the last sample — the exact
+            # construction engine.sim uses)
+            network = NSC.report(ns_final[i])
+            network["records"] = len(nsrecs[i].records)
+            if nsrecs[i].path:
+                network["path"] = nsrecs[i].path
+            nsrecs[i].close()
         reports.append(SimReport(
             stats=stats_b[i], host_names=sims[i].host_names,
             sim_time_ns=sim_ns, wall_seconds=wall,
             windows=int(total_windows[i]), capacity=capacity,
-            cost=cost))
+            cost=cost, network=network))
     return reports
 
 
@@ -310,6 +358,22 @@ def main(argv=None) -> int:
                         "directory)")
     p.add_argument("--digest-every", type=int, default=0,
                    metavar="WINDOWS")
+    p.add_argument("--netscope", action="store_true",
+                   help="network observatory (obs.netscope): device "
+                        "histograms per lane, a per-lane network "
+                        "report in each member's summary, and one "
+                        "cross-lane ensemble JSON line (pooled + "
+                        "per-lane percentiles)")
+    p.add_argument("--netscope-dir", default=None, metavar="DIR",
+                   help="per-member netscope time-series streams: "
+                        "DIR/<member>.netscope.jsonl (implies "
+                        "--netscope)")
+    p.add_argument("--netscope-paths", default=None,
+                   metavar="P1,P2,...",
+                   help="explicit per-member netscope stream paths "
+                        "(comma-separated, member order; the fleet "
+                        "worker points these at each member's run "
+                        "directory — implies --netscope)")
     p.add_argument("--aot-cache", default=None, metavar="DIR",
                    help="persistent AOT executable cache "
                         "(docs/serving.md)")
@@ -369,6 +433,14 @@ def main(argv=None) -> int:
             scen.seed = seed
         from ..engine.sim import Simulation
         sim = Simulation(scen)
+        if args.netscope or args.netscope_dir or args.netscope_paths:
+            # the device histograms are part of the compiled shape, so
+            # the knob must be set before Hosts allocation — rebuild
+            # with the auto config flipped (topology is reused)
+            import dataclasses
+            sim = Simulation(scen, topology=sim.topo,
+                             engine_cfg=dataclasses.replace(
+                                 sim.cfg, netscope=True))
         if args.runahead:
             import jax.numpy as jnp
             ra = parse_time(args.runahead, default_unit="ms")
@@ -388,10 +460,24 @@ def main(argv=None) -> int:
                                      f"{n}.digest.jsonl")
                         for n in names]
 
+    netscope_paths = None
+    if args.netscope_paths:
+        netscope_paths = [s for s in args.netscope_paths.split(",")
+                          if s]
+        if len(netscope_paths) != len(sims):
+            p.error(f"--netscope-paths names {len(netscope_paths)} "
+                    f"paths for {len(sims)} members")
+    elif args.netscope_dir:
+        os.makedirs(args.netscope_dir, exist_ok=True)
+        netscope_paths = [os.path.join(args.netscope_dir,
+                                       f"{n}.netscope.jsonl")
+                          for n in names]
+
     try:
         reports = run_batch(sims, names=names,
                             digest_paths=digest_paths,
                             digest_every=args.digest_every,
+                            netscope_paths=netscope_paths,
                             verbose=args.verbose)
     except BatchShapeError as e:
         p.error(str(e))
@@ -409,6 +495,21 @@ def main(argv=None) -> int:
         print(json.dumps(line), flush=True)
         if args.summary_json:
             print(json.dumps(s), flush=True)
+
+    if args.netscope or args.netscope_dir or args.netscope_paths:
+        # cross-lane percentile curves: pooled distribution + per-lane
+        # tails per kind, from the lanes' final device histograms
+        from ..obs import netscope as NSC
+        ens = NSC.ensemble([
+            [r.network["kinds"][n]["buckets"] for n in NSC.KIND_NAMES]
+            for r in reports if r.network.get("kinds")])
+        print(json.dumps({"netscope_ensemble": {
+            "runs": ens.get("runs", 0),
+            "kinds": {name: {f: k[f] for f in
+                             ("count", "p50_us", "p90_us", "p99_us",
+                              "lane_p50_us", "lane_p99_us")}
+                      for name, k in ens.get("kinds", {}).items()},
+        }}), flush=True)
 
     if args.perf is not None:
         import jax

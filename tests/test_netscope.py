@@ -1,0 +1,339 @@
+"""Network observatory (obs.netscope) acceptance tests.
+
+The contract under test (docs/observability.md "Network
+observatory"):
+
+- the device histograms are EXACT: bit-equal to the pure-Python
+  reference engine recounting the same samples on the differential
+  scenarios (the same oracle the stats table answers to);
+- observation never perturbs simulation: a netscope run's
+  non-netscope digest sections are byte-equal to the same seed run
+  with the knob off, and same-seed netscope runs are byte-identical
+  end to end (digest chain AND the JSONL time-series);
+- vmapped batch lanes are exactly their individual runs: per-lane
+  reports and per-lane JSONL streams byte-match, and the cross-lane
+  ensemble pools them;
+- the host-side math (bucket ladder, exact percentiles, fold,
+  ensemble) agrees with the device bucketing;
+- the heartbeat/stream tooling round-trips (tools/parse_heartbeat.py
+  columns == obs.tracker line schema, rss=/dev= and netscope CSV).
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+
+from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario
+from shadow_tpu.engine import defs
+from shadow_tpu.engine.pyengine import PyEngine
+from shadow_tpu.engine.sim import Simulation
+from shadow_tpu.engine.state import EngineConfig, hot_fields
+from shadow_tpu.obs import netscope as NS
+
+from conftest import SIMPLE_TOPOLOGY
+from test_differential import CFG, _bulk_scen
+
+NCFG = dict(CFG, netscope=True)
+
+
+def _bulk_cfg(netscope=True):
+    return EngineConfig(num_hosts=2, **(NCFG if netscope else CFG))
+
+
+def _bulk():
+    # lossy TCP bulk: populates completion (app), queue (NIC admit)
+    # and retx (RTO) — the richest single differential shape
+    return _bulk_scen(loss=0.05, size=120_000, count=2, stop=60)()
+
+
+def _ping():
+    return Scenario(
+        stop_time=8 * 10**9,
+        topology_graphml=SIMPLE_TOPOLOGY,
+        hosts=[
+            HostSpec(id="srv", processes=[
+                ProcessSpec(plugin="pingserver", start_time=10**9,
+                            arguments="port=8000")]),
+            HostSpec(id="cli", processes=[
+                ProcessSpec(plugin="ping", start_time=2 * 10**9,
+                            arguments="peer=srv port=8000 "
+                                      "interval=700ms size=96 "
+                                      "count=6")]),
+        ],
+    )
+
+
+# --- host-side math (no engine) --------------------------------------
+
+
+def test_bucket_ladder_host_equals_device_rule():
+    # the device bucketing is sum(v >= bounds); bucket_of must agree
+    # on every edge and both sides of it
+    for v in (0, 1, 2, 3, 4, 1023, 1024, 1025, 1500,
+              (1 << 30) - 1, 1 << 30, 1 << 40):
+        assert NS.bucket_of(v) == sum(v >= b for b in NS.BOUNDS_US), v
+    assert len(NS.BOUNDS_US) == NS.NS_BUCKETS - 1
+    assert NS.bucket_edge_us(0) == 1
+    assert NS.bucket_edge_us(11) == 2048
+    assert NS.bucket_edge_us(NS.NS_BUCKETS - 1) == 1 << 31
+
+
+def test_percentile_exact_ranks():
+    row = [0] * NS.NS_BUCKETS
+    row[5] = 99
+    row[20] = 1
+    assert NS.percentile(row, 50) == 1 << 5
+    assert NS.percentile(row, 99) == 1 << 5     # rank 99 of 100
+    assert NS.percentile(row, 100) == 1 << 20
+    assert NS.percentile([0] * NS.NS_BUCKETS, 99) == 0
+    s = NS.kind_summary(row)
+    assert s["count"] == 100 and s["p99_us"] == 1 << 5
+
+
+def test_fold_and_ensemble():
+    t = [[(i + 1) * (j + 1) for j in range(NS.NS_BUCKETS)]
+         for i in range(NS.NS_KINDS)]
+    assert NS.fold(t) == t
+    assert NS.fold([t, t, t]) == [[3 * c for c in r] for r in t]
+    assert NS.fold([[t], [t]]) == [[2 * c for c in r] for r in t]
+    a = [[0] * NS.NS_BUCKETS for _ in range(NS.NS_KINDS)]
+    b = [[0] * NS.NS_BUCKETS for _ in range(NS.NS_KINDS)]
+    a[NS.NS_RTT][3] = 5
+    b[NS.NS_RTT][9] = 5
+    ens = NS.ensemble([a, b])
+    r = ens["kinds"]["rtt"]
+    assert r["count"] == 10
+    assert r["lane_p99_us"] == [1 << 3, 1 << 9]
+    assert abs(r["cdf"][-1] - 1.0) < 1e-9
+    assert ens["runs"] == 2
+
+
+# --- state contract ---------------------------------------------------
+
+
+def test_netscope_knob_is_shape_and_hot_set():
+    on, off = _bulk_cfg(True), _bulk_cfg(False)
+    ha = Simulation(_bulk(), engine_cfg=on).hosts
+    hb = Simulation(_bulk(), engine_cfg=off).hosts
+    assert ha.ns_hist.shape == (2, NS.NS_KINDS, NS.NS_BUCKETS)
+    assert hb.ns_hist.shape == (2, NS.NS_KINDS, 0)
+    assert "ns_hist" in hot_fields(on)
+    assert "ns_hist" not in hot_fields(off)
+
+
+# --- exactness vs the reference engine --------------------------------
+
+
+def test_device_hist_equals_pyengine_bulk():
+    cfg = _bulk_cfg()
+    rep = Simulation(_bulk(), engine_cfg=cfg).run()
+    py = PyEngine(Simulation(_bulk(), engine_cfg=cfg))
+    py.run()
+    # the run's report reads the FINAL device histograms; the
+    # reference engine recounts the same samples in pure Python —
+    # every kind, every bucket, bit-equal
+    ref = NS.fold(py.ns_hist.tolist())
+    dev = [rep.network["kinds"][n]["buckets"]
+           for n in NS.KIND_NAMES]
+    assert dev == ref, (
+        f"device {[sum(r) for r in dev]} != "
+        f"pyengine {[sum(r) for r in ref]}")
+    # something actually happened in every expected kind
+    per_kind = [sum(r) for r in dev]
+    assert per_kind[NS.NS_COMPLETION] == 2     # count=2 transfers
+    assert per_kind[NS.NS_QUEUE] > 0
+    assert per_kind[NS.NS_RETX] > 0            # 5% loss forces RTOs
+    k = rep.network["kinds"]
+    assert k["queue"]["count"] == per_kind[NS.NS_QUEUE]
+    s = rep.summary()
+    assert s["rtt_p50_us"] == k["rtt"]["p50_us"]
+    assert s["completion_p99_s"] == k["completion"]["p99_us"] / 1e6
+
+
+def test_device_hist_equals_pyengine_ping_rtt():
+    cfg = _bulk_cfg()
+    rep = Simulation(_ping(), engine_cfg=cfg).run()
+    py = PyEngine(Simulation(_ping(), engine_cfg=cfg))
+    py.run()
+    dev = [rep.network["kinds"][n]["buckets"]
+           for n in NS.KIND_NAMES]
+    assert dev == NS.fold(py.ns_hist.tolist())
+    # 6 echoes: each is an RTT sample and a completion sample
+    assert sum(dev[NS.NS_RTT]) == 6
+    assert sum(dev[NS.NS_COMPLETION]) == 6
+
+
+# --- determinism and non-perturbation ---------------------------------
+
+
+def test_same_seed_runs_byte_identical(tmp_path):
+    outs = []
+    for tag in ("a", "b"):
+        dg = tmp_path / f"{tag}.digest.jsonl"
+        ns = tmp_path / f"{tag}.netscope.jsonl"
+        mt = tmp_path / f"{tag}.metrics.json"
+        Simulation(_bulk(), engine_cfg=_bulk_cfg()).run(
+            digest=str(dg), netscope=str(ns), metrics=str(mt))
+        outs.append((dg.read_bytes(), ns.read_bytes(),
+                     json.loads(mt.read_text())))
+    assert outs[0][0] == outs[1][0], "digest chains differ"
+    assert outs[0][1] == outs[1][1], "netscope streams differ"
+    # the metrics net section is assembled and identical
+    net = outs[0][2]["net"]
+    assert net == outs[1][2]["net"]
+    assert net["completion.count"] == 2
+    assert isinstance(net["queue.bucket"], list)
+    # the stream is self-describing and cumulative
+    header, recs = NS.read_stream(str(tmp_path / "a.netscope.jsonl"))
+    assert header["format"] == NS.FORMAT
+    assert header["kinds"] == list(NS.KIND_NAMES)
+    assert recs, "no chunk records"
+    assert recs[-1]["hist"][NS.NS_COMPLETION][
+        NS.bucket_of(1)] >= 0     # table shape holds
+    tot = [sum(r) for r in recs[-1]["hist"]]
+    assert tot[NS.NS_COMPLETION] == 2
+
+
+def test_observation_does_not_perturb_digest(tmp_path):
+    chains = {}
+    for on in (True, False):
+        p = tmp_path / f"ns-{on}.digest.jsonl"
+        Simulation(_bulk(), engine_cfg=_bulk_cfg(on)).run(
+            digest=str(p))
+        chains[on] = [json.loads(line)
+                      for line in p.read_text().splitlines()
+                      if "sections" in line]
+    on_recs = [r for r in chains[True] if "sections" in r]
+    off_recs = [r for r in chains[False] if "sections" in r]
+    assert len(on_recs) == len(off_recs)
+    for a, b in zip(on_recs, off_recs):
+        assert a["window"] == b["window"]
+        sa = dict(a["sections"])
+        sb = dict(b["sections"])
+        # the netscope section exists exactly when the knob is on...
+        assert "netscope" in sa and "netscope" not in sb
+        del sa["netscope"]
+        # ...and every OTHER section hash is byte-equal: observing
+        # the run did not change a single simulated byte
+        assert sa == sb, (a["window"], sa, sb)
+
+
+# --- vmapped ensemble --------------------------------------------------
+
+
+def test_batch_lanes_equal_individual_runs(tmp_path):
+    from shadow_tpu.serving.batch import run_batch
+
+    cfg = _bulk_cfg()
+    seeds = [11, 12, 13, 14]
+
+    def mk(seed):
+        scen = _bulk()
+        scen.seed = seed
+        return Simulation(scen, engine_cfg=cfg)
+
+    paths = [str(tmp_path / f"lane{s}.netscope.jsonl")
+             for s in seeds]
+    reports = run_batch([mk(s) for s in seeds],
+                        names=[f"s{s}" for s in seeds],
+                        netscope_paths=paths)
+    for s, rep, p in zip(seeds, reports, paths):
+        ind = tmp_path / f"ind{s}.netscope.jsonl"
+        ind_rep = mk(s).run(netscope=str(ind))
+        assert rep.network["kinds"] == ind_rep.network["kinds"], s
+        assert (open(p, "rb").read() == ind.read_bytes()), (
+            f"lane {s} stream != individual run stream")
+    # cross-lane ensemble pools the lanes exactly
+    ens = NS.ensemble([
+        [r.network["kinds"][n]["buckets"] for n in NS.KIND_NAMES]
+        for r in reports])
+    assert ens["runs"] == len(seeds)
+    assert (ens["kinds"]["completion"]["count"]
+            == sum(r.network["kinds"]["completion"]["count"]
+                   for r in reports))
+    assert len(ens["kinds"]["rtt"]["lane_p99_us"]) == len(seeds)
+
+
+# --- ledger tail fields ------------------------------------------------
+
+
+def test_ledger_entry_carries_tails():
+    from shadow_tpu.obs import ledger as LG
+    e = LG.make_entry(
+        "x", "0" * 16, "cpu",
+        {"events": 1, "wall_seconds": 1.0, "events_per_sec": 1.0,
+         "rtt_p50_us": 8, "rtt_p99_us": 4096,
+         "completion_p99_s": 2.5})
+    assert (e["rtt_p50_us"], e["rtt_p99_us"],
+            e["completion_p99_s"]) == (8, 4096, 2.5)
+    e2 = LG.make_entry(
+        "x", "0" * 16, "cpu",
+        {"events": 1, "wall_seconds": 1.0, "events_per_sec": 1.0})
+    assert "rtt_p50_us" not in e2
+
+
+# --- tooling round-trips -----------------------------------------------
+
+
+def _tool(name):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        f"_{name}", os.path.join(repo, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_parse_heartbeat_matches_tracker_schema():
+    from shadow_tpu.obs import tracker
+    ph = _tool("parse_heartbeat")
+    # the CSV columns ARE the tracker's [node] schema, including the
+    # covered-interval column PR 15 added
+    assert [f.replace("_", "-") for f in ph.FIELDS] == \
+        tracker.HEADER.split(",")
+    rows = ph.node_rows([
+        "x [shadow-heartbeat] [node] 3,cli,1,7,2,1,0,64,0,0,0,0",
+        "unrelated line"])
+    assert rows == [["3", "cli", "1", "7", "2", "1", "0", "64",
+                     "0", "0", "0", "0"]]
+    # [ram] rows: optional rss= / dev= suffixes become fixed columns
+    rows = ph.ram_rows([
+        "x [shadow-heartbeat] [ram] 3,cli,10,0,10,1",
+        "x [shadow-heartbeat] [ram] 4,cli,0,5,5,1,rss=777",
+        "x [shadow-heartbeat] [ram] 5,cli,0,0,5,1,rss=778,dev=999",
+    ])
+    assert [r[len(r) - 2:] for r in rows] == [
+        ["", ""], ["777", ""], ["778", "999"]]
+    assert rows[2][:6] == ["5", "cli", "0", "0", "5", "1"]
+
+
+def test_parse_heartbeat_netscope_roundtrip(tmp_path):
+    ph = _tool("parse_heartbeat")
+    rec = NS.NetScope(str(tmp_path / "s.jsonl"))
+    hist = np.zeros((2, NS.NS_KINDS, NS.NS_BUCKETS), np.int64)
+    stats = np.zeros((2, defs.N_STATS), np.int64)
+    hist[0, NS.NS_RTT, 5] = 4
+    stats[:, defs.ST_EVENTS] = 10
+    rec.sample(8, 10**9, hist, stats, conns=3)
+    hist[1, NS.NS_RTT, 9] = 4
+    stats[:, defs.ST_EVENTS] = 25
+    rec.sample(16, 2 * 10**9, hist, stats, conns=1)
+    rec.close()
+    fields, rows = ph.netscope_rows(str(tmp_path / "s.jsonl"))
+    assert fields[:2] == ["window", "time"]
+    assert "rtt_p99_us" in fields
+    by = [dict(zip(fields, r)) for r in rows]
+    assert by[0]["window"] == 8 and by[1]["window"] == 16
+    assert by[0]["d_events"] == 20        # first delta is the total
+    assert by[1]["d_events"] == 30
+    assert by[0]["rtt_n"] == 4 and by[1]["rtt_n"] == 8
+    assert by[0]["rtt_p99_us"] == 1 << 5
+    assert by[1]["rtt_p99_us"] == 1 << 9  # pooled tail moved up
+
+
+def test_netreport_self_check():
+    nr = _tool("netreport")
+    assert nr.self_check() == 0

@@ -108,12 +108,16 @@ def test_repo_scan_is_clean(repo_matrix):
 
 def test_hand_derived_nic_rx_admit(repo_matrix):
     """nic.rx_admit (net/nic.py): reads the rx busy horizon, rolls
-    the backlog against the buffer, counts drops. Derived by hand
+    the backlog against the buffer, counts drops, and observes the
+    queue delay into the netscope histogram (obs.netscope.observe —
+    the analyzer must follow the cross-module call). Derived by hand
     from the function body — stateflow must reproduce it exactly."""
     matrix, _ = repo_matrix
     acc = matrix["nic.rx_admit"]
-    assert sorted(acc["hosts"]["reads"]) == ["nic_rx_until", "stats"]
-    assert sorted(acc["hosts"]["writes"]) == ["nic_rx_until", "stats"]
+    assert sorted(acc["hosts"]["reads"]) == [
+        "nic_rx_until", "ns_hist", "stats"]
+    assert sorted(acc["hosts"]["writes"]) == [
+        "nic_rx_until", "ns_hist", "stats"]
     assert sorted(acc["hp"]["reads"]) == ["bw_down", "nic_buf"]
     assert acc["sh"]["reads"] == {}
 

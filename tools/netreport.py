@@ -1,0 +1,207 @@
+#!/usr/bin/env python3
+"""netreport: fold netscope time-series streams into ensemble
+percentile curves.
+
+A sweep leaves one network observatory stream per run
+(``<run>/netscope.jsonl`` — ``fleet submit --netscope``, ``python -m
+shadow_tpu CONF --netscope FILE``, or ``batch --netscope-dir``). Each
+stream's last record carries the run's cumulative device histogram
+([NS_KINDS][NS_BUCKETS] integer counts); this tool folds any number
+of them into the cross-run view ``obs.netscope.ensemble`` computes:
+pooled p50/p90/p99 per kind, per-run tails (the spread the means
+hide), and the pooled CDF curve — the figure-ready "ensemble
+percentile curves" of the observability roadmap item.
+
+``fleet status --ensemble`` prints the same fold for a live queue;
+netreport is the offline/archival half: point it at stream files (or
+a runs directory) from any mix of queues, batches and single runs.
+
+Usage:
+  python tools/netreport.py runs/*/netscope.jsonl [--json] [--out F]
+  python tools/netreport.py --runs-dir q/runs        # scans */netscope.jsonl
+  python tools/netreport.py --self-check             # no jax, <1s
+
+Headless by design: loads obs/netscope.py by file path (stdlib-only
+module level), so no jax import and no accelerator env is touched.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def netscope_mod():
+    """obs/netscope.py by FILE PATH — shadow_tpu/__init__ imports jax,
+    which this tool must not pay (the perf_report.py convention)."""
+    spec = importlib.util.spec_from_file_location(
+        "_netscope", os.path.join(REPO, "shadow_tpu/obs/netscope.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def collect(paths, runs_dir=None):
+    """-> (names, tables): one final cumulative histogram per readable
+    stream; unreadable/empty streams are reported and skipped, never a
+    crash (a sweep with one crashed run must still fold)."""
+    NS = netscope_mod()
+    paths = list(paths or [])
+    if runs_dir:
+        for rid in sorted(os.listdir(runs_dir)):
+            p = os.path.join(runs_dir, rid, "netscope.jsonl")
+            if os.path.exists(p):
+                paths.append(p)
+    names, tables = [], []
+    for p in paths:
+        try:
+            _, recs = NS.read_stream(p)
+        except (OSError, json.JSONDecodeError) as e:
+            sys.stderr.write(f"netreport: {p}: unreadable ({e}) — "
+                             "skipped\n")
+            continue
+        if not recs:
+            sys.stderr.write(f"netreport: {p}: no records — skipped\n")
+            continue
+        names.append(p)
+        tables.append(recs[-1]["hist"])
+    return names, tables
+
+
+def render(ens, names) -> str:
+    lines = [f"netscope ensemble: {ens['runs']} runs"]
+    for n in names:
+        lines.append(f"  {n}")
+    lines.append(f"{'kind':<14}{'n':<10}{'p50':<10}{'p90':<10}"
+                 f"{'p99':<10}per-run p99 (us)")
+    for name, k in ens["kinds"].items():
+        lanes = " ".join(str(v) for v in k["lane_p99_us"])
+        lines.append(f"{name:<14}{k['count']:<10}{k['p50_us']:<10}"
+                     f"{k['p90_us']:<10}{k['p99_us']:<10}{lanes}")
+    return "\n".join(lines)
+
+
+# --- self-check: the fold/percentile math, no jax -------------------------
+
+def self_check() -> int:
+    """Synthetic-stream check of the ensemble contract: bucket math,
+    fold over every accepted nesting, exact percentile ranks, CDF
+    monotonicity, stream round-trip. Wired into the verify flow next
+    to perf_report's."""
+    import tempfile
+    NS = netscope_mod()
+    K, B = NS.NS_KINDS, NS.NS_BUCKETS
+
+    # bucketing: host ladder is the device comparison-sum ladder
+    for v, want in ((0, 0), (1, 1), (2, 2), (3, 2), (1024, 11),
+                    (1500, 11), (1 << 29, 30), (1 << 30, 31),
+                    (1 << 40, 31)):
+        got = NS.bucket_of(v)
+        assert got == want, (v, got, want)
+        idx = sum(v >= b for b in NS.BOUNDS_US)
+        assert idx == got, (v, idx, got)
+
+    # exact percentiles: 100 samples in bucket 3, 1 in bucket 10
+    row = [0] * B
+    row[3], row[10] = 100, 1
+    assert NS.percentile(row, 50) == 1 << 3
+    assert NS.percentile(row, 99) == 1 << 3      # rank 100 of 101
+    assert NS.percentile(row, 100) == 1 << 10
+    assert NS.percentile([0] * B, 99) == 0
+
+    # fold accepts [K][B], [H][K][B], [L][H][K][B] and agrees
+    t = [[i * B + j for j in range(B)] for i in range(K)]
+    assert NS.fold(t) == t
+    assert NS.fold([t, t]) == [[2 * c for c in r] for r in t]
+    assert NS.fold([[t, t], [t, t]]) == [[4 * c for c in r]
+                                         for r in t]
+
+    # ensemble: pooled count sums lanes; lane tails match per-lane
+    # percentiles; CDF is monotone and ends at 1
+    a = [[0] * B for _ in range(K)]
+    b = [[0] * B for _ in range(K)]
+    a[0][2] = 10                      # lane a: rtt all ~4us
+    b[0][8] = 30                      # lane b: rtt all ~256us
+    ens = NS.ensemble([a, b])
+    r = ens["kinds"]["rtt"]
+    assert r["count"] == 40
+    assert r["lane_p99_us"] == [1 << 2, 1 << 8]
+    assert r["p50_us"] == 1 << 8      # pooled median sits in lane b
+    cdf = r["cdf"]
+    assert all(x <= y + 1e-12 for x, y in zip(cdf, cdf[1:]))
+    assert abs(cdf[-1] - 1.0) < 1e-9
+
+    # stream round-trip: header + records -> collect() takes the LAST
+    # record's cumulative table
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "netscope.jsonl")
+        with open(p, "w") as f:
+            f.write(json.dumps({"format": NS.FORMAT,
+                                "kinds": list(NS.KIND_NAMES),
+                                "bounds_us": list(NS.BOUNDS_US)}) + "\n")
+            f.write(json.dumps({"window": 8, "sim_ns": 10 ** 9,
+                                "totals": {}, "delta": {},
+                                "hist": a, "hist_delta": a}) + "\n")
+            f.write(json.dumps({"window": 16, "sim_ns": 2 * 10 ** 9,
+                                "totals": {}, "delta": {},
+                                "hist": b, "hist_delta": b}) + "\n")
+        names, tables = collect([p])
+        assert names == [p] and tables == [b], (names, tables)
+        # empty stream is skipped, not fatal
+        empty = os.path.join(td, "empty.jsonl")
+        open(empty, "w").close()
+        names, tables = collect([empty, p])
+        assert names == [p], names
+
+    print("netreport: self-check OK (buckets + fold + ensemble + "
+          "stream)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fold netscope JSONL streams into cross-run "
+                    "percentile curves (obs.netscope.ensemble)")
+    ap.add_argument("streams", nargs="*",
+                    help="netscope JSONL stream paths")
+    ap.add_argument("--runs-dir", default=None, metavar="DIR",
+                    help="also scan DIR/*/netscope.jsonl (a fleet "
+                         "queue's runs directory)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full ensemble JSON (with CDF and "
+                         "buckets) instead of the table")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="also write the ensemble JSON to FILE")
+    ap.add_argument("--self-check", action="store_true",
+                    help="headless math check (no jax, no inputs)")
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        return self_check()
+    if not args.streams and not args.runs_dir:
+        ap.error("provide stream paths, --runs-dir, or --self-check")
+
+    names, tables = collect(args.streams, runs_dir=args.runs_dir)
+    if not tables:
+        sys.stderr.write("netreport: no usable streams\n")
+        return 1
+    NS = netscope_mod()
+    ens = NS.ensemble(tables)
+    ens["members"] = names
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(ens, f, indent=1, sort_keys=True)
+    if args.json:
+        print(json.dumps(ens, indent=1, sort_keys=True))
+    else:
+        print(render(ens, names))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,48 +4,155 @@ reference's src/tools/parse-shadow.py over [shadow-heartbeat] lines).
 
 Usage:
   python tools/parse_heartbeat.py sim.log --out nodes.csv
+  python tools/parse_heartbeat.py sim.log --ram --out ram.csv
   python tools/parse_heartbeat.py sim.log --summary
+  python tools/parse_heartbeat.py --netscope run.netscope.jsonl
 
 Node lines have the schema obs.tracker.HEADER:
-  time,host,events,pkts-sent,pkts-recv,bytes-sent,bytes-recv,
-  retransmits,drop-net,drop-buf,transfers-done
+  time,host,interval,events,pkts-sent,pkts-recv,bytes-sent,
+  bytes-recv,retransmits,drop-net,drop-buf,transfers-done
+
+[ram] lines are ``time,host,alloc,dealloc,total,sockets`` plus the
+optional trailing ``rss=`` (hosted child resident set) and ``dev=``
+(device-buffer watermark, obs.memscope) columns — parsed into fixed
+``rss``/``dev`` CSV columns, empty when a line doesn't carry them.
+
+``--netscope`` converts a network observatory time-series stream
+(obs.netscope JSONL — ``--netscope FILE`` on a run) into CSV: one row
+per chunk record with the interval stat deltas and each kind's
+cumulative sample count and exact p50/p99 read-out.
 """
 
 import argparse
 import csv
+import importlib.util
+import os
 import re
 import sys
 
 NODE_RE = re.compile(r"\[shadow-heartbeat\] \[node\] (.+)$")
+RAM_RE = re.compile(r"\[shadow-heartbeat\] \[ram\] (.+)$")
 SUMMARY_RE = re.compile(r"\[shadow-heartbeat\] \[summary\] (.+)$")
 
-FIELDS = ["time", "host", "events", "pkts_sent", "pkts_recv",
-          "bytes_sent", "bytes_recv", "retransmits", "drop_net",
-          "drop_buf", "transfers_done"]
+FIELDS = ["time", "host", "interval", "events", "pkts_sent",
+          "pkts_recv", "bytes_sent", "bytes_recv", "retransmits",
+          "drop_net", "drop_buf", "transfers_done"]
+
+RAM_FIELDS = ["time", "host", "alloc", "dealloc", "total", "sockets",
+              "rss", "dev"]
+
+
+def node_rows(lines):
+    """[node] heartbeat lines -> rows aligned with FIELDS."""
+    rows = []
+    for line in lines:
+        m = NODE_RE.search(line)
+        if m:
+            rows.append(m.group(1).split(","))
+    return rows
+
+
+def ram_rows(lines):
+    """[ram] heartbeat lines -> rows aligned with RAM_FIELDS. The
+    trailing ``rss=``/``dev=`` columns are optional per line (only
+    hosted hosts carry rss, only memscope runs carry dev) — absent
+    values become empty cells so the CSV shape is fixed."""
+    rows = []
+    for line in lines:
+        m = RAM_RE.search(line)
+        if not m:
+            continue
+        cols = m.group(1).split(",")
+        fixed, extra = cols[:6], {"rss": "", "dev": ""}
+        for c in cols[6:]:
+            k, eq, v = c.partition("=")
+            if eq and k in extra:
+                extra[k] = v
+        rows.append(fixed + [extra["rss"], extra["dev"]])
+    return rows
+
+
+def _netscope_mod():
+    # by file path: obs/netscope.py is stdlib-only at module level,
+    # and shadow_tpu/__init__ would import jax (the headless-tool
+    # convention of tools/perf_report.py)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "_netscope", os.path.join(repo, "shadow_tpu/obs/netscope.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def netscope_fields(kinds):
+    return (["window", "time"]
+            + [f"d_{k}" for k in ("events", "pkts_sent", "pkts_recv",
+                                  "bytes_sent", "bytes_recv",
+                                  "retransmits", "xfers_done")]
+            + [f"{k}_{c}" for k in kinds
+               for c in ("n", "p50_us", "p99_us")])
+
+
+def netscope_rows(path):
+    """A netscope JSONL stream -> (fields, rows): one row per chunk
+    record — interval stat deltas plus each kind's cumulative sample
+    count and exact percentile read-outs."""
+    NS = _netscope_mod()
+    header, records = NS.read_stream(path)
+    kinds = list(header.get("kinds", NS.KIND_NAMES))
+    rows = []
+    for r in records:
+        d = r.get("delta", {})
+        row = [r.get("window", ""), r.get("sim_ns", 0) / 1e9]
+        row += [d.get(k, "") for k in ("events", "pkts_sent",
+                                       "pkts_recv", "bytes_sent",
+                                       "bytes_recv", "retransmits",
+                                       "xfers_done")]
+        for k, counts in zip(kinds, r.get("hist", [])):
+            row += [sum(counts), NS.percentile(counts, 50),
+                    NS.percentile(counts, 99)]
+        rows.append(row)
+    return netscope_fields(kinds), rows
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("log")
+    ap.add_argument("log", nargs="?")
     ap.add_argument("--out", default="-")
     ap.add_argument("--summary", action="store_true",
                     help="print summary lines instead of node CSV")
+    ap.add_argument("--ram", action="store_true",
+                    help="emit the [ram] family (alloc/dealloc/total/"
+                         "sockets + optional rss=/dev= columns)")
+    ap.add_argument("--netscope", default=None, metavar="JSONL",
+                    help="convert a netscope time-series stream to "
+                         "CSV instead of parsing a heartbeat log")
     args = ap.parse_args()
+    if not args.log and not args.netscope:
+        ap.error("provide a heartbeat log or --netscope JSONL")
 
-    out = sys.stdout if args.out == "-" else open(args.out, "w", newline="")
-    with open(args.log) as f:
-        if args.summary:
-            for line in f:
-                m = SUMMARY_RE.search(line)
-                if m:
-                    out.write(m.group(1) + "\n")
-        else:
-            w = csv.writer(out)
-            w.writerow(FIELDS)
-            for line in f:
-                m = NODE_RE.search(line)
-                if m:
-                    w.writerow(m.group(1).split(","))
+    out = (sys.stdout if args.out == "-"
+           else open(args.out, "w", newline=""))
+    if args.netscope:
+        fields, rows = netscope_rows(args.netscope)
+        w = csv.writer(out)
+        w.writerow(fields)
+        w.writerows(rows)
+    else:
+        with open(args.log) as f:
+            if args.summary:
+                for line in f:
+                    m = SUMMARY_RE.search(line)
+                    if m:
+                        out.write(m.group(1) + "\n")
+            elif args.ram:
+                w = csv.writer(out)
+                w.writerow(RAM_FIELDS)
+                w.writerows(ram_rows(f))
+            else:
+                w = csv.writer(out)
+                w.writerow(FIELDS)
+                w.writerows(node_rows(f))
     if out is not sys.stdout:
         out.close()
 

@@ -5,8 +5,12 @@ src/tools/plot-shadow.py over parse-shadow output).
 Usage:
   python tools/plot_heartbeat.py sim.log --out sim.pdf
   python tools/plot_heartbeat.py sim.log --metric bytes_recv --out x.png
+  python tools/plot_heartbeat.py sim.log --netscope run.netscope.jsonl
 
-Produces per-metric time series: one line per host plus the aggregate.
+Produces per-metric time series: one line per host plus the
+aggregate. ``--netscope`` appends the network observatory panels
+(obs.netscope): per-kind sample counts and the exact p50/p99
+percentile curves over simulated time, from the run's JSONL stream.
 """
 
 import argparse
@@ -25,12 +29,13 @@ import matplotlib.pyplot as plt  # noqa: E402
 METRICS = ["events", "pkts_sent", "pkts_recv", "bytes_sent",
            "bytes_recv", "retransmits", "drop_net", "transfers_done"]
 
+PARSER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "parse_heartbeat.py")
+
 
 def load(log_path):
-    parser = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "parse_heartbeat.py")
     out = subprocess.run(
-        [sys.executable, parser, log_path],
+        [sys.executable, PARSER, log_path],
         capture_output=True, text=True, check=True).stdout
     rows = list(csv.DictReader(io.StringIO(out)))
     series = collections.defaultdict(lambda: collections.defaultdict(list))
@@ -41,18 +46,44 @@ def load(log_path):
     return series
 
 
+def load_netscope(path):
+    """-> {kind: [(t_s, n, p50_us, p99_us), ...]} via the parser's
+    --netscope CSV (one reader for log and stream alike)."""
+    out = subprocess.run(
+        [sys.executable, PARSER, "--netscope", path],
+        capture_output=True, text=True, check=True).stdout
+    rows = list(csv.DictReader(io.StringIO(out)))
+    kinds = sorted({c[:-2] for c in (rows[0] if rows else {})
+                    if c.endswith("_n")})
+    series = {k: [] for k in kinds}
+    for r in rows:
+        for k in kinds:
+            series[k].append((float(r["time"]), int(r[f"{k}_n"]),
+                              int(r[f"{k}_p50_us"]),
+                              int(r[f"{k}_p99_us"])))
+    return series
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("log")
     ap.add_argument("--out", default="heartbeat.pdf")
     ap.add_argument("--metric", action="append",
                     help=f"subset of {METRICS}")
+    ap.add_argument("--netscope", default=None, metavar="JSONL",
+                    help="append network observatory panels from this "
+                         "netscope stream (per-kind sample counts + "
+                         "p50/p99 curves)")
     args = ap.parse_args()
 
     series = load(args.log)
     metrics = args.metric or METRICS
-    fig, axes = plt.subplots(len(metrics), 1,
-                             figsize=(8, 2.2 * len(metrics)),
+    ns = load_netscope(args.netscope) if args.netscope else None
+    ns_kinds = ([k for k, pts in ns.items()
+                 if any(n for _, n, _, _ in pts)] if ns else [])
+    n_panels = len(metrics) + (2 if ns_kinds else 0)
+    fig, axes = plt.subplots(n_panels, 1,
+                             figsize=(8, 2.2 * n_panels),
                              sharex=True, squeeze=False)
     for ax, m in zip(axes[:, 0], metrics):
         total = collections.Counter()
@@ -69,6 +100,24 @@ def main():
             ax.legend(loc="upper left", fontsize=7)
         ax.set_ylabel(m, fontsize=8)
         ax.tick_params(labelsize=7)
+    if ns_kinds:
+        ax_n, ax_p = axes[len(metrics), 0], axes[len(metrics) + 1, 0]
+        for k in ns_kinds:
+            pts = ns[k]
+            xs = [t for t, _, _, _ in pts]
+            ax_n.plot(xs, [n for _, n, _, _ in pts], linewidth=1.2,
+                      label=k)
+            ax_p.plot(xs, [p50 for _, _, p50, _ in pts],
+                      linewidth=1.0, label=f"{k} p50")
+            ax_p.plot(xs, [p99 for _, _, _, p99 in pts],
+                      linewidth=1.0, linestyle="--", label=f"{k} p99")
+        ax_n.set_ylabel("net samples (cum)", fontsize=8)
+        ax_n.legend(loc="upper left", fontsize=7)
+        ax_p.set_yscale("log")
+        ax_p.set_ylabel("latency (us)", fontsize=8)
+        ax_p.legend(loc="upper left", fontsize=6, ncol=2)
+        for ax in (ax_n, ax_p):
+            ax.tick_params(labelsize=7)
     axes[-1, 0].set_xlabel("simulated time (s)", fontsize=8)
     fig.tight_layout()
     fig.savefig(args.out)
