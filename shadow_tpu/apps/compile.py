@@ -146,3 +146,156 @@ def compile_app(plugin: str, args: str, dns, num_hosts: int,
     raise ValueError(f"unknown plugin {plugin!r} "
                      "(builtin: ping, pingserver, phold, bulk, bulkserver, "
                      "tgen, gossip, socksclient, socksproxy)")
+
+
+# --- scenario-scaled engine capacities (shrink campaign, lever 3) ---------
+#
+# Every socket-table row costs ~239 B/host-slot at the narrow layout
+# (~364 wide) whether or not a socket ever lives there, and qcap rides
+# on scap (one standing RTO timer per live socket). The hand-tuned
+# per-config caps in tools/baseline_configs are sized for the WORST
+# member of a config family; most scenarios declare enough in their
+# process specs to size exactly. peak_sockets() reads those
+# declarations; auto_caps() turns them into an EngineConfig with a 2x
+# margin. Overflow above a cap defers to the next window (exact), so a
+# mis-declared peak costs windows, never correctness.
+
+def _tgen_attr(graphml: str, attr: str):
+    """First <data> value for a graphml attr.name, resolving the
+    attr -> key-id indirection (<key attr.name=.. id=..>)."""
+    import re
+    m = re.search(r'<key[^>]*attr\.name="%s"[^>]*id="([^"]+)"' % attr,
+                  graphml)
+    if not m:
+        m = re.search(r'<key[^>]*id="([^"]+)"[^>]*attr\.name="%s"' % attr,
+                      graphml)
+    if not m:
+        return None
+    d = re.search(r'<data key="%s">([^<]*)</data>' % re.escape(m.group(1)),
+                  graphml)
+    return d.group(1) if d else None
+
+
+def _strip_ordinal(name: str) -> str:
+    """'relay37' -> 'relay' — hostnames are spec id + 1-based ordinal
+    (core.dns expansion order)."""
+    return name.rstrip("0123456789") or name
+
+
+def peak_sockets(scenario):
+    """Per-HostSpec peak concurrent sockets, from the apps' declared
+    traffic shape -> {spec_id: peak} — or None with a reason string,
+    (None, why), when any process is unbounded (hosted apps, unknown
+    plugins, tgen file-path graphs the planner cannot read inline).
+
+    The model: each plugin contributes sockets it OWNS on its host
+    (listeners, the one in-flight fetch) plus LOAD it lands on remote
+    pools, distributed uniformly over the pool — a socks circuit
+    crosses each of its `hops` relays with 2 sockets (in + out leg,
+    apps/socks.py), a fetch holds 1 server-side socket."""
+    specs = []          # (spec, id_lo, id_hi)
+    lo = 0
+    for hs in scenario.hosts:
+        q = max(int(hs.quantity or 1), 1)
+        specs.append((hs, lo, lo + q))
+        lo += q
+
+    own = {hs.id: 0 for hs, _, _ in specs}      # per-host owned peak
+    loads = []                                  # (id_lo, id_hi, total)
+    named_loads = []                            # (spec_id, n_pool, total)
+
+    for hs, s_lo, s_hi in specs:
+        q = s_hi - s_lo
+        for ps in hs.processes:
+            kv = parse_kv(ps.arguments)
+            p = ps.plugin
+            if p in ("ping", "pingserver", "phold", "gossip",
+                     "bulkserver", "socksproxy"):
+                own[hs.id] += 1                 # one UDP sock / listener
+            elif p == "bulk":
+                own[hs.id] += 1                 # serial fetches
+                peer = _strip_ordinal(kv["peer"])
+                named_loads.append((peer, 1, q))
+            elif p == "socksclient":
+                own[hs.id] += 1                 # one circuit leg at a time
+                hops = int(kv.get("hops", 1))
+                rlo, rhi = int(kv["proxy-lo"]), int(kv["proxy-hi"])
+                slo, shi = int(kv["server-lo"]), int(kv["server-hi"])
+                # 2 sockets on every relay the circuit crosses, 1 on
+                # the server; pools absorb the whole client population
+                loads.append((rlo, rhi, 2 * hops * q))
+                loads.append((slo, shi, 1 * q))
+            elif p == "tgen":
+                src = ps.arguments.strip()
+                if not src.startswith("<"):
+                    return None, (f"spec {hs.id!r}: tgen file-path "
+                                  "graph — peak not declared inline")
+                peers = _tgen_attr(src, "peers")
+                if peers:
+                    own[hs.id] += 2             # active transfer + churn
+                    names = [t.split(":")[0] for t in peers.split(",")
+                             if t.strip()]
+                    by_spec = {}
+                    for nm in names:
+                        by_spec[_strip_ordinal(nm)] = \
+                            by_spec.get(_strip_ordinal(nm), 0) + 1
+                    for spec_id, n_pool in by_spec.items():
+                        named_loads.append(
+                            (spec_id, n_pool,
+                             q * n_pool / max(len(names), 1)))
+                else:
+                    own[hs.id] += 1             # pure server graph
+            else:
+                return None, (f"spec {hs.id!r}: plugin {p!r} declares "
+                              "no socket peak (hosted/unknown)")
+
+    peaks = {}
+    for hs, s_lo, s_hi in specs:
+        density = 0.0
+        for l_lo, l_hi, total in loads:
+            o_lo, o_hi = max(s_lo, l_lo), min(s_hi, l_hi)
+            if o_hi > o_lo and l_hi > l_lo:
+                density += total / (l_hi - l_lo)
+        for spec_id, n_pool, total in named_loads:
+            if spec_id == hs.id:
+                density += total / max(n_pool, 1)
+        peaks[hs.id] = own[hs.id] + int(-(-density // 1))
+    return peaks, None
+
+
+def auto_caps(scenario, base):
+    """Scenario-scaled capacities: (EngineConfig, info dict).
+
+    scap = ceil16(2 x max declared peak) — the 2x absorbs TIME_WAIT
+    residue and burst skew above the mean the peak model computes.
+    qcap preserves the BASE's qcap - scap headroom delta, not a ratio:
+    the delta is the arrival budget that keeps one standing RTO-timer
+    event per live socket from starving intake
+    (tools/baseline_configs.socks_caps round-3 notes). obcap/txqcap
+    are per-window throughput budgets, not per-socket needs — they
+    keep the base value, clamped to scap (budgeting more emit slots
+    than sockets that could emit buys nothing).
+
+    When the scenario declares no computable peak the BASE caps come
+    back unchanged with info["applied"] False — the planner never
+    guesses."""
+    import dataclasses
+
+    peaks, why = peak_sockets(scenario)
+    if peaks is None:
+        return base, {"applied": False, "why": why}
+    mx = max(peaks.values()) if peaks else 1
+    scap = max(((2 * mx + 15) // 16) * 16, 16)
+    qcap = scap + max(base.qcap - base.scap, 16)
+    obcap = min(base.obcap, scap)
+    txqcap = min(base.txqcap, scap)
+    cfg = dataclasses.replace(base, scap=scap, qcap=qcap, obcap=obcap,
+                              txqcap=txqcap)
+    return cfg, {
+        "applied": True, "peaks": peaks, "max_peak": mx,
+        "caps": {"scap": scap, "qcap": qcap, "obcap": obcap,
+                 "txqcap": txqcap},
+        "base_caps": {"scap": base.scap, "qcap": base.qcap,
+                      "obcap": base.obcap, "txqcap": base.txqcap},
+        "grew": scap > base.scap,
+    }

@@ -131,9 +131,14 @@ class AotJit:
         # error recorded, never a failed build. The latest analysis is
         # also kept on the instance so callers holding the AotJit
         # (engine.sim's cost model) read it without knowing the scope.
+        # The DECLARED donation rides along so memscope's donation
+        # audit (shrink-campaign lever 4) can compare it against the
+        # measured alias_bytes per executable without reaching back
+        # into this wrapper.
         from ..obs import memscope
         self.analysis = memscope.observe_executable(
-            self.cache_scope or getattr(self._fn, "__name__", "?"), fn)
+            self.cache_scope or getattr(self._fn, "__name__", "?"), fn,
+            donated=self._jit_kwargs.get("donate_argnums", ()))
         return fn
 
 

@@ -148,6 +148,20 @@ class EngineConfig:
     #   passes. Off (default) allocates the bucket axis at ZERO, so
     #   shapes, digests and checkpoints of existing runs are
     #   untouched and every observe() call is a static no-op.
+    wide_state: int = 0     # at-rest state width (the shrink
+    #   campaign, ROADMAP item 2): 0 (default) = the socket-table
+    #   columns in NARROW_SPEC live at their narrow dtypes (i32/i16/
+    #   i8/u16) with the SACK/OOO scoreboards delta-encoded relative
+    #   to their stream anchors; every handler still computes at the
+    #   canonical wide dtypes — widen_state/narrow_state convert at
+    #   the drain's single row entry/exit (engine.window.step_one_host)
+    #   and at the hosted op-replay boundary (hosting.bridge.apply_ops).
+    #   1 = allocate full-width (the pre-shrink layout; the --wide-state
+    #   A/B escape hatch, same pattern as hot_split=0). Bit-identical
+    #   either way: NARROW_SPEC carries a machine-checked bound per
+    #   column (simlint STF404) proving round-trip exactness, and
+    #   digest chains canonicalize narrowed columns back to wide form
+    #   before hashing (engine.window.canonicalize_state).
 
 
 # Digest sections (obs.digest): Hosts field prefix -> the named state
@@ -345,6 +359,167 @@ def hot_fields(cfg: "EngineConfig") -> tuple:
         if _guard_holds(guard, cfg):
             off.update(fields)
     return tuple(f for f in HOT_FIELDS if f not in off)
+
+
+# At-rest narrow layout for provably-bounded socket columns (the
+# shrink campaign, ROADMAP item 2). Each entry:
+#
+#   (field, wide, narrow, encoding, bound, why)
+#
+# - `wide` is the canonical COMPUTE dtype every handler sees (the
+#   dtype the Hosts annotation comments declare and digest chains
+#   canonicalize to);
+# - `narrow` is the AT-REST dtype alloc_hosts uses when
+#   cfg.wide_state == 0;
+# - `encoding` is "abs" (plain cast — the value itself fits the
+#   narrow dtype) or "rel:<anchor>" (stored as offset from the named
+#   Hosts column; the free-slot sentinel -1 is preserved verbatim);
+# - `bound` is the machine-checked maximum magnitude a live value can
+#   take (plain int literal — the stateflow analyzer reads this table
+#   from the AST and ast.literal_eval cannot fold shifts), and `why`
+#   names the invariant that enforces it.
+#
+# Simlint STF404 verifies every entry (known dtypes, bound fits the
+# narrow dtype, rel anchors are abs-narrowed i64 columns, non-empty
+# why) and tests/test_shrink.py asserts the bounds against the
+# documented max scenario parameters, failing by field name.
+#
+# Stream offsets are bounded by the TCP wire format: every SEQ/ACK is
+# cast to i32 on the wire (net/tcp.py mk_segment), so an absolute
+# stream offset past 2^31-1 would already corrupt the protocol — the
+# sender's flow control (sndbuf/rwnd <= buf_cap = 2^30) never reaches
+# it within any supported scenario envelope (max transfer ~2 GiB per
+# connection; UDP's cumulative sk_rcv_nxt byte counter shares the
+# same documented envelope). Scoreboard runs are additionally bounded
+# by the receive/send buffer (< 2^30) so offsets relative to
+# sk_rcv_nxt/sk_snd_una always fit i32 with room.
+NARROW_SPEC = (
+    # -- delta-encoded scoreboards (lever 1): [H, S, K] i64 -> i32 --
+    ("sk_ooo_s", "i64", "i32", "rel:sk_rcv_nxt", 1073741824,
+     "receiver OOO runs lie in (rcv_nxt, rcv_nxt + rcvbuf]; "
+     "rcvbuf <= buf_cap = 2^30 (net/tcp.py _autotune)"),
+    ("sk_ooo_e", "i64", "i32", "rel:sk_rcv_nxt", 1073741824,
+     "run ends share the OOO window bound (end - rcv_nxt <= rcvbuf)"),
+    ("sk_sack_s", "i64", "i32", "rel:sk_snd_una", 1073741824,
+     "sender SACK runs lie in [snd_una, snd_una + sndbuf + rwnd); "
+     "both <= buf_cap = 2^30 and runs are dropped below una on every "
+     "ACK (net/tcp.py on_tcp_rx drop_below BEFORE the una write)"),
+    ("sk_sack_e", "i64", "i32", "rel:sk_snd_una", 1073741824,
+     "run ends share the SACK window bound"),
+    # -- absolute stream offsets (lever 2): i64 -> i32 ----------------
+    ("sk_snd_una", "i64", "i32", "abs", 2147483647,
+     "wire i32 SEQ/ACK cast (net/tcp.py mk_segment) bounds every "
+     "absolute stream offset below 2^31"),
+    ("sk_snd_nxt", "i64", "i32", "abs", 2147483647, "wire i32 SEQ"),
+    ("sk_snd_max", "i64", "i32", "abs", 2147483647, "wire i32 SEQ"),
+    ("sk_snd_end", "i64", "i32", "abs", 2147483647,
+     "app write cursor; flow control caps it at snd_una + sndbuf"),
+    ("sk_rcv_nxt", "i64", "i32", "abs", 2147483647,
+     "wire i32 ACK; UDP reuses it as a delivered-bytes counter under "
+     "the same documented scenario envelope"),
+    ("sk_hole_end", "i64", "i32", "abs", 2147483647,
+     "recovery point: a snapshot of snd_max (wire-bounded)"),
+    ("sk_rex_nxt", "i64", "i32", "abs", 2147483647,
+     "retransmit cursor within [snd_una, snd_max]"),
+    ("sk_peer_fin", "i64", "i32", "abs", 2147483647,
+     "peer FIN stream offset (wire-bounded; -1 sentinel when unset)"),
+    ("sk_rtt_seq", "i64", "i32", "abs", 2147483647,
+     "RTT-sampled SEQ (wire-bounded; -1 sentinel between samples)"),
+    # -- buffer/window sizes (lever 2): i64 -> i32 --------------------
+    ("sk_peer_rwnd", "i64", "i32", "abs", 1073741824,
+     "peer-advertised window, clamped to buf_cap = 2^30 on receive"),
+    ("sk_sndbuf", "i64", "i32", "abs", 1073741824,
+     "send buffer, autotuned within [min, buf_cap = 2^30]"),
+    ("sk_rcvbuf", "i64", "i32", "abs", 1073741824,
+     "receive buffer, autotuned within [min, buf_cap = 2^30]"),
+    # -- small enums / flags / ports (lever 2) ------------------------
+    ("sk_proto", "i32", "i8", "abs", 17,
+     "IPPROTO id: 0 free, 1 hosted pipe, 6 tcp, 17 udp"),
+    ("sk_state", "i32", "i8", "abs", 10,
+     "TCPS_* enum, max TCPS_TIME_WAIT = 10 (net/socket.py)"),
+    ("sk_ctl", "i32", "i8", "abs", 31,
+     "pending-control bitmask SYN|SYNACK|ACKNOW|FIN|RST = 0x1f"),
+    ("sk_lport", "i32", "u16", "abs", 65535,
+     "port numbers <= MAX_PORT = 65535 (core/constants.py)"),
+    ("sk_rport", "i32", "u16", "abs", 65535,
+     "port numbers <= MAX_PORT = 65535"),
+)
+
+_DTYPES = {"i8": "int8", "i16": "int16", "u16": "uint16",
+           "i32": "int32", "i64": "int64"}
+
+
+def _narrow_maps():
+    """(abs, rel) field maps parsed once from NARROW_SPEC: abs is
+    {field: (wide_dt, narrow_dt)}, rel is {field: (wide_dt, narrow_dt,
+    anchor)} with anchors resolvable through abs."""
+    abs_f, rel_f = {}, {}
+    for field, wide, narrow, enc, _bound, _why in NARROW_SPEC:
+        wdt, ndt = _DTYPES[wide], _DTYPES[narrow]
+        if enc == "abs":
+            abs_f[field] = (wdt, ndt)
+        else:
+            rel_f[field] = (wdt, ndt, enc.split(":", 1)[1])
+    return abs_f, rel_f
+
+
+NARROW_ABS, NARROW_REL = _narrow_maps()
+# the dtype probe the codec keys on: wide alloc gives int64 here
+_PROBE_FIELD = "sk_snd_una"
+
+
+def narrow_dtypes(cfg: "EngineConfig") -> dict:
+    """{field: jnp dtype} for the at-rest layout this config allocates
+    — empty when cfg.wide_state (the A/B escape hatch) asks for the
+    full-width layout."""
+    if getattr(cfg, "wide_state", 0):
+        return {}
+    out = {f: jnp.dtype(ndt) for f, (_w, ndt) in NARROW_ABS.items()}
+    out.update({f: jnp.dtype(ndt)
+                for f, (_w, ndt, _a) in NARROW_REL.items()})
+    return out
+
+
+def widen_state(t):
+    """Decode a narrow at-rest Hosts tree (or a single vmapped row) to
+    the canonical wide compute form -> (tree, was_narrow). Identity on
+    wide state; `was_narrow` is a PYTHON bool read from static dtypes
+    at trace time, so the wide path compiles zero conversion code.
+    Rank-agnostic: scoreboard anchors broadcast over the trailing K
+    axis via [..., None], so the same codec serves step_one_host's
+    rows and apply_ops' full [H, S, K] tables."""
+    probe = getattr(t, _PROBE_FIELD)
+    if str(probe.dtype) == NARROW_ABS[_PROBE_FIELD][0]:
+        return t, False
+    reps = {}
+    for f, (wdt, _ndt) in NARROW_ABS.items():
+        reps[f] = getattr(t, f).astype(wdt)
+    for f, (wdt, _ndt, anchor) in NARROW_REL.items():
+        rel = getattr(t, f)
+        anc = reps[anchor]  # anchors are abs-narrowed -> already wide
+        reps[f] = jnp.where(rel >= 0,
+                            rel.astype(wdt) + anc[..., None],
+                            jnp.array(-1, wdt))
+    return t.replace(**reps), True
+
+
+def narrow_state(t):
+    """Re-encode a wide Hosts tree (or row) to the narrow at-rest
+    layout — the inverse of :func:`widen_state` (exact for every value
+    within its NARROW_SPEC bound; free-slot -1 sentinels round-trip
+    verbatim). Identity when the tree is already narrow."""
+    probe = getattr(t, _PROBE_FIELD)
+    if str(probe.dtype) != NARROW_ABS[_PROBE_FIELD][0]:
+        return t
+    reps = {}
+    for f, (_wdt, ndt, anchor) in NARROW_REL.items():
+        s = getattr(t, f)
+        anc = getattr(t, anchor)  # still wide in t
+        reps[f] = jnp.where(s >= 0, s - anc[..., None],
+                            jnp.array(-1, s.dtype)).astype(ndt)
+    for f, (_wdt, ndt) in NARROW_ABS.items():
+        reps[f] = getattr(t, f).astype(ndt)
+    return t.replace(**reps)
 
 
 def shape_census(cfg: "EngineConfig") -> dict:
@@ -576,6 +751,16 @@ def alloc_hosts(cfg: EngineConfig) -> Hosts:
     def full(shape, val, dt):
         return jnp.full(shape, val, dtype=dt)
 
+    # at-rest dtype per column: NARROW_SPEC's narrow dtype when the
+    # shrink layout is on (cfg.wide_state == 0), else the wide dtype
+    # named by the field's annotation comment. The stateflow model
+    # intentionally keeps the WIDE dtype for these fields (handlers
+    # only ever see widened rows — engine.window.step_one_host).
+    _nd = narrow_dtypes(cfg)
+
+    def ndt(name, wide):
+        return _nd.get(name, wide)
+
     return Hosts(
         eq_time=full((H, Q), SIMTIME_MAX, jnp.int64),
         eq_seq=full((H, Q), 0, jnp.int32),
@@ -595,24 +780,24 @@ def alloc_hosts(cfg: EngineConfig) -> Hosts:
         pkt_ctr=full((H,), 0, jnp.int32),
         next_eport=full((H,), C.MIN_RANDOM_PORT, jnp.int32),
         sk_used=full((H, S), False, jnp.bool_),
-        sk_proto=full((H, S), 0, jnp.int32),
-        sk_state=full((H, S), 0, jnp.int32),
-        sk_lport=full((H, S), 0, jnp.int32),
-        sk_rport=full((H, S), 0, jnp.int32),
+        sk_proto=full((H, S), 0, ndt("sk_proto", jnp.int32)),
+        sk_state=full((H, S), 0, ndt("sk_state", jnp.int32)),
+        sk_lport=full((H, S), 0, ndt("sk_lport", jnp.int32)),
+        sk_rport=full((H, S), 0, ndt("sk_rport", jnp.int32)),
         sk_rhost=full((H, S), -1, jnp.int32),
         sk_parent=full((H, S), -1, jnp.int32),
-        sk_snd_una=full((H, S), 0, jnp.int64),
-        sk_snd_nxt=full((H, S), 0, jnp.int64),
-        sk_snd_max=full((H, S), 0, jnp.int64),
-        sk_snd_end=full((H, S), 0, jnp.int64),
-        sk_rcv_nxt=full((H, S), 0, jnp.int64),
-        sk_ooo_s=full((H, S, SACK_K), -1, jnp.int64),
-        sk_ooo_e=full((H, S, SACK_K), -1, jnp.int64),
-        sk_sack_s=full((H, S, SACK_K), -1, jnp.int64),
-        sk_sack_e=full((H, S, SACK_K), -1, jnp.int64),
-        sk_hole_end=full((H, S), 0, jnp.int64),
-        sk_rex_nxt=full((H, S), 0, jnp.int64),
-        sk_peer_fin=full((H, S), -1, jnp.int64),
+        sk_snd_una=full((H, S), 0, ndt("sk_snd_una", jnp.int64)),
+        sk_snd_nxt=full((H, S), 0, ndt("sk_snd_nxt", jnp.int64)),
+        sk_snd_max=full((H, S), 0, ndt("sk_snd_max", jnp.int64)),
+        sk_snd_end=full((H, S), 0, ndt("sk_snd_end", jnp.int64)),
+        sk_rcv_nxt=full((H, S), 0, ndt("sk_rcv_nxt", jnp.int64)),
+        sk_ooo_s=full((H, S, SACK_K), -1, ndt("sk_ooo_s", jnp.int64)),
+        sk_ooo_e=full((H, S, SACK_K), -1, ndt("sk_ooo_e", jnp.int64)),
+        sk_sack_s=full((H, S, SACK_K), -1, ndt("sk_sack_s", jnp.int64)),
+        sk_sack_e=full((H, S, SACK_K), -1, ndt("sk_sack_e", jnp.int64)),
+        sk_hole_end=full((H, S), 0, ndt("sk_hole_end", jnp.int64)),
+        sk_rex_nxt=full((H, S), 0, ndt("sk_rex_nxt", jnp.int64)),
+        sk_peer_fin=full((H, S), -1, ndt("sk_peer_fin", jnp.int64)),
         sk_fin_acked=full((H, S), False, jnp.bool_),
         sk_close_after=full((H, S), False, jnp.bool_),
         sk_cwnd=full((H, S), 0.0, jnp.float32),
@@ -625,12 +810,12 @@ def alloc_hosts(cfg: EngineConfig) -> Hosts:
         sk_timer_on=full((H, S), False, jnp.bool_),
         sk_timer_gen=full((H, S), 0, jnp.int32),
         sk_dupacks=full((H, S), 0, jnp.int32),
-        sk_rtt_seq=full((H, S), -1, jnp.int64),
+        sk_rtt_seq=full((H, S), -1, ndt("sk_rtt_seq", jnp.int64)),
         sk_rtt_time=full((H, S), 0, jnp.int64),
-        sk_ctl=full((H, S), 0, jnp.int32),
-        sk_peer_rwnd=full((H, S), C.RECV_BUFFER_SIZE, jnp.int64),
-        sk_sndbuf=full((H, S), C.SEND_BUFFER_SIZE, jnp.int64),
-        sk_rcvbuf=full((H, S), C.RECV_BUFFER_SIZE, jnp.int64),
+        sk_ctl=full((H, S), 0, ndt("sk_ctl", jnp.int32)),
+        sk_peer_rwnd=full((H, S), C.RECV_BUFFER_SIZE, ndt("sk_peer_rwnd", jnp.int64)),
+        sk_sndbuf=full((H, S), C.SEND_BUFFER_SIZE, ndt("sk_sndbuf", jnp.int64)),
+        sk_rcvbuf=full((H, S), C.RECV_BUFFER_SIZE, ndt("sk_rcvbuf", jnp.int64)),
         sk_hs_time=full((H, S), 0, jnp.int64),
         sk_last_tx=full((H, S), 0, jnp.int64),
         sk_syn_tag=full((H, S), 0, jnp.int32),

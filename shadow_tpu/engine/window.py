@@ -46,7 +46,8 @@ from . import equeue
 from .defs import (EV_NULL, EV_APP, EV_PKT, EV_NIC_TX, EV_TCP_TIMER,
                    EV_TCP_CLOSE, ST_EVENTS, ST_PKTS_RECV, ST_PKTS_DROP_NET,
                    ST_PKTS_DROP_Q, ST_DEFER_FANIN)
-from .state import EngineConfig, hot_fields, row_proto
+from .state import (NARROW_ABS, NARROW_REL, EngineConfig, hot_fields,
+                    narrow_state, row_proto, widen_state)
 
 
 # --- Event handlers (row-level) -------------------------------------------
@@ -141,6 +142,13 @@ def _make_handlers(cfg: EngineConfig):
 
 def step_one_host(row, hp, sh, wend, cfg: EngineConfig):
     """Pop and execute this host's earliest event if inside the window."""
+    # At-rest narrow layout (state.NARROW_SPEC, cfg.wide_state == 0):
+    # this is the drain's single codec insertion point — the row is
+    # decoded to the canonical wide compute form here, every handler
+    # below sees exactly the pre-shrink dtypes, and the single return
+    # path re-encodes. `was_narrow` is a Python bool from static
+    # dtypes, so a wide-state run compiles zero conversion code.
+    row, was_narrow = widen_state(row)
     slot, t = equeue.q_min(row)
     ready = t < wend
     kind = jnp.where(ready, rget(row.eq_kind, slot), EV_NULL)
@@ -192,9 +200,10 @@ def step_one_host(row, hp, sh, wend, cfg: EngineConfig):
             jnp.maximum(row.cpu_avail, t) + hp.cpu_cost,
             row.cpu_avail))
 
-    return row.replace(
+    row = row.replace(
         stats=radd(row.stats, ST_EVENTS,
                    jnp.where(ready, 1, 0) + jnp.where(due, 1, 0)))
+    return narrow_state(row) if was_narrow else row
 
 
 def step_all_hosts(hosts, hp, sh, wend, cfg: EngineConfig):
@@ -1161,6 +1170,28 @@ def canonicalize_state(arrs: dict) -> dict:
     import numpy as np
 
     a = dict(arrs)
+
+    # Narrow at-rest layout (state.NARROW_SPEC, cfg.wide_state == 0):
+    # decode every narrowed column back to its canonical wide dtype —
+    # and the delta-encoded scoreboards back to absolute stream
+    # offsets — BEFORE any hashing or scrubbing. The digest hashes
+    # dtype+shape headers per column, so without this a narrowed run
+    # could never chain byte-identically to a --wide-state one; and
+    # the socket scrub below must see the scoreboards' dead-slot
+    # sentinel in ONE encoding (a freed slot's stale relative values
+    # decode to garbage absolutes, which the sk_used scrub then zeroes
+    # exactly like the wide run's stale absolutes). Order matters: the
+    # abs columns first, so the rel anchors (sk_rcv_nxt/sk_snd_una)
+    # are wide when the scoreboards decode against them.
+    for f, (wdt, _ndt) in NARROW_ABS.items():
+        if f in a and a[f].dtype != np.dtype(wdt):
+            a[f] = a[f].astype(wdt)
+    for f, (wdt, _ndt, anchor) in NARROW_REL.items():
+        if f in a and a[f].dtype != np.dtype(wdt):
+            rel = a[f]
+            anc = a[anchor]
+            a[f] = np.where(rel >= 0, rel.astype(wdt) + anc[..., None],
+                            np.array(-1, wdt))
 
     def scrub(key, dead):
         v = a[key]

@@ -234,6 +234,13 @@ def apply_ops(hosts, hp, sh, ops):
     """Apply a padded [K, OP_WORDS] int64 op batch sequentially (ops on
     the same host must compose), then clear the wake rings. Returns
     (hosts, results[K] int32)."""
+    # op replay is the second state-mutation boundary beside the drain
+    # (engine.window.step_one_host): decode the narrow at-rest layout
+    # once for the whole batch, replay against wide rows (sock_alloc
+    # and the tcp/udp calls write wide dtypes), re-encode on return.
+    # Static-dtype keyed, so wide-state runs trace zero conversions.
+    from ..engine.state import narrow_state, widen_state
+    hosts, was_narrow = widen_state(hosts)
 
     def body(i, carry):
         hosts, results = carry
@@ -244,6 +251,8 @@ def apply_ops(hosts, hp, sh, ops):
     results = jnp.full((K,), -1, _I32)
     hosts, results = jax.lax.fori_loop(0, K, body, (hosts, results))
     hosts = hosts.replace(hw_cnt=jnp.zeros_like(hosts.hw_cnt))
+    if was_narrow:
+        hosts = narrow_state(hosts)
     return hosts, results
 
 

@@ -86,6 +86,14 @@ STF403 = rule(
     "SIMTIME_MAX is the i64 ns clock's infinity; comparing it against "
     "an i32/f32 value can never be true (or truncates) — widen the "
     "operand")
+STF404 = rule(
+    "STF404", "narrowed column lacks a machine-checked bound",
+    "every NARROW_SPEC entry in engine/state.py must name an existing "
+    "Hosts field, carry known wide/narrow dtypes with the narrow one "
+    "strictly smaller, a positive bound that fits the narrow dtype's "
+    "range, a rel: anchor that is itself an abs-narrowed Hosts column, "
+    "and a non-empty invariant note — a shrink without its proof is "
+    "how 2^31 overflows land silently (docs/performance.md)")
 
 STATE_PATH = "shadow_tpu/engine/state.py"
 
@@ -162,6 +170,7 @@ class StateModel:
         self.hot = ()              # HOT_FIELDS literal (may be absent
         #                            in fixture repos — see hot_set())
         self.cold_when = []        # [(guard, (fields...))] COLD_WHEN
+        self.narrow = []           # NARROW_SPEC entries (STF404)
         self.errors = []           # human-readable parse failures
         self.missing = False       # no state.py at all (fixture repo)
 
@@ -261,6 +270,14 @@ def load_state_model(cache) -> StateModel:
                 except (ValueError, TypeError):
                     m.errors.append("COLD_WHEN not a literal tuple of "
                                     "(guard, (fields...)) pairs")
+            elif tname == "NARROW_SPEC":
+                try:
+                    m.narrow = [tuple(e) for e in
+                                ast.literal_eval(node.value)]
+                except (ValueError, TypeError):
+                    m.errors.append(
+                        "NARROW_SPEC not a literal tuple of (field, "
+                        "wide, narrow, encoding, bound, why) entries")
         elif isinstance(node, ast.FunctionDef) and node.name in (
                 "alloc_hosts", "make_shared"):
             kind = HOSTS if node.name == "alloc_hosts" else SH
@@ -1386,6 +1403,83 @@ def _contract_violations(model: StateModel, matrix, drain_access):
                     STF304, STATE_PATH, 0,
                     f"COLD_WHEN[{guard}] names `{field}`, which is "
                     "not in HOT_FIELDS"))
+    # STF404: every narrowed column carries a machine-checked bound
+    # annotation (NARROW_SPEC) that actually proves the shrink safe.
+    # The narrow layout is opt-out (wide_state=0 is the default), so a
+    # malformed entry here is live-state corruption waiting to happen.
+    _NARROW_MAX = {"i8": 127, "i16": 32767, "i32": 2147483647,
+                   "u8": 255, "u16": 65535, "u32": 4294967295}
+    _RANK = {"i8": 1, "u8": 1, "i16": 2, "u16": 2, "i32": 4,
+             "u32": 4, "i64": 8, "u64": 8}
+    seen_narrow = set()
+    abs_anchors = {f for e in model.narrow
+                   if len(e) == 6 and e[3] == "abs" for f in (e[0],)}
+    for entry in model.narrow:
+        if len(entry) != 6:
+            out.append(Violation(
+                STF404, STATE_PATH, 0,
+                f"NARROW_SPEC entry {entry!r} is not a (field, wide, "
+                "narrow, encoding, bound, why) 6-tuple"))
+            continue
+        field, wide, narrow, enc, bound, why = entry
+        loc = model.linenos.get(field, 0)
+        if field in seen_narrow:
+            out.append(Violation(
+                STF404, STATE_PATH, loc,
+                f"NARROW_SPEC lists `{field}` twice"))
+        seen_narrow.add(field)
+        if field not in model.fields[HOSTS]:
+            out.append(Violation(
+                STF404, STATE_PATH, 0,
+                f"NARROW_SPEC names `{field}`, which is not a Hosts "
+                "field"))
+            continue
+        mdt = model.dtype_of(HOSTS, field)
+        if mdt != "?" and mdt != wide:
+            out.append(Violation(
+                STF404, STATE_PATH, loc,
+                f"NARROW_SPEC declares `{field}` wide dtype {wide} "
+                f"but the state model says {mdt} — the annotation "
+                "comment (the COMPUTE dtype handlers see) and the "
+                "spec must agree"))
+        if narrow not in _NARROW_MAX or wide not in _RANK:
+            out.append(Violation(
+                STF404, STATE_PATH, loc,
+                f"NARROW_SPEC `{field}`: unknown dtype pair "
+                f"({wide} -> {narrow})"))
+            continue
+        if _RANK[narrow] >= _RANK.get(wide, 0):
+            out.append(Violation(
+                STF404, STATE_PATH, loc,
+                f"NARROW_SPEC `{field}`: {narrow} is not strictly "
+                f"narrower than {wide} — the entry shrinks nothing"))
+        if not (isinstance(bound, int) and 0 < bound
+                <= _NARROW_MAX[narrow]):
+            out.append(Violation(
+                STF404, STATE_PATH, loc,
+                f"NARROW_SPEC `{field}`: bound {bound!r} does not fit "
+                f"{narrow} (max {_NARROW_MAX[narrow]}) — the shrink "
+                "is unproven"))
+        if not (enc == "abs" or (isinstance(enc, str)
+                                 and enc.startswith("rel:"))):
+            out.append(Violation(
+                STF404, STATE_PATH, loc,
+                f"NARROW_SPEC `{field}`: encoding {enc!r} is neither "
+                "'abs' nor 'rel:<anchor>'"))
+        elif enc != "abs":
+            anchor = enc.split(":", 1)[1]
+            if anchor not in abs_anchors:
+                out.append(Violation(
+                    STF404, STATE_PATH, loc,
+                    f"NARROW_SPEC `{field}`: rel anchor `{anchor}` is "
+                    "not an abs-narrowed NARROW_SPEC column (the "
+                    "codec widens anchors first; a non-narrowed or "
+                    "rel anchor breaks that ordering)"))
+        if not (isinstance(why, str) and why.strip()):
+            out.append(Violation(
+                STF404, STATE_PATH, loc,
+                f"NARROW_SPEC `{field}`: empty invariant note — name "
+                "the bound's enforcing mechanism"))
     return out
 
 

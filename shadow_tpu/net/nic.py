@@ -11,6 +11,14 @@ bounded input buffer with drop-on-overflow (:288-311) plus the 10ms
 batched receive become the rx-horizon admission test in `rx_admit`.
 
 All functions are row-level (one host under vmap).
+
+Shrink-campaign note (engine.state.NARROW_SPEC): the NIC columns stay
+at their wide dtypes deliberately. txq_pkt/ob_pkt are already int32
+wire words; nic_busy, txq/outbox timestamps and every other i64 here
+is a nanosecond simtime, and sim horizons (hours) times 10^9 clear
+int32 by orders of magnitude — narrowing any time column is a
+correctness bug, not a saving. The NIC's bytes/host lever is capacity,
+not dtype: txqcap/obcap come from apps.compile.auto_caps.
 """
 
 from __future__ import annotations

@@ -42,6 +42,18 @@ from ..core.constants import TCP_MSS
 # go-back-N via the RTO, never to wrong data
 K = 4
 
+# At-rest layout note (engine.state.NARROW_SPEC): the four scoreboard
+# tables (sk_ooo_s/e absolute receive offsets, sk_sack_s/e absolute
+# send-side offsets) are stored DELTA-ENCODED as int32 offsets from
+# their window anchor (sk_rcv_nxt / sk_snd_una) with -1 kept as the
+# empty sentinel. Live ranges always sit within the receive/send
+# window of their anchor, and windows are bounded by buf_cap = 2^30
+# (tcp._apply_buffer_sizes), so the deltas fit i32 with 2x margin.
+# This module never sees the encoded form: the drain and the op-replay
+# bridge decode to absolute i64 at entry (engine.state.widen_state)
+# and re-encode at exit, so every function below stays in absolute
+# offsets — including the -1/_INF sentinel arithmetic.
+
 _I64 = jnp.int64
 # plain Python int: a module-level jnp constant would initialize the
 # XLA backend at import time (breaking jax.distributed.initialize and

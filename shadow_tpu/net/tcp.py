@@ -166,7 +166,17 @@ def tcp_connect(row, hp, sh, now, dst_host, dst_port, tag=0):
 
 def tcp_write(row, now, slot, nbytes):
     """App writes `nbytes` to the stream (payload is not materialized;
-    only byte counts flow, as with all modeled apps)."""
+    only byte counts flow, as with all modeled apps).
+
+    Stream-offset bound (engine.state.NARROW_SPEC): sk_snd_end and
+    every other per-connection stream offset must stay < 2^31 — they
+    ride the wire's int32 SEQ/ACK/LEN packet words (net.packet), so an
+    offset past that is already a wire-encoding overflow, not a new
+    narrow-layout limit. Per-connection cumulative bytes are bounded
+    by the apps' declared transfer sizes (socks fetches cap at ~2 MiB
+    by the CONNECT tag, tgen/bulk open a fresh connection per
+    transfer); rcvbuf advertisement already truncates at 2^31 - 1
+    (_recv_window)."""
     row = _set(row, slot,
                sk_snd_end=rget(row.sk_snd_end, slot) + _I64(nbytes))
     return nic.kick(row, now)

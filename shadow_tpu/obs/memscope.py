@@ -87,10 +87,12 @@ def hbm_peak_gbps() -> float:
 # alloc_hosts edit that forgets this table fails that test by field
 # name.
 
-DTYPE_BYTES = {"i64": 8, "i32": 4, "u32": 4, "f32": 4, "bool": 1}
+DTYPE_BYTES = {"i64": 8, "i32": 4, "u32": 4, "f32": 4, "bool": 1,
+               "i16": 2, "u16": 2, "i8": 1}
 # canonical numpy names, for pinning against real array dtypes
 DTYPE_NAMES = {"i64": "int64", "i32": "int32", "u32": "uint32",
-               "f32": "float32", "bool": "bool"}
+               "f32": "float32", "bool": "bool",
+               "i16": "int16", "u16": "uint16", "i8": "int8"}
 
 # constant dims mirrored from their owning modules (pinned by the
 # exactness test): net.packet.PKT_WORDS, net.sack.K,
@@ -210,6 +212,37 @@ HP_DIMS = (
 )
 
 
+# The shrink campaign's at-rest dtype overlay (docs/performance.md):
+# when an EngineConfig allocates the narrow layout (wide_state == 0,
+# the default), these Hosts columns live at a narrower dtype than the
+# canonical wide one HOSTS_DIMS declares. A LITERAL mirror of
+# engine.state.NARROW_SPEC's (field -> narrow dtype) projection,
+# pinned against it by tests/test_shrink.py and against live arrays
+# by the census exactness pin — a NARROW_SPEC edit that forgets this
+# table fails by field name. HOSTS_DIMS itself stays wide-canonical:
+# it documents the COMPUTE dtype handlers see, and the digest's
+# canonical form.
+NARROW_DTYPES = {
+    "sk_proto": "i8", "sk_state": "i8", "sk_ctl": "i8",
+    "sk_lport": "u16", "sk_rport": "u16",
+    "sk_snd_una": "i32", "sk_snd_nxt": "i32", "sk_snd_max": "i32",
+    "sk_snd_end": "i32", "sk_rcv_nxt": "i32",
+    "sk_ooo_s": "i32", "sk_ooo_e": "i32",
+    "sk_sack_s": "i32", "sk_sack_e": "i32",
+    "sk_hole_end": "i32", "sk_rex_nxt": "i32", "sk_peer_fin": "i32",
+    "sk_rtt_seq": "i32",
+    "sk_peer_rwnd": "i32", "sk_sndbuf": "i32", "sk_rcvbuf": "i32",
+}
+
+
+def effective_dtype(field: str, dt: str, cfg=None) -> str:
+    """The AT-REST dtype of a Hosts column under this config: the
+    NARROW_DTYPES overlay applies unless cfg asks for the wide layout
+    (wide_state truthy). None = EngineConfig defaults = narrow."""
+    wide = int(getattr(cfg, "wide_state", 0)) if cfg is not None else 0
+    return dt if wide else NARROW_DTYPES.get(field, dt)
+
+
 def dims_of(cfg=None) -> dict:
     """Symbolic-dim sizes from an EngineConfig (duck-typed: anything
     with the cap attributes works, so headless callers can pass a
@@ -241,10 +274,14 @@ def row_shape(dims_spec: tuple, dims: dict) -> tuple:
 
 
 def row_bytes(field: str, cfg=None, table=HOSTS_DIMS) -> int:
-    """Per-host bytes of one column at this config (stdlib path)."""
+    """Per-host bytes of one column at this config (stdlib path).
+    Hosts columns honor the at-rest NARROW_DTYPES overlay
+    (effective_dtype); HP_DIMS rows have no narrow layout."""
     dims = dims_of(cfg)
     for name, dspec, dt in table:
         if name == field:
+            if table is HOSTS_DIMS:
+                dt = effective_dtype(name, dt, cfg)
             n = DTYPE_BYTES[dt]
             for d in row_shape(dspec, dims):
                 n *= d
@@ -254,10 +291,13 @@ def row_bytes(field: str, cfg=None, table=HOSTS_DIMS) -> int:
 
 def table_row_bytes(cfg=None, table=HOSTS_DIMS) -> dict:
     """{field: per-host bytes} for a whole dims table (stdlib path —
-    what state_matrix's bytes/host column reads)."""
+    what state_matrix's bytes/host column reads), at the layout this
+    config actually allocates (NARROW_DTYPES overlay on Hosts)."""
     dims = dims_of(cfg)
     out = {}
     for name, dspec, dt in table:
+        if table is HOSTS_DIMS:
+            dt = effective_dtype(name, dt, cfg)
         n = DTYPE_BYTES[dt]
         for d in row_shape(dspec, dims):
             n *= d
@@ -298,7 +338,7 @@ def state_census(cfg, hosts=None, hp=None, sh=None) -> dict:
 
     def _nbytes(shape, dtype_name):
         n = {"int64": 8, "int32": 4, "uint32": 4, "float32": 4,
-             "bool": 1}[dtype_name]
+             "bool": 1, "int16": 2, "uint16": 2, "int8": 1}[dtype_name]
         for d in shape:
             n *= int(d)
         return n
@@ -412,7 +452,7 @@ def _cost_dict(compiled):
     return dict(ca or {})
 
 
-def observe_executable(scope: str, compiled) -> dict:
+def observe_executable(scope: str, compiled, donated=()) -> dict:
     """Record one compiled program's XLA cost/memory analyses.
 
     Returns (and stores in :data:`CAPTURED` under `scope`) a dict::
@@ -433,6 +473,10 @@ def observe_executable(scope: str, compiled) -> dict:
            "bytes_accessed": None, "argument_bytes": None,
            "output_bytes": None, "temp_bytes": None,
            "alias_bytes": None, "generated_code_bytes": None,
+           # the DECLARED donation (core.jitcache.AotJit's
+           # donate_argnums) — the donation audit compares it against
+           # the MEASURED alias_bytes per executable
+           "donated": tuple(donated or ()),
            "errors": {}}
     if compiled is None:
         out["errors"]["compiled"] = "no executable"
@@ -492,6 +536,53 @@ def program_footprint(analysis: dict) -> int | None:
         return None
     return (analysis["argument_bytes"] + analysis["temp_bytes"]
             + analysis["output_bytes"] - analysis["alias_bytes"])
+
+
+def donation_audit(captured: dict = None) -> list:
+    """Donation/aliasing audit over the captured executables (lever 4
+    of the shrink campaign): one row per scope comparing the DECLARED
+    donation (AotJit donate_argnums, recorded at build time) against
+    the MEASURED ``alias_bytes`` from XLA memory_analysis. Flags:
+
+    - ``ok``          — donation declared and XLA aliased bytes;
+    - ``inert``       — donation declared but XLA aliased nothing
+      (the backend refused the alias: outputs double-buffer and the
+      program peaks ~2x its arguments — worth chasing per backend);
+    - ``undonated``   — no donation declared on a program whose
+      outputs could alias (output_bytes > 0): the state copy is paid
+      every call;
+    - ``unmeasured``  — the backend refused memory_analysis.
+
+    Sorted fattest-arguments first, so the top row is the biggest
+    lever. Rows are plain dicts (capacity_plan renders them)."""
+    rows = []
+    for scope, an in (captured if captured is not None
+                      else CAPTURED).items():
+        arg = an.get("argument_bytes")
+        if arg is None:
+            rows.append({"scope": scope, "flag": "unmeasured",
+                         "declared": list(an.get("donated") or ()),
+                         "argument_bytes": None, "alias_bytes": None,
+                         "temp_bytes": None, "output_bytes": None,
+                         "aliased_frac": None})
+            continue
+        alias = an.get("alias_bytes") or 0
+        declared = list(an.get("donated") or ())
+        if declared:
+            flag = "ok" if alias > 0 else "inert"
+        else:
+            flag = "undonated" if (an.get("output_bytes") or 0) > 0 \
+                else "ok"
+        rows.append({
+            "scope": scope, "flag": flag, "declared": declared,
+            "argument_bytes": int(arg),
+            "alias_bytes": int(alias),
+            "temp_bytes": int(an.get("temp_bytes") or 0),
+            "output_bytes": int(an.get("output_bytes") or 0),
+            "aliased_frac": round(alias / arg, 4) if arg else None,
+        })
+    rows.sort(key=lambda r: -(r["argument_bytes"] or 0))
+    return rows
 
 
 # --- live watermarks -------------------------------------------------------

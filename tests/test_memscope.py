@@ -64,7 +64,7 @@ def test_census_exactness_small_config():
     assert set(sc) == set(Hosts.__dataclass_fields__)
     table = MS.table_row_bytes(cfg)
     np_bytes = {"int64": 8, "int32": 4, "uint32": 4, "float32": 4,
-                "bool": 1}
+                "bool": 1, "int16": 2, "uint16": 2, "int8": 1}
     for f, (shape, dt) in sc.items():
         n = np_bytes[dt]
         for d in shape:
@@ -81,12 +81,18 @@ def test_census_exactness_small_config():
     census2 = MS.state_census(cfg)
     assert census2["hosts"]["bytes"] == census["hosts"]["bytes"]
     # hand-computed spot checks: eq_time [4, 8] i64, eq_pkt
-    # [4, 8, 13] i32, sk_ooo_s [4, 4, 4] i64, stats [4, 24] i64
+    # [4, 8, 13] i32, sk_ooo_s [4, 4, 4] i32 at rest (delta-encoded
+    # narrow layout — i64 under the --wide-state escape hatch),
+    # stats [4, 24] i64
     fl = census["hosts"]["fields"]
     assert fl["eq_time"]["bytes"] == 4 * 8 * 8
     assert fl["eq_pkt"]["bytes"] == 4 * 8 * 13 * 4
-    assert fl["sk_ooo_s"]["bytes"] == 4 * 4 * 4 * 8
+    assert fl["sk_ooo_s"]["bytes"] == 4 * 4 * 4 * 4
     assert fl["stats"]["bytes"] == 4 * 24 * 8
+    import dataclasses
+    wcfg = dataclasses.replace(cfg, wide_state=1)
+    wfl = MS.state_census(wcfg)["hosts"]["fields"]
+    assert wfl["sk_ooo_s"]["bytes"] == 4 * 4 * 4 * 8
     assert fl["eq_time"]["section"] == "event_queue"
     # HostParams table matches the real thing too (via a built sim in
     # the run tests; here the dims): hid i32 -> 4 B/host

@@ -1,0 +1,382 @@
+"""The shrink campaign (docs/performance.md): narrow at-rest socket
+layout, delta-encoded scoreboards, auto-caps and the proof obligations
+around them.
+
+Four layers, mirroring the digest/stateflow test philosophy:
+
+1. range audit — every NARROW_SPEC bound is re-derived from the OWNING
+   module's constants (MAX_PORT, TCPS_*, buf_cap, the wire's i32 SEQ
+   words) and checked against the narrow dtype's range, failing BY
+   FIELD NAME, so a constant bump that invalidates a shrink fails the
+   suite before it corrupts a run;
+2. codec unit — widen/narrow round-trips bit-exactly on live values
+   and sentinels, and is the identity (zero traced conversions) on a
+   --wide-state tree;
+3. lint — STF404 fires on every malformed NARROW_SPEC shape, and the
+   memscope NARROW_DTYPES mirror cannot drift from the engine spec;
+4. acceptance — same-seed digest chains are byte-identical between a
+   narrowed run and its --wide-state twin on the differential
+   scenarios (phold, lossy bulk, socks, tgen), pinning that
+   canonicalization masks freed slots of relative-encoded scoreboard
+   columns exactly like absolute ones.
+"""
+
+import importlib
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from shadow_tpu.engine.sim import Simulation          # noqa: E402
+from shadow_tpu.engine.state import (                 # noqa: E402
+    NARROW_ABS, NARROW_REL, NARROW_SPEC, EngineConfig, alloc_hosts,
+    narrow_dtypes)
+from shadow_tpu.obs import digest as D                # noqa: E402
+
+_NARROW_MAX = {"i8": 127, "i16": 32767, "i32": 2147483647,
+               "u8": 255, "u16": 65535, "u32": 4294967295}
+
+SMALL = dict(qcap=16, scap=4, obcap=8, incap=16, txqcap=8,
+             chunk_windows=8)
+
+
+@pytest.fixture(autouse=True)
+def _digest_global_reset():
+    yield
+    D.finish()
+
+
+# --- 1. per-field range audit ----------------------------------------------
+
+def _documented_maxima():
+    """The largest value each narrowed column can hold at the
+    documented maximum scenario parameters, re-derived from the owning
+    modules — NOT copied from NARROW_SPEC."""
+    from shadow_tpu.core.constants import MAX_PORT
+    from shadow_tpu.net.channel import PROTO_PIPE
+    from shadow_tpu.net.packet import PROTO_TCP, PROTO_UDP
+    from shadow_tpu.net.socket import (CTL_ACKNOW, CTL_FIN, CTL_RST,
+                                       CTL_SYN, CTL_SYNACK,
+                                       TCPS_TIME_WAIT)
+
+    buf_cap = 1 << 30          # net/tcp.py _apply_buffer_sizes
+    wire_seq = 2 ** 31 - 1     # int32 SEQ/ACK/WND packet words
+    return {
+        # delta-encoded scoreboards: offsets from their window anchor
+        # never exceed the buffer that admits the ranges
+        "sk_ooo_s": buf_cap, "sk_ooo_e": buf_cap,
+        "sk_sack_s": buf_cap, "sk_sack_e": buf_cap,
+        # absolute stream offsets ride the wire's int32 words
+        "sk_snd_una": wire_seq, "sk_snd_nxt": wire_seq,
+        "sk_snd_max": wire_seq, "sk_snd_end": wire_seq,
+        "sk_rcv_nxt": wire_seq, "sk_hole_end": wire_seq,
+        "sk_rex_nxt": wire_seq, "sk_peer_fin": wire_seq,
+        "sk_rtt_seq": wire_seq,
+        # buffers/windows are clamped at buf_cap
+        "sk_peer_rwnd": buf_cap, "sk_sndbuf": buf_cap,
+        "sk_rcvbuf": buf_cap,
+        # enums / flags / ports
+        "sk_proto": max(PROTO_PIPE, PROTO_TCP, PROTO_UDP),
+        "sk_state": TCPS_TIME_WAIT,
+        "sk_ctl": CTL_SYN | CTL_SYNACK | CTL_ACKNOW | CTL_FIN | CTL_RST,
+        "sk_lport": MAX_PORT, "sk_rport": MAX_PORT,
+    }
+
+
+def test_narrow_spec_range_audit():
+    """Every narrowed column's documented maximum fits its NARROW_SPEC
+    bound, and the bound fits the narrow dtype — per field, failing by
+    field name."""
+    maxima = _documented_maxima()
+    spec = {e[0]: e for e in NARROW_SPEC}
+    assert set(spec) == set(maxima), (
+        "NARROW_SPEC and the range audit disagree on WHICH columns "
+        f"are narrowed: {set(spec) ^ set(maxima)}")
+    for field, (_, wide, narrow, enc, bound, why) in spec.items():
+        mx = maxima[field]
+        assert mx <= bound, (
+            f"{field}: documented maximum {mx} exceeds the NARROW_SPEC "
+            f"bound {bound} — the shrink's proof no longer holds")
+        assert bound <= _NARROW_MAX[narrow], (
+            f"{field}: bound {bound} does not fit {narrow} "
+            f"(max {_NARROW_MAX[narrow]})")
+        assert why.strip(), f"{field}: empty invariant note"
+
+
+def test_excluded_columns_stay_wide():
+    """Columns the campaign deliberately does NOT narrow: nanosecond
+    times/durations exceed i32 (RTO_MAX alone is 1.2e12), and
+    sk_dupacks has no provable < 2^15 bound. Their absence from
+    NARROW_SPEC is a decision, not an oversight — pin it."""
+    narrowed = {e[0] for e in NARROW_SPEC}
+    for f in ("sk_rto", "sk_rto_deadline", "sk_srtt", "sk_rttvar",
+              "sk_rtt_min", "sk_hs_time", "sk_last_tx", "sk_rtt_time",
+              "sk_cc_epoch", "sk_dupacks", "sk_timer_gen"):
+        assert f not in narrowed, f"{f} must stay wide (see ISSUE 17)"
+
+
+# --- 2. the codec ----------------------------------------------------------
+
+def _named(tree):
+    from shadow_tpu.engine.checkpoint import named_leaves
+    return {k: np.array(v) for k, v in named_leaves(tree)}
+
+
+def test_codec_round_trip_bit_exact():
+    """narrow -> widen -> narrow is the identity on live values,
+    sentinels (-1) and anchors; widen reconstructs the absolute
+    scoreboard offsets exactly."""
+    from shadow_tpu.engine.state import narrow_state, widen_state
+
+    cfg = EngineConfig(num_hosts=2, **SMALL)
+    hosts = alloc_hosts(cfg)
+    nd = narrow_dtypes(cfg)
+    assert nd, "default layout must be narrow"
+    assert str(hosts.sk_snd_una.dtype) == "int32"
+    assert str(hosts.sk_proto.dtype) == "int8"
+    assert str(hosts.sk_lport.dtype) == "uint16"
+
+    import jax.numpy as jnp
+    rcv = jnp.array([[123_456_789, 0, 7, 0], [5, 0, 0, 0]], jnp.int32)
+    ooo_rel = jnp.full((2, 4, 4), -1, jnp.int32)
+    ooo_rel = ooo_rel.at[0, 0, 0].set(1434)       # abs 123_458_223
+    ooo_rel = ooo_rel.at[0, 0, 1].set(2 ** 30 - 1)
+    hosts = hosts.replace(
+        sk_rcv_nxt=rcv, sk_ooo_s=ooo_rel,
+        sk_lport=jnp.full((2, 4), 65535, jnp.uint16))
+
+    wide, was_narrow = widen_state(hosts)
+    assert was_narrow is True
+    assert str(wide.sk_ooo_s.dtype) == "int64"
+    w = _named(wide)
+    assert w["sk_ooo_s"][0, 0, 0] == 123_456_789 + 1434
+    assert w["sk_ooo_s"][0, 0, 1] == 123_456_789 + 2 ** 30 - 1
+    assert (w["sk_ooo_s"][1] == -1).all()          # sentinel survives
+    assert w["sk_lport"].dtype == np.dtype("int32")
+    assert (w["sk_lport"] == 65535).all()
+
+    back = narrow_state(wide)
+    a, b = _named(hosts), _named(back)
+    for k in a:
+        assert a[k].dtype == b[k].dtype, k
+        assert np.array_equal(a[k], b[k]), k
+
+
+def test_widen_is_identity_on_wide_layout():
+    """A --wide-state tree passes through untouched: was_narrow False,
+    the SAME arrays (no conversion traced at all)."""
+    from shadow_tpu.engine.state import widen_state
+
+    cfg = EngineConfig(num_hosts=2, wide_state=1, **SMALL)
+    assert narrow_dtypes(cfg) == {}
+    hosts = alloc_hosts(cfg)
+    assert str(hosts.sk_snd_una.dtype) == "int64"
+    out, was_narrow = widen_state(hosts)
+    assert was_narrow is False
+    assert out.sk_snd_una is hosts.sk_snd_una
+
+
+def test_canonicalize_masks_freed_rel_slots_like_abs():
+    """The satellite-f fix: freed socket rows carrying garbage
+    RELATIVE scoreboard values canonicalize identically to a wide
+    run's garbage ABSOLUTE values, and live rows decode to the same
+    canonical absolutes."""
+    from shadow_tpu.engine.window import canonicalize_state
+
+    ncfg = EngineConfig(num_hosts=2, **SMALL)
+    wcfg = EngineConfig(num_hosts=2, wide_state=1, **SMALL)
+    na, wa = _named(alloc_hosts(ncfg)), _named(alloc_hosts(wcfg))
+
+    # freed rows (sk_used False): DIFFERENT garbage in each encoding
+    na["sk_ooo_s"][0, 1, 0] = 55          # stale relative offset
+    wa["sk_ooo_s"][0, 1, 0] = 99_999      # stale absolute offset
+    na["sk_sack_e"][1, 0, 2] = 7
+    wa["sk_sack_e"][1, 0, 2] = -3
+
+    # one LIVE row with equivalent values in both encodings
+    for a in (na, wa):
+        a["sk_used"][0, 2] = True
+        a["sk_rcv_nxt"][0, 2] = 1000
+        a["sk_snd_una"][0, 2] = 500
+    na["sk_ooo_s"][0, 2, 0] = 34          # rel:  rcv_nxt + 34
+    wa["sk_ooo_s"][0, 2, 0] = 1034        # abs
+    na["sk_sack_s"][0, 2, 0] = 16         # rel:  snd_una + 16
+    wa["sk_sack_s"][0, 2, 0] = 516        # abs
+
+    cn, cw = canonicalize_state(na), canonicalize_state(wa)
+    assert set(cn) == set(cw)
+    for k in cn:
+        assert cn[k].dtype == cw[k].dtype, k
+        assert np.array_equal(cn[k], cw[k]), k
+    assert cn["sk_ooo_s"][0, 2, 0] == 1034
+
+
+# --- 3. lint + mirror pins -------------------------------------------------
+
+def test_memscope_narrow_dtypes_mirror_spec():
+    """obs.memscope.NARROW_DTYPES is a literal mirror of NARROW_SPEC's
+    (field -> narrow dtype) projection — field-for-field."""
+    from shadow_tpu.obs import memscope as MS
+    assert MS.NARROW_DTYPES == {e[0]: e[2] for e in NARROW_SPEC}
+
+
+def test_narrow_maps_cover_spec():
+    assert set(NARROW_ABS) | set(NARROW_REL) == \
+        {e[0] for e in NARROW_SPEC}
+    for f, (_, _, anchor) in NARROW_REL.items():
+        assert anchor in NARROW_ABS, (f, anchor)
+
+
+def _stf404(narrow_entries):
+    """STF404 violations for a mutated NARROW_SPEC over the real
+    repo's state model."""
+    from tools.simlint import load
+    load()
+    core = sys.modules["shadow_tpu.lint.core"]
+    stateflow = importlib.import_module("shadow_tpu.lint.stateflow")
+    m = stateflow.load_state_model(core.SourceCache(REPO))
+    assert not m.errors, m.errors
+    m.narrow = narrow_entries
+    vs = stateflow._contract_violations(m, {}, None)
+    return [v for v in vs if v.rule == "STF404"]
+
+
+def test_stf404_clean_on_repo_spec():
+    assert _stf404([tuple(e) for e in NARROW_SPEC]) == []
+
+
+def test_stf404_fires_on_malformed_entries():
+    ok = ("sk_snd_una", "i64", "i32", "abs", 2147483647, "wire i32")
+    cases = [
+        (("sk_snd_una", "i64", "i32", "abs", 2147483647), "6-tuple"),
+        ([ok, ok], "twice"),
+        (("sk_ghost", "i64", "i32", "abs", 1, "x"), "not a Hosts"),
+        (("sk_snd_una", "i32", "i8", "abs", 1, "x"), "must agree"),
+        (("sk_snd_una", "i64", "i77", "abs", 1, "x"), "unknown dtype"),
+        (("sk_snd_una", "i32", "i32", "abs", 1, "x"),
+         "not strictly narrower"),
+        (("sk_snd_una", "i64", "i32", "abs", 2147483648, "x"),
+         "does not fit"),
+        (("sk_snd_una", "i64", "i32", "zigzag", 1, "x"),
+         "neither 'abs'"),
+        (("sk_ooo_s", "i64", "i32", "rel:sk_rto", 1, "x"),
+         "not an abs-narrowed"),
+        (("sk_snd_una", "i64", "i32", "abs", 2147483647, "  "),
+         "empty invariant"),
+    ]
+    for entry, needle in cases:
+        vs = _stf404(entry if isinstance(entry, list) else [entry])
+        assert vs, f"no STF404 for {entry!r}"
+        assert any(needle in v.message for v in vs), (
+            needle, [v.message for v in vs])
+
+
+# --- auto-caps (lever 3) ---------------------------------------------------
+
+def test_auto_caps_baseline_configs():
+    """The declared-peak model on the three baseline families: the
+    relay is the fattest spec, and the derived caps keep the base's
+    qcap-scap RTO-timer headroom delta."""
+    from shadow_tpu.apps.compile import auto_caps
+    from tools.baseline_configs import CONFIGS
+
+    expect = {"socks10k": (17, 48, 144), "tor50k": (49, 112, 208),
+              "bulk1k": (5, 16, 112)}
+    for name, (peak, scap, qcap) in expect.items():
+        builder, capf, nd = CONFIGS[name]
+        base = capf(nd)
+        cfg, info = auto_caps(builder(nd, 60), base)
+        assert info["applied"], (name, info)
+        assert info["max_peak"] == peak, (name, info["peaks"])
+        assert (cfg.scap, cfg.qcap) == (scap, qcap), name
+        assert cfg.qcap - cfg.scap >= 16
+        assert cfg.obcap <= base.obcap and cfg.txqcap <= base.txqcap
+
+
+def test_auto_caps_bails_on_unbounded_apps():
+    from shadow_tpu.apps.compile import auto_caps
+    from shadow_tpu.core.config import HostSpec, ProcessSpec, Scenario
+
+    scen = Scenario(stop_time=10 ** 9, hosts=[
+        HostSpec(id="h", processes=[
+            ProcessSpec(plugin="hosted:tor", arguments="")])])
+    base = EngineConfig(num_hosts=1, **SMALL)
+    cfg, info = auto_caps(scen, base)
+    assert not info["applied"] and "hosted" in info["why"]
+    assert cfg is base
+
+
+def test_capacity_plan_self_check_and_gap_table():
+    from tools import capacity_plan as CP
+    assert CP.self_check() == 0
+    census = {"per_host": 100, "hosts": {"fields": {
+        "fat": {"bytes": 0, "per_host": 60, "dtype": "int64",
+                "section": "s"},
+        "thin": {"bytes": 0, "per_host": 40, "dtype": "int32",
+                 "section": "s"}}}}
+    g = CP.gap_table(census, 50)
+    assert [r["field"] for r in g["rows"]] == ["fat"]  # 60 covers 50
+    assert g["covered"] and not g["met"]
+    assert CP.gap_table(census, 200)["met"]
+
+
+# --- 4. acceptance: wide-vs-narrow digest parity ---------------------------
+
+def _parity(tmp_path, name, scen_fn, n_hosts, cfg_kwargs, stop_hint=""):
+    """Same-seed, same-scenario runs at the two layouts must produce
+    byte-identical digest chains (the canonical form is the wide
+    layout, by construction)."""
+    chains = []
+    for tag, wide in (("narrow", 0), ("wide", 1)):
+        p = tmp_path / f"{name}-{tag}.jsonl"
+        sim = Simulation(scen_fn(),
+                         engine_cfg=EngineConfig(num_hosts=n_hosts,
+                                                 wide_state=wide,
+                                                 **cfg_kwargs))
+        sim.run(digest=str(p), digest_every=4)
+        chains.append(open(p, "rb").read())
+    assert chains[0], f"{name}: empty digest chain"
+    assert chains[0] == chains[1], (
+        f"{name}: digest chain differs between the narrow layout and "
+        "its --wide-state twin")
+
+
+def test_parity_phold(tmp_path):
+    from test_phold import phold_scenario
+    _parity(tmp_path, "phold", lambda: phold_scenario(n=8, stop=4), 8,
+            SMALL)
+
+
+def test_parity_lossy_bulk(tmp_path):
+    """The satellite-f dual-run pin: loss creates OOO/SACK scoreboard
+    churn AND freed socket rows with stale relative offsets — parity
+    proves canonicalization masks them like the wide run's stale
+    absolutes."""
+    from test_differential import _bulk_scen
+    _parity(tmp_path, "lossy-bulk",
+            _bulk_scen(loss=0.05, size=120_000, count=2, stop=40), 2,
+            SMALL)
+
+
+@pytest.mark.slow
+def test_parity_socks(tmp_path):
+    from test_differential import SOCKS_CFG, _socks_scen
+    _parity(tmp_path, "socks", _socks_scen(hops=2, clients=3, stop=40),
+            8, SOCKS_CFG)
+
+
+@pytest.mark.slow
+def test_parity_tgen(tmp_path, simple_topology_xml):
+    from test_tgen import tgen_scenario
+
+    lossy = simple_topology_xml.replace('<data key="d9">0.0</data>',
+                                        '<data key="d9">0.03</data>')
+    _parity(tmp_path, "tgen",
+            lambda: tgen_scenario(lossy, n_web=2, n_bulk=1, stop=30), 5,
+            dict(qcap=24, scap=6, obcap=12, incap=16, txqcap=8,
+                 chunk_windows=8))

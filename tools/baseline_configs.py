@@ -219,13 +219,23 @@ CONFIGS = {
 
 def run_config(name, n=None, stop=60, heartbeat=0.0, verbose=False,
                runahead_ms=0, chunk=0, active_block=None,
-               event_batch=None):
+               event_batch=None, auto_caps=False, wide_state=False):
     from shadow_tpu.engine.sim import Simulation
 
     builder, capf, n_default = CONFIGS[name]
     n = n or n_default
     scen = builder(n, stop)
     cfg = capf(n)
+    if auto_caps:
+        # shrink lever 3 (docs/performance.md "The shrink campaign"):
+        # OFF by default here so the measurement baseline and its
+        # ledger trajectory stay on the hand-tuned caps; capacity_plan
+        # defaults it ON for planning runs
+        from shadow_tpu.apps.compile import auto_caps as _ac
+        cfg, _ = _ac(scen, cfg)
+    if wide_state:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, wide_state=1)
     if chunk or active_block is not None or event_batch is not None:
         # a wider runahead packs ~runahead/min-latency more event
         # passes into each window — keep one device dispatch (a chunk)
@@ -307,6 +317,13 @@ def main(argv):
                     help="events drained per gathered host per sparse "
                          "pass (A/B the pass-count batching; 1 = "
                          "one event per pass)")
+    ap.add_argument("--auto-caps", action="store_true",
+                    help="size scap/qcap/obcap/txqcap from the apps' "
+                         "declared peaks (shrink lever 3; default "
+                         "here is the hand-tuned base caps)")
+    ap.add_argument("--wide-state", action="store_true",
+                    help="force the wide at-rest socket layout (the "
+                         "shrink campaign's A/B escape hatch)")
     args = ap.parse_args(argv)
     if args.emit_xml:
         caps = emit_xml(args.config, args.emit_xml, n=args.n,
@@ -334,9 +351,15 @@ def main(argv):
     out = run_config(args.config, n=args.n, stop=args.stop,
                      verbose=args.verbose, runahead_ms=args.runahead_ms,
                      chunk=args.chunk, active_block=args.active_block,
-                     event_batch=args.event_batch)
+                     event_batch=args.event_batch,
+                     auto_caps=args.auto_caps,
+                     wide_state=args.wide_state)
     if args.runahead_ms:
         out["runahead_ms"] = args.runahead_ms
+    if args.auto_caps:
+        out["auto_caps"] = True
+    if args.wide_state:
+        out["wide_state"] = True
     print(json.dumps(out))
 
 

@@ -66,9 +66,20 @@ def _gib(n) -> float:
 
 
 def measure(config: str, n: int = None, stop: int = 2,
-            runahead_ms: int = 0, seed: int = None) -> dict:
+            runahead_ms: int = 0, seed: int = None,
+            auto_caps: bool = True, wide_state: bool = False) -> dict:
     """Build, census, run and capture one scenario at a measurable
-    size. Returns the raw figures plan() extrapolates from."""
+    size. Returns the raw figures plan() extrapolates from.
+
+    `auto_caps` (shrink lever 3, default ON): size scap/qcap/obcap/
+    txqcap from the apps' declared peaks (apps.compile.auto_caps)
+    instead of the config family's hand-tuned worst case; the saving
+    vs the base caps is reported. `wide_state` (the A/B escape hatch)
+    forces the wide at-rest layout — the knob digest-parity runs
+    compare against."""
+    import dataclasses
+
+    from shadow_tpu.apps import compile as AC
     from shadow_tpu.engine.sim import Simulation
     from shadow_tpu.obs import memscope as MS
     from tools.baseline_configs import apply_runahead
@@ -77,6 +88,18 @@ def measure(config: str, n: int = None, stop: int = 2,
     scen, cfg, n = build_config(config, n, stop)
     if seed is not None:
         scen.seed = seed
+    caps = {"applied": False, "why": "--no-auto-caps"}
+    if auto_caps:
+        base = cfg
+        cfg, caps = AC.auto_caps(scen, cfg)
+        if caps["applied"]:
+            # eval_shape censuses (zero allocation) of both layouts:
+            # the lever's own saving, independent of the dtype levers
+            caps["saved_bytes_per_host"] = (
+                MS.state_census(base)["per_host"]
+                - MS.state_census(cfg)["per_host"])
+    if wide_state:
+        cfg = dataclasses.replace(cfg, wide_state=1)
     sim = apply_runahead(Simulation(scen, engine_cfg=cfg), runahead_ms)
     census = MS.state_census(sim.cfg, hosts=sim.hosts, hp=sim.hp,
                              sh=sim.sh)
@@ -86,6 +109,11 @@ def measure(config: str, n: int = None, stop: int = 2,
         "census": census,
         "memory": report.memory,
         "events": report.events,
+        "caps": caps,
+        "wide_state": bool(wide_state),
+        # lever 4's evidence: per-program declared donation vs the
+        # aliasing/temps XLA measured (obs.memscope.donation_audit)
+        "donation": MS.donation_audit(),
     }
 
 
@@ -160,6 +188,9 @@ def plan(measured: dict, hbm_gb: float, targets=DEFAULT_TARGETS,
 
     return {
         "config": measured["config"],
+        "caps": measured.get("caps"),
+        "wide_state": measured.get("wide_state", False),
+        "donation": measured.get("donation"),
         "measured_hosts": H,
         "hbm_budget_gib": round(_gib(budget), 3),
         "per_host_state_bytes": per_host_state,
@@ -177,6 +208,83 @@ def plan(measured: dict, hbm_gb: float, targets=DEFAULT_TARGETS,
     }
 
 
+def gap_table(census: dict, target: int) -> dict:
+    """The per-field shrink gap: where the next bytes must come from
+    to reach `target` bytes/host. Pure arithmetic on a census dict.
+
+    Fields (Hosts + HostParams) are ranked fattest-first; each row
+    carries its per-host bytes and the running cumulative, and the
+    table cuts off once the cumulative covers the gap — i.e. it names
+    the smallest fattest-first set whose TOTAL elimination would meet
+    the target, the upper bound on what any dtype/cap lever combination
+    operating on those fields can recover."""
+    per_host = census["per_host"]
+    gap = per_host - target
+    fields = []
+    for f, d in census["hosts"]["fields"].items():
+        fields.append({"field": f, "per_host": d["per_host"],
+                       "dtype": d["dtype"], "section": d["section"],
+                       "table": "hosts"})
+    for f, d in census.get("hp", {}).get("fields", {}).items():
+        fields.append({"field": f, "per_host": d["per_host"],
+                       "dtype": d["dtype"], "section": "params",
+                       "table": "hp"})
+    fields.sort(key=lambda r: (-r["per_host"], r["field"]))
+    rows, cum = [], 0
+    for r in fields:
+        if gap > 0 and cum >= gap:
+            break
+        cum += r["per_host"]
+        rows.append(dict(r, cumulative=cum,
+                         share=round(r["per_host"] / max(per_host, 1),
+                                     4)))
+        if gap <= 0:
+            break       # target already met: show only the fattest
+    return {"per_host": per_host, "target": target, "gap": gap,
+            "met": gap <= 0, "covered": cum >= gap, "rows": rows}
+
+
+def render_gap(g: dict) -> str:
+    lines = []
+    if g["met"]:
+        lines.append(
+            f"### shrink gap: target {g['target']} B/host MET "
+            f"(current {g['per_host']} B/host, "
+            f"headroom {-g['gap']} B)")
+    else:
+        lines.append(
+            f"### shrink gap: {g['per_host']} B/host vs target "
+            f"{g['target']} — {g['gap']} B/host to recover "
+            f"(fattest-first cut set below"
+            + (")" if g["covered"] else
+               "; ALL fields together do not cover it)"))
+    lines += ["", "| field | B/host | dtype | section | cum B |",
+              "|---|---|---|---|---|"]
+    for r in g["rows"]:
+        lines.append(f"| {r['field']} | {r['per_host']} | {r['dtype']} "
+                     f"| {r['section']} | {r['cumulative']} |")
+    return "\n".join(lines)
+
+
+def render_donation(rows: list) -> str:
+    """Markdown for memscope.donation_audit() — lever 4's worksheet:
+    which compiled programs donate their fat arguments, whether XLA
+    actually aliased them, and the temp bytes left to attack."""
+    lines = ["### donation audit (state-carrying executables)", "",
+             "| scope | flag | donated | args B | aliased | temps B |",
+             "|---|---|---|---|---|---|"]
+    if not rows:
+        return lines[0] + "\n\n(no executables captured this run)"
+    for r in rows:
+        frac = r.get("aliased_frac")
+        lines.append(
+            f"| {r['scope']} | {r['flag']} | {r['declared']} "
+            f"| {r['argument_bytes']} "
+            f"| {'—' if frac is None else f'{frac * 100:.0f}%'} "
+            f"| {r['temp_bytes']} |")
+    return "\n".join(lines)
+
+
 def render_markdown(p: dict) -> str:
     v = p["validation"]
     lines = [
@@ -192,6 +300,19 @@ def render_markdown(p: dict) -> str:
         f"- watermark: {p['watermark']['peak_bytes']} B "
         f"({p['watermark']['source']})",
     ]
+    caps = p.get("caps") or {}
+    if caps.get("applied"):
+        c, b = caps["caps"], caps["base_caps"]
+        lines.insert(3, f"- auto-caps: scap {b['scap']}->{c['scap']}, "
+                        f"qcap {b['qcap']}->{c['qcap']} (max declared "
+                        f"peak {caps['max_peak']} sockets; saves "
+                        f"{caps.get('saved_bytes_per_host', '?')} "
+                        f"B/host vs the base caps)")
+    elif caps:
+        lines.insert(3, f"- auto-caps: OFF ({caps.get('why')})")
+    if p.get("wide_state"):
+        lines.insert(3, "- layout: WIDE (--wide-state A/B escape "
+                        "hatch — narrow dtype levers disabled)")
     if v["ok"] is None:
         lines.append(f"- validation: UNVALIDATED — {v.get('why')}")
     else:
@@ -217,14 +338,104 @@ def render_markdown(p: dict) -> str:
     return "\n".join(lines)
 
 
+def self_check() -> int:
+    """No-jax census-exactness smoke for CI's fast lane.
+
+    Builds a synthetic measurement from memscope's stdlib table
+    helpers (the same per-field arithmetic the real census uses) and
+    asserts: (1) plan() validates at exactly 0.00% when the measured
+    argument bytes equal the census's own prediction — the exactness
+    contract the shrink campaign gates on; (2) the narrow layout's
+    modeled socket rows are strictly under the wide layout's, with the
+    engine's NARROW_SPEC and memscope's NARROW_DTYPES projection in
+    sync field-for-field; (3) gap_table covers a gap fattest-first
+    and reports a met target as met."""
+    from shadow_tpu.obs import memscope as MS
+
+    H = 1024
+
+    class _Caps:
+        num_hosts, qcap, scap, obcap, txqcap = H, 144, 48, 24, 16
+        wide_state = 0
+
+    class _WideCaps(_Caps):
+        wide_state = 1
+
+    narrow = MS.table_row_bytes(_Caps)
+    wide = MS.table_row_bytes(_WideCaps)
+    assert sum(narrow.values()) < sum(wide.values()), \
+        "narrow layout models no saving over wide"
+    for f in MS.NARROW_DTYPES:
+        assert narrow[f] < wide[f], \
+            f"narrowed field {f} models no saving"
+
+    hosts_b = sum(narrow.values()) * H
+    fields = {f: {"bytes": narrow[f] * H, "per_host": narrow[f],
+                  "dtype": MS.DTYPE_NAMES[
+                      MS.effective_dtype(f, dt, _Caps)],
+                  "shape": [], "section": "synthetic", "hot": False,
+                  "hot_runtime": False}
+              for f, _, dt in MS.HOSTS_DIMS}
+    census = {"H": H, "bytes": hosts_b, "per_host": hosts_b // H,
+              "fixed_bytes": 0,
+              "hosts": {"fields": fields, "bytes": hosts_b,
+                        "per_host": hosts_b // H,
+                        "hot": {"runtime_bytes": 0}},
+              "hp": {"fields": {}, "bytes": 0, "per_host": 0}}
+    measured = {
+        "config": "self-check", "hosts": H, "stop_s": 0,
+        "census": census,
+        "memory": {"xla": {"argument_bytes":
+                           census["bytes"] + SCALAR_ARG_BYTES,
+                           "temp_bytes": 0, "output_bytes": 0,
+                           "alias_bytes": 0,
+                           "generated_code_bytes": 0},
+                   "peak_bytes": None, "source": "synthetic",
+                   "per_device": None},
+        "events": 0,
+    }
+    p = plan(measured, hbm_gb=16.0, targets=(100_000,))
+    v = p["validation"]
+    assert v["ok"] and v["rel_error"] == 0.0, \
+        f"census-exactness broken: {v}"
+
+    g = gap_table(census, census["per_host"] // 2)
+    assert not g["met"] and g["covered"] and g["rows"], g
+    assert g["rows"] == sorted(g["rows"],
+                               key=lambda r: -r["per_host"]), \
+        "gap table not fattest-first"
+    assert gap_table(census, census["per_host"] + 1)["met"]
+
+    print(f"capacity_plan self-check OK: narrow Hosts rows "
+          f"{sum(narrow.values())} B/host vs wide "
+          f"{sum(wide.values())} B/host; census-vs-XLA 0.00%")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="predict max hosts per chip from measured bytes "
                     "(docs/performance.md 'Sizing the 1M push')")
-    ap.add_argument("config", help="phold | socks10k | tor50k | bulk1k")
+    ap.add_argument("config", nargs="?", default=None,
+                    help="phold | socks10k | tor50k | bulk1k")
     ap.add_argument("--n", type=int, default=None,
                     help="hosts at the MEASUREMENT scale (default: "
                          "the config's own)")
+    ap.add_argument("--self-check", action="store_true",
+                    help="no-jax census-exactness + layout-model smoke "
+                         "(CI fast lane); ignores the other arguments")
+    ap.add_argument("--auto-caps", dest="auto_caps",
+                    action=argparse.BooleanOptionalAction, default=True,
+                    help="size scap/qcap/obcap/txqcap from the apps' "
+                         "declared peaks (shrink lever 3; default ON, "
+                         "--no-auto-caps = the config's hand-tuned "
+                         "base caps)")
+    ap.add_argument("--wide-state", action="store_true",
+                    help="force the wide at-rest layout (the shrink "
+                         "campaign's A/B escape hatch)")
+    ap.add_argument("--target-bytes-per-host", type=int, default=None,
+                    help="also print the per-field shrink-gap table "
+                         "toward this bytes/host target")
     ap.add_argument("--stop", type=int, default=2)
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--runahead-ms", type=int, default=0)
@@ -247,6 +458,11 @@ def main(argv=None) -> int:
                     help="also write the markdown table to a file")
     args = ap.parse_args(argv)
 
+    if args.self_check:
+        return self_check()
+    if not args.config:
+        ap.error("config required (or --self-check)")
+
     targets = DEFAULT_TARGETS
     if args.targets:
         try:
@@ -262,14 +478,23 @@ def main(argv=None) -> int:
         os.environ["JAX_PLATFORMS"] = "cpu"
 
     measured = measure(args.config, n=args.n, stop=args.stop,
-                       runahead_ms=args.runahead_ms, seed=args.seed)
+                       runahead_ms=args.runahead_ms, seed=args.seed,
+                       auto_caps=args.auto_caps,
+                       wide_state=args.wide_state)
     p = plan(measured, args.hbm_gb, targets=targets,
              tolerance=args.tolerance)
+    if args.target_bytes_per_host:
+        p["gap"] = gap_table(measured["census"],
+                             args.target_bytes_per_host)
 
     if args.json:
         print(json.dumps(p, indent=1))
     else:
         md = render_markdown(p)
+        if p.get("gap"):
+            md += "\n\n" + render_gap(p["gap"])
+        if p.get("donation") is not None:
+            md += "\n\n" + render_donation(p["donation"])
         print(md)
         if not args.markdown:
             print(json.dumps({k: p[k] for k in

@@ -242,6 +242,56 @@ def render_json(matrix, model, root) -> str:
     }, indent=1, sort_keys=False) + "\n"
 
 
+def render_top(matrix, model, n: int) -> str:
+    """The shrink campaign's targeting report (``--top N``): the N
+    fattest Hosts columns by bytes/host at the EngineConfig defaults,
+    with their hot/cold/drain membership and at-rest layout — the
+    fattest column not yet narrowed or capacity-scaled is the next
+    lever. Bytes honor the NARROW_DTYPES overlay (the default layout);
+    the `wide` column shows what the --wide-state escape hatch would
+    pay, so the per-field saving is the difference."""
+    ms = _memscope()
+    narrow_bm = ms.table_row_bytes(None, ms.HOSTS_DIMS)
+
+    class _Wide:
+        wide_state = 1
+    wide_bm = ms.table_row_bytes(_Wide(), ms.HOSTS_DIMS)
+    drain = matrix.get("drain", {}).get("hosts", {})
+    drain_cols = set(drain.get("reads", {})) | set(drain.get("writes",
+                                                             {}))
+    hot = set(model.hot_set())
+    rows = sorted(narrow_bm.items(), key=lambda kv: (-kv[1], kv[0]))
+    header = ["field", "B/host", "wide", "dtype", "layout", "split",
+              "drain", "section"]
+    table = []
+    for field, b in rows[:max(n, 0)]:
+        nd = ms.NARROW_DTYPES.get(field)
+        table.append([
+            field, b, wide_bm[field],
+            model.dtype_of("hosts", field),
+            (f"narrow:{nd}" if nd else "wide"),
+            ("cold" if field in model.cold
+             else "hot" if field in hot else "?"),
+            ("yes" if field in drain_cols else ""),
+            model.section_of(field) or "other",
+        ])
+    widths = [max(len(str(r[i])) for r in [header] + table)
+              for i in range(len(header))]
+    out = [f"## top {len(table)} Hosts columns by bytes/host "
+           "(EngineConfig defaults; narrow at-rest layout)"]
+    out.append("  ".join(h.ljust(w) for h, w in zip(header, widths)))
+    for r in table:
+        out.append("  ".join(str(c).ljust(w)
+                             for c, w in zip(r, widths)))
+    shown = sum(r[1] for r in table)
+    total = sum(narrow_bm.values())
+    out.append("")
+    out.append(f"shown {shown} of {total} B/host "
+               f"({100.0 * shown / max(total, 1):.1f}%); wide layout "
+               f"total {sum(wide_bm.values())} B/host")
+    return "\n".join(out)
+
+
 def diff_snapshot(matrix, model, snap_path: str) -> list:
     """Compare the freshly-built matrix against a committed snapshot
     (render_json output). Returns a list of human-readable failures —
@@ -298,6 +348,11 @@ def main(argv=None) -> int:
                    help="compare against a committed --json snapshot; "
                         "exit 1 when the drain working set grew or "
                         "the declared partition changed (CI gate)")
+    p.add_argument("--top", type=int, metavar="N", default=None,
+                   help="show only the N fattest Hosts columns by "
+                        "bytes/host with their hot/cold/drain "
+                        "membership (the shrink campaign's targeting "
+                        "report)")
     p.add_argument("-o", "--out", default=None,
                    help="write to a file instead of stdout")
     args = p.parse_args(argv)
@@ -323,7 +378,9 @@ def main(argv=None) -> int:
               f"{args.diff}")
         return 0
 
-    if args.json:
+    if args.top is not None:
+        text = render_top(matrix, model, args.top)
+    elif args.json:
         text = render_json(matrix, model, root)
     elif args.markdown:
         text = render_markdown(matrix, model)
