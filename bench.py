@@ -285,6 +285,14 @@ def _emit(metric, summary, baseline, baseline_cfg, baseline_c=None,
         line["rtt_p50_us"] = summary["rtt_p50_us"]
         line["rtt_p99_us"] = summary["rtt_p99_us"]
         line["completion_p99_s"] = summary.get("completion_p99_s")
+    if "waste_frac" in summary:
+        # lockstep occupancy (obs.passcope): the wasted-lane fraction
+        # beside the rate — and, on --passcope runs, which pass the
+        # device time concentrated in
+        line["waste_frac"] = summary["waste_frac"]
+        if "top_pass" in summary:
+            line["top_pass"] = summary["top_pass"]
+            line["top_pass_frac"] = summary["top_pass_frac"]
     if baseline_c:
         line["baseline_c"] = baseline_c
         if baseline_c.get("events_per_sec"):

@@ -173,6 +173,19 @@ def main(argv=None):
                         "Deterministic; changes the compiled shape "
                         "(and so the config fingerprint), never the "
                         "simulation results")
+    p.add_argument("--passcope", nargs="?", const="", default=None,
+                   metavar="DIR",
+                   help="pass-time observatory (obs.passcope): "
+                        "profile the first few chunks with "
+                        "jax.profiler into DIR (default "
+                        "passcope_trace; SHADOW_TPU_PASSCOPE also "
+                        "enables it), decode the xplane dump into a "
+                        "per-pass DEVICE-time table keyed by the "
+                        "stateflow entry names, and print it with "
+                        "the lockstep-occupancy block after the run. "
+                        "Observation only — digest chains are "
+                        "byte-identical to a plain run's "
+                        "(docs/performance.md)")
     p.add_argument("--perf", nargs="?", const="", default=None,
                    metavar="LEDGER",
                    help="per-phase wall attribution + perf ledger: "
@@ -538,7 +551,8 @@ def main(argv=None):
                          digest=args.digest,
                          digest_every=args.digest_every,
                          digest_context=dg_ctx,
-                         netscope=args.netscope)
+                         netscope=args.netscope,
+                         passcope=args.passcope)
     except Preempted as pe:
         from .engine.supervisor import EXIT_PREEMPTED
         logger.message(pe.sim_ns, "main",
@@ -583,6 +597,12 @@ def main(argv=None):
         if lpath:
             logger.message(report.sim_time_ns, "main",
                            f"perf ledger += {lpath}")
+    if args.passcope is not None or report.device_phases:
+        # pass-time observatory read-out (obs.passcope): the decoded
+        # per-pass device table + lockstep-occupancy block
+        from .obs import passcope as PCOPE
+        print(PCOPE.format_report(report.device_phases or None,
+                                  report.occupancy or None))
     logger.message(report.sim_time_ns, "main",
                    f"done: {s['events']} events in {s['wall_seconds']:.2f}s "
                    f"wall ({s['events_per_sec']:.0f} ev/s, "

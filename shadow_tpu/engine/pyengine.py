@@ -130,9 +130,15 @@ class PyEngine:
     sim.run().stats (build two Simulations; each is single-use).
     """
 
-    def __init__(self, sim):
+    def __init__(self, sim, count_passes=False):
         cfg = sim.cfg
         self.cfg = cfg
+        # lockstep pass recount (obs.passcope differential): when on,
+        # run() drains windows in the compiled engine's pass order and
+        # tallies {rung label: passes} into self.pass_mix, comparable
+        # to SimReport pass_acc / engine.window.pass_labels
+        self.count_passes = count_passes
+        self.pass_mix = {}
         H = cfg.num_hosts
         self.H = H
         self.hp_vertex = np.asarray(sim.hp.vertex)
@@ -1605,6 +1611,98 @@ class PyEngine:
                 self.stats[src, defs.ST_DEFER_FANIN] += 1
         return departed
 
+    # --- lockstep pass recount (obs.passcope differential) ---
+    def _exec_due(self, host, wend):
+        """Execute the host's due minimum event plus the same-slot
+        NIC-TX chain (engine.window._step_hot mirror). -> events run."""
+        t, seq, kind, pkt = self._q_pop_min(host)
+        self.stats[host.hid, defs.ST_EVENTS] += 1
+        n = 1
+        if kind == EV_APP:
+            self._app(host, t, pkt)
+        elif kind == EV_PKT:
+            self._on_pkt(host, t, pkt)
+        elif kind == EV_NIC_TX:
+            self._on_tx(host, t, wend)
+        elif kind == EV_TCP_TIMER:
+            self._on_tcp_timer(host, t, pkt)
+        elif kind == EV_TCP_CLOSE:
+            self._on_tcp_close(host, t, pkt)
+        if not self.cfg.cpu_model and host.events:
+            slot = min(host.events, key=lambda s: (host.events[s][0],
+                                                   host.events[s][1]))
+            t2, _, k2, _ = host.events[slot]
+            if t2 == t and k2 == EV_NIC_TX:
+                self._q_pop_min(host)
+                self.stats[host.hid, defs.ST_EVENTS] += 1
+                self._on_tx(host, t, wend)
+                n += 1
+        return n
+
+    def _drain_lockstep(self, wend):
+        """Drain one window in the compiled engine's lockstep pass
+        order, counting passes per rung label into self.pass_mix.
+
+        Mirror of engine.window._drain_hot/_pass_hot: the same
+        searchsorted rung selection over the same ladders, the same
+        per-pass event budget (sparse_batch events per gathered host on
+        sparse rungs, one per ready host on dense), the same fixed
+        active set inside a window rung with inner passes tallied into
+        the w slot. State-identical to the plain per-host drain — hosts
+        only interact at the exchange, and per-host event order is
+        unchanged — but the pass counts line up with the device
+        pass_acc so occupancy math can be recounted independently.
+        -> events executed."""
+        import bisect
+        from .window import ladder_of, sparse_batch, window_ladder
+        cfg = self.cfg
+        wks = window_ladder(cfg, self.H)
+        ks = ladder_of(cfg, self.H)
+        B = sparse_batch(cfg)
+        nexec = 0
+
+        def run_pass(ready, batch):
+            n = 0
+            for host in ready:
+                for _ in range(batch):
+                    if self._next_time(host) >= wend:
+                        break
+                    n += self._exec_due(host, wend)
+            return n
+
+        active = [h for h in self.hosts if self._next_time(h) < wend]
+        widx = bisect.bisect_left(wks, len(active))
+        if wks and active and widx < len(wks):
+            # window rung: the K-sub is gathered once at window open
+            # (hosts idle at open stay out the whole window); each
+            # inner pass reselects its own sub-ladder rung
+            sub_ks = ladder_of(cfg, wks[widx])
+            lbl = "w%d" % wks[widx]
+            while True:
+                ready = [h for h in active if self._next_time(h) < wend]
+                if not ready:
+                    break
+                self.pass_mix[lbl] = self.pass_mix.get(lbl, 0) + 1
+                r = bisect.bisect_left(sub_ks, len(ready))
+                nexec += run_pass(ready, B if r < len(sub_ks) else 1)
+        else:
+            while True:
+                ready = [h for h in self.hosts
+                         if self._next_time(h) < wend]
+                if not ready:
+                    break
+                if wks:
+                    # overflow past the window ladder runs plain dense
+                    lbl, batch = "dense", 1
+                else:
+                    r = bisect.bisect_left(ks, len(ready))
+                    sparse = r < len(ks)
+                    lbl = "k%d" % ks[r] if sparse else "dense"
+                    batch = B if sparse else 1
+                self.pass_mix[lbl] = self.pass_mix.get(lbl, 0) + 1
+                nexec += run_pass(ready, batch)
+        return nexec
+
     # --- main loop ---
     def run(self):
         from ..obs import metrics as MT
@@ -1619,26 +1717,31 @@ class PyEngine:
             wend = min(nt + self.min_jump, self.stop)
             executed = False
             nexec = 0
-            progressed = True
-            while progressed:
-                progressed = False
-                for host in self.hosts:
-                    while host.events and self._next_time(host) < wend:
-                        t, seq, kind, pkt = self._q_pop_min(host)
-                        self.stats[host.hid, defs.ST_EVENTS] += 1
-                        nexec += 1
-                        if kind == EV_APP:
-                            self._app(host, t, pkt)
-                        elif kind == EV_PKT:
-                            self._on_pkt(host, t, pkt)
-                        elif kind == EV_NIC_TX:
-                            self._on_tx(host, t, wend)
-                        elif kind == EV_TCP_TIMER:
-                            self._on_tcp_timer(host, t, pkt)
-                        elif kind == EV_TCP_CLOSE:
-                            self._on_tcp_close(host, t, pkt)
-                        progressed = True
-                        executed = True
+            if self.count_passes:
+                nexec = self._drain_lockstep(wend)
+                executed = nexec > 0
+            else:
+                progressed = True
+                while progressed:
+                    progressed = False
+                    for host in self.hosts:
+                        while (host.events
+                               and self._next_time(host) < wend):
+                            t, seq, kind, pkt = self._q_pop_min(host)
+                            self.stats[host.hid, defs.ST_EVENTS] += 1
+                            nexec += 1
+                            if kind == EV_APP:
+                                self._app(host, t, pkt)
+                            elif kind == EV_PKT:
+                                self._on_pkt(host, t, pkt)
+                            elif kind == EV_NIC_TX:
+                                self._on_tx(host, t, wend)
+                            elif kind == EV_TCP_TIMER:
+                                self._on_tcp_timer(host, t, pkt)
+                            elif kind == EV_TCP_CLOSE:
+                                self._on_tcp_close(host, t, pkt)
+                            progressed = True
+                            executed = True
             shipped = self._exchange()
             windows += 1
             if TR.ENABLED:

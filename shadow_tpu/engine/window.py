@@ -59,6 +59,18 @@ def _on_null(row, hp, sh, now, wend, pkt):
     return row
 
 
+def _scoped(label, fn):
+    """Stamp a handler with its stateflow entry name
+    (lint/stateflow.py ENTRIES) via jax.named_scope, so the passcope
+    observatory (obs/passcope.py) can attribute decoded HLO self-times
+    back to the pass. Trace-time naming only — the compiled math, the
+    shapes and the digest chain are untouched."""
+    def h(*args):
+        with jax.named_scope(label):
+            return fn(*args)
+    return h
+
+
 def _make_handlers(cfg: EngineConfig):
     """Build the event-kind switch for this scenario. Static pruning:
     app kinds not present and (when uses_tcp is False) the whole TCP
@@ -100,7 +112,8 @@ def _make_handlers(cfg: EngineConfig):
     def _on_pkt(row, hp, sh, now, wend, pkt):
         """Packet arrival at the NIC: admission, demux, protocol
         dispatch."""
-        row, keep = nic.rx_admit(row, hp, now, pkt)
+        with jax.named_scope("nic.rx_admit"):
+            row, keep = nic.rx_admit(row, hp, now, pkt)
 
         def deliver(r):
             r = r.replace(stats=r.stats.at[ST_PKTS_RECV].add(1))
@@ -110,20 +123,23 @@ def _make_handlers(cfg: EngineConfig):
             proto = pkt[P.FLAGS] & P.PROTO_MASK
 
             def tcp_path(rr):
-                slot = sock_demux(rr, pkt[P.SRC], pkt[P.SPORT],
-                                  pkt[P.DPORT], P.PROTO_TCP)
-                return jax.lax.cond(
-                    slot >= 0,
-                    lambda r2: tcp_rx(r2, hp, sh, now, slot, pkt_in),
-                    lambda r2: r2, rr)
+                with jax.named_scope("tcp.rx"):
+                    slot = sock_demux(rr, pkt[P.SRC], pkt[P.SPORT],
+                                      pkt[P.DPORT], P.PROTO_TCP)
+                    return jax.lax.cond(
+                        slot >= 0,
+                        lambda r2: tcp_rx(r2, hp, sh, now, slot, pkt_in),
+                        lambda r2: r2, rr)
 
             def udp_path(rr):
-                slot = sock_demux(rr, pkt[P.SRC], pkt[P.SPORT],
-                                  pkt[P.DPORT], P.PROTO_UDP)
-                return jax.lax.cond(
-                    slot >= 0,
-                    lambda r2: udp_deliver(r2, hp, sh, now, slot, pkt_in),
-                    lambda r2: r2, rr)
+                with jax.named_scope("udp.deliver"):
+                    slot = sock_demux(rr, pkt[P.SRC], pkt[P.SPORT],
+                                      pkt[P.DPORT], P.PROTO_UDP)
+                    return jax.lax.cond(
+                        slot >= 0,
+                        lambda r2: udp_deliver(r2, hp, sh, now, slot,
+                                               pkt_in),
+                        lambda r2: r2, rr)
 
             if not cfg.uses_tcp:
                 return udp_path(r)
@@ -132,11 +148,13 @@ def _make_handlers(cfg: EngineConfig):
         return jax.lax.cond(keep, deliver, lambda r: r, row)
 
     def _on_tx(row, hp, sh, now, wend, pkt):
-        return nic.on_tx(row, hp, sh, now, wend, pkt, qdisc=cfg.qdisc)
+        with jax.named_scope("nic.tx"):
+            return nic.on_tx(row, hp, sh, now, wend, pkt,
+                             qdisc=cfg.qdisc)
 
     if cfg.uses_tcp:
-        return [_on_null, _on_app, _on_pkt, _on_tx, on_tcp_timer,
-                on_tcp_close]
+        return [_on_null, _on_app, _on_pkt, _on_tx,
+                _scoped("tcp.timer", on_tcp_timer), on_tcp_close]
     return [_on_null, _on_app, _on_pkt, _on_tx, _on_null, _on_null]
 
 
@@ -187,11 +205,12 @@ def step_one_host(row, hp, sh, wend, cfg: EngineConfig):
     if not cfg.cpu_model:
         slot2, t2 = equeue.q_min(row)
         due = ready & (t2 == t) & (rget(row.eq_kind, slot2) == EV_NIC_TX)
-        row = jax.lax.cond(
-            due,
-            lambda r: nic.on_tx(equeue.q_clear_slot(r, slot2), hp, sh, t,
-                                wend, pkt, qdisc=cfg.qdisc),
-            lambda r: r, row)
+        with jax.named_scope("nic.tx"):
+            row = jax.lax.cond(
+                due,
+                lambda r: nic.on_tx(equeue.q_clear_slot(r, slot2), hp,
+                                    sh, t, wend, pkt, qdisc=cfg.qdisc),
+                lambda r: r, row)
 
     if cfg.cpu_model:
         # charge this event's modeled CPU cost to the busy horizon
@@ -388,7 +407,9 @@ def _drain_hot(hot, proto, hp, sh, wend, cfg: EngineConfig, pc, names):
                 h2, rung = _pass_hot(h2, proto, hp, sh, wend, cfg,
                                      names)
             else:
-                h2 = _step_hot(h2, proto, hp, sh, wend, cfg, names)
+                with jax.named_scope("dense"):
+                    h2 = _step_hot(h2, proto, hp, sh, wend, cfg,
+                                   names)
                 rung = len(ladder_of(cfg, H))  # the dense slot
             return h2, pc3.at[nw + rung].add(1)
 
@@ -432,7 +453,7 @@ def _drain_hot(hot, proto, hp, sh, wend, cfg: EngineConfig, pc, names):
             sub, n = jax.lax.while_loop(c, b, (sub, jnp.int64(0)))
             h = {f2: h[f2].at[idx].set(sub[f2]) for f2 in names}
             return h, pc2.at[slot].add(n)
-        return f
+        return _scoped(f"w{K}", f)
 
     branches = [make_win(K, i) for i, K in enumerate(wks)] + [fallback]
     rung = jnp.searchsorted(jnp.asarray(wks, jnp.int32), nact,
@@ -506,7 +527,8 @@ def _pass_hot(hot, proto, hp, sh, wend, cfg: EngineConfig, names):
     B = sparse_batch(cfg)
 
     def dense(h):
-        return _step_hot(h, proto, hp, sh, wend, cfg, names)
+        with jax.named_scope("dense"):
+            return _step_hot(h, proto, hp, sh, wend, cfg, names)
 
     def make_sparse(K):
         def sparse(h):
@@ -531,7 +553,7 @@ def _pass_hot(hot, proto, hp, sh, wend, cfg: EngineConfig, names):
             else:
                 sub = _step_hot(sub, proto, shp, sh, wend, cfg, names)
             return {f: h[f].at[idx].set(sub[f]) for f in names}
-        return sparse
+        return _scoped(f"k{K}", sparse)
 
     if not ks:
         return dense(hot), jnp.int32(0)
@@ -1109,28 +1131,38 @@ def _run_windows_impl(hosts, hp, sh, wstart, wend, cfg: EngineConfig,
         we_eff = jnp.minimum(we, sh.stop_time)
         ran = next_event_time(hosts) < we_eff  # >=1 event will execute
 
-        hosts, pc = drain_window(hosts, hp, sh, we_eff, cfg, pc)
-        hosts = update_cap_peaks(hosts)
+        # named_scope stamps carry the stateflow entry names into the
+        # compiled HLO metadata so the passcope observatory
+        # (obs/passcope.py) attributes decoded device self-times back
+        # to these passes — naming only, never math
+        with jax.named_scope("drain"):
+            hosts, pc = drain_window(hosts, hp, sh, we_eff, cfg, pc)
+        with jax.named_scope("cap_peaks"):
+            hosts = update_cap_peaks(hosts)
         ob0 = jnp.sum(hosts.ob_cnt)
         # an empty exchange is the identity: skip its sort/gather work
         # for windows that emitted nothing (common in sparse phases).
         # Single-chip only — the sharded body's collectives must run
         # uniformly on every shard.
-        hosts = jax.lax.cond(
-            jnp.any(hosts.ob_cnt > 0),
-            lambda h: exchange(h, hp, sh, cfg),
-            lambda h: h, hosts)
+        with jax.named_scope("exchange"):
+            hosts = jax.lax.cond(
+                jnp.any(hosts.ob_cnt > 0),
+                lambda h: exchange(h, hp, sh, cfg),
+                lambda h: h, hosts)
         # second sample catches the queue right after arrivals merged
-        hosts = update_cap_peaks(hosts)
+        with jax.named_scope("cap_peaks"):
+            hosts = update_cap_peaks(hosts)
         # Anti-livelock: a window that executed nothing AND shipped
         # nothing (every carried packet's destination still jammed)
         # must not re-open at the same carried arrival forever —
         # advance to the earliest queue event instead so the jammed
         # destination drains (its events execute, freeing intake).
-        progressed = ran | (jnp.sum(hosts.ob_cnt) < ob0)
-        nt = jnp.where(progressed, next_wakeup(hosts),
-                       next_event_time(hosts))
-        we2 = jnp.where(nt == SIMTIME_MAX, SIMTIME_MAX, nt + sh.min_jump)
+        with jax.named_scope("advance"):
+            progressed = ran | (jnp.sum(hosts.ob_cnt) < ob0)
+            nt = jnp.where(progressed, next_wakeup(hosts),
+                           next_event_time(hosts))
+            we2 = jnp.where(nt == SIMTIME_MAX, SIMTIME_MAX,
+                            nt + sh.min_jump)
         return hosts, nt, we2, i + 1, pc
 
     return jax.lax.while_loop(

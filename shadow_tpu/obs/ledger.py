@@ -159,6 +159,17 @@ def make_entry(scenario: str, fingerprint: str, platform: str,
         e["rtt_p50_us"] = int(summary["rtt_p50_us"])
         e["rtt_p99_us"] = int(summary["rtt_p99_us"])
         e["completion_p99_s"] = summary.get("completion_p99_s")
+    # occupancy fields (obs.passcope): the lockstep wasted-lane
+    # fraction and, on --passcope runs, the top device pass — what
+    # tools/perf_regress.py's occupancy gate compares (waste GROWING
+    # past the band is a regression like a rate drop is). Present only
+    # when the run carried the occupancy record, so pre-passcope
+    # trajectories stay untouched.
+    if "waste_frac" in summary:
+        e["waste_frac"] = summary["waste_frac"]
+        if "top_pass" in summary:
+            e["top_pass"] = summary["top_pass"]
+            e["top_pass_frac"] = summary["top_pass_frac"]
     if rep_rates:
         e["rep_rates"] = list(rep_rates)
     if rep_spread is not None:

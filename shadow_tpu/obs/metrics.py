@@ -211,6 +211,16 @@ class Registry:
         net = _assemble_indexed(
             {k[len("net."):]: v for k, v in gauges.items()
              if k.startswith("net.")})
+        # occupancy view (obs.passcope): lockstep lane utilization /
+        # waste with the per-rung gauge families folded like shards,
+        # and the device pass table of a --passcope run — assembled
+        # from their occupancy.* / passcope.* gauges
+        occupancy = _assemble_indexed(
+            {k[len("occupancy."):]: v for k, v in gauges.items()
+             if k.startswith("occupancy.")})
+        device_phases = {k[len("passcope."):]: v
+                         for k, v in gauges.items()
+                         if k.startswith("passcope.")}
         # fleet view (shadow_tpu.fleet scheduler): queue depth by
         # state plus lifetime start/retry/preempt/watchdog counters —
         # the sweep-health section of a ``fleet run --metrics`` file
@@ -233,6 +243,10 @@ class Registry:
             out["memory"] = memory
         if net:
             out["net"] = net
+        if occupancy:
+            out["occupancy"] = occupancy
+        if device_phases:
+            out["device_phases"] = device_phases
         if fleet:
             out["fleet"] = fleet
         return out

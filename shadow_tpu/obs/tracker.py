@@ -74,7 +74,8 @@ class Tracker:
     def maybe_heartbeat(self, sim_ns: int, stats: np.ndarray,
                         socks: dict | None = None,
                         hosted_rss: dict | None = None,
-                        dev_peak: int | None = None):
+                        dev_peak: int | None = None,
+                        waste: float | None = None):
         """Called after each window chunk with current cumulative stats;
         emits one heartbeat covering all interval boundaries elapsed
         since the last call (see module docstring on sampling).
@@ -97,6 +98,11 @@ class Tracker:
         the modeled per-host bytes. Process/device-global, so the
         value repeats per line by design (the [ram] family is the
         per-host view; consumers take any one).
+
+        waste: optional cumulative wasted-lane fraction of the
+        drain's gathered lanes so far (obs.passcope.occupancy).
+        Rides the [summary] line as a ``waste=`` column — the
+        lockstep-efficiency trend beside the throughput columns.
         """
         if self.interval <= 0 or sim_ns < self.next_ns:
             return
@@ -144,13 +150,17 @@ class Tracker:
         # water this way
         dev = (f"dev-peak-gib={dev_peak / (1 << 30):.3f},"
                if dev_peak else "")
+        # waste=: cumulative lockstep lane waste (obs.passcope) — the
+        # occupancy trend per heartbeat, same optional-column pattern
+        # as dev-peak-gib
+        wst = f"waste={waste:.4f}," if waste is not None else ""
         self._emit(
             f"[shadow-heartbeat] [summary] {t},"
             f"interval={span_s},"
             f"events={tot[defs.ST_EVENTS]},"
             f"pkts={tot[defs.ST_PKTS_SENT]}/{tot[defs.ST_PKTS_RECV]},"
             f"bytes={tot[defs.ST_BYTES_SENT]}/{tot[defs.ST_BYTES_RECV]},"
-            f"{dev}"
+            f"{dev}{wst}"
             f"maxrss-gib={ru.ru_maxrss / (1 << 20):.3f},"
             f"utime-min={ru.ru_utime / 60:.3f},"
             f"stime-min={ru.ru_stime / 60:.3f}")

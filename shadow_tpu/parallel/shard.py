@@ -298,17 +298,26 @@ def _windows_body(hosts, hp, sh, wstart, wend, cfg, lcfg, max_windows,
         # rows into hot_fields(cfg) and rejoins before the exchange,
         # which (like the checkpoint/digest pulls) stays whole-tree —
         # so the mesh-vs-single digest equality contract is untouched.
-        hosts, pc = drain_window(hosts, hp, sh, we_eff, cfg, pc)
-        hosts = update_cap_peaks(hosts)
+        # passcope named_scope stamps (stateflow entry names — see
+        # engine.window.win_body; the sharded exchange gets its own
+        # label, matching the stateflow ENTRIES row)
+        with jax.named_scope("drain"):
+            hosts, pc = drain_window(hosts, hp, sh, we_eff, cfg, pc)
+        with jax.named_scope("cap_peaks"):
+            hosts = update_cap_peaks(hosts)
         ob0 = jax.lax.psum(jnp.sum(hosts.ob_cnt), AXIS)
-        hosts = exchange_sharded(hosts, hp, sh, cfg, lcfg)
-        hosts = update_cap_peaks(hosts)
+        with jax.named_scope("exchange.sharded"):
+            hosts = exchange_sharded(hosts, hp, sh, cfg, lcfg)
+        with jax.named_scope("cap_peaks"):
+            hosts = update_cap_peaks(hosts)
         # anti-livelock, global decision (engine.window.win_body)
-        ob1 = jax.lax.psum(jnp.sum(hosts.ob_cnt), AXIS)
-        progressed = ran | (ob1 < ob0)
-        nt = jnp.where(progressed, next_wakeup_global(hosts),
-                       next_time_global(hosts))
-        we2 = jnp.where(nt == SIMTIME_MAX, SIMTIME_MAX, nt + sh.min_jump)
+        with jax.named_scope("advance"):
+            ob1 = jax.lax.psum(jnp.sum(hosts.ob_cnt), AXIS)
+            progressed = ran | (ob1 < ob0)
+            nt = jnp.where(progressed, next_wakeup_global(hosts),
+                           next_time_global(hosts))
+            we2 = jnp.where(nt == SIMTIME_MAX, SIMTIME_MAX,
+                            nt + sh.min_jump)
         return hosts, nt, we2, i + 1, pc
 
     hosts, ws, we, i, pc = jax.lax.while_loop(

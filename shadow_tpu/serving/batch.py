@@ -123,12 +123,14 @@ def run_batch(sims, names=None, digest_paths=None, digest_every=0,
     import jax.numpy as jnp
 
     from ..core.simtime import SIMTIME_MAX
+    from ..engine import defs
     from ..engine.sim import SimReport
     from ..engine.state import hot_fields
     from ..engine.window import (pass_labels, run_windows_batch_aot,
                                  sparse_batch)
     from ..obs import digest as DG
     from ..obs import netscope as NSC
+    from ..obs import passcope as PCOPE
 
     B = len(sims)
     assert B >= 1
@@ -322,11 +324,18 @@ def run_batch(sims, names=None, digest_paths=None, digest_every=0,
             if nsrecs[i].path:
                 network["path"] = nsrecs[i].path
             nsrecs[i].close()
+        # per-lane lockstep occupancy (obs.passcope): each lane's own
+        # pass mix against its own executed events — a skewed lane
+        # shows its waste here, not averaged into the batch
+        occ = PCOPE.occupancy(
+            cost["pass_mix"],
+            int(stats_b[i][:, defs.ST_EVENTS].sum()),
+            cost["batch"])
         reports.append(SimReport(
             stats=stats_b[i], host_names=sims[i].host_names,
             sim_time_ns=sim_ns, wall_seconds=wall,
             windows=int(total_windows[i]), capacity=capacity,
-            cost=cost, network=network))
+            cost=cost, network=network, occupancy=occ))
     return reports
 
 

@@ -1,0 +1,152 @@
+"""Hand-built xplane protobuf buffers for the passcope decoder tests.
+
+The ENCODER side of obs/passcope.py's wire decoder: enough of the
+XSpace/XPlane/XLine/XEvent (+ embedded HloProto) schema to build
+fixture traces byte-by-byte, so the decoder's varint/field walk is
+tested against known wire bytes, not against itself round-tripping.
+Also generates the committed CI fixture:
+
+    python tests/helpers/xplane_encode.py tests/data/passcope_fixture.xplane.pb
+"""
+
+from __future__ import annotations
+
+
+def varint(x: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = x & 0x7F
+        x >>= 7
+        if x:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def tag(fn: int, wt: int) -> bytes:
+    return varint((fn << 3) | wt)
+
+
+def f_varint(fn: int, v: int) -> bytes:
+    return tag(fn, 0) + varint(v)
+
+
+def f_bytes(fn: int, payload: bytes) -> bytes:
+    return tag(fn, 2) + varint(len(payload)) + payload
+
+
+def f_str(fn: int, s: str) -> bytes:
+    return f_bytes(fn, s.encode())
+
+
+# --- HloProto (the /host:metadata embed) ----------------------------------
+
+def hlo_instruction(name: str, op_name: str | None) -> bytes:
+    meta = f_str(2, op_name) if op_name else b""
+    return f_str(1, name) + (f_bytes(7, meta) if op_name else b"")
+
+
+def hlo_module(instrs) -> bytes:
+    """instrs: [(hlo_name, op_name|None)] — one computation."""
+    comp = b"".join(f_bytes(2, hlo_instruction(n, op))
+                    for n, op in instrs)
+    return f_bytes(3, comp)                    # HloModuleProto.computations
+
+
+def hlo_proto(instrs) -> bytes:
+    return f_bytes(1, hlo_module(instrs))      # HloProto.hlo_module
+
+
+# --- XSpace ----------------------------------------------------------------
+
+def xevent(mid: int, offset_ps: int, dur_ps: int) -> bytes:
+    return f_varint(1, mid) + f_varint(2, offset_ps) + f_varint(3, dur_ps)
+
+
+def xline(name: str, events) -> bytes:
+    """events: [(mid, offset_ps, dur_ps)]."""
+    return f_str(2, name) + b"".join(
+        f_bytes(4, xevent(*e)) for e in events)
+
+
+def xevent_metadata(name: str = "", stats_bytes: bytes = b"") -> bytes:
+    out = f_str(2, name) if name else b""
+    if stats_bytes:
+        out += f_bytes(5, f_bytes(6, stats_bytes))  # stats -> bytes_value
+    return out
+
+
+def xplane(name: str, meta: dict, lines) -> bytes:
+    """meta: {mid: metadata_bytes}; lines: [line_bytes]."""
+    out = f_str(2, name)
+    for mid, m in meta.items():
+        out += f_bytes(4, f_varint(1, mid) + f_bytes(2, m))
+    for ln in lines:
+        out += f_bytes(3, ln)
+    return out
+
+
+def xspace(planes) -> bytes:
+    return b"".join(f_bytes(1, p) for p in planes)
+
+
+# --- the CI fixture --------------------------------------------------------
+
+def make_fixture() -> bytes:
+    """One traced chunk, numbers chosen for exact assertions
+    (obs.passcope.self_check):
+
+    device self-times (ms): fusion.1=40 (drain/w512), sort.2=30
+    (exchange, under w512 via the window gather), custom-call.3=20
+    (drain/w512/nic.rx_admit/tcp.rx), reduce.4=5 (advance),
+    copy.5=3 (no scope -> residual), thunk parent glue=2 (runtime
+    scaffolding, excluded from the denominator) -> HLO total 98,
+    attributed 95/98.
+    """
+    ms = 10**9  # picoseconds per millisecond
+    instrs = [
+        ("fusion.1", "jit(run_windows)/jit(main)/drain/w512/while/body/gather"),
+        ("sort.2", "jit(run_windows)/jit(main)/drain/w512/exchange/sort"),
+        ("custom-call.3",
+         "jit(run_windows)/jit(main)/drain/w512/nic.rx_admit/tcp.rx/fusion"),
+        ("reduce.4", "jit(run_windows)/jit(main)/advance/reduce"),
+        ("copy.5", None),            # no scope -> exercises the residual
+    ]
+    meta_plane = xplane(
+        "/host:metadata",
+        {1: xevent_metadata("jit_run_windows(1)", hlo_proto(instrs))},
+        [])
+    op_meta = {
+        10: xevent_metadata("ThunkExecutor::Execute"),
+        11: xevent_metadata("fusion.1"),
+        12: xevent_metadata("sort.2"),
+        13: xevent_metadata("custom-call.3"),
+        14: xevent_metadata("reduce.4"),
+        15: xevent_metadata("copy.5"),
+    }
+    # one parent thunk span [0,100ms) with nested op spans; parent
+    # SELF time = 100-40-30-20-5-3 = 2ms of glue -> runtime bucket
+    # (the "::" name rule); copy.5 is a real HLO op with no scope
+    # -> the labeled residual
+    events = [
+        (10, 0 * ms, 100 * ms),
+        (11, 0 * ms, 40 * ms),
+        (12, 40 * ms, 30 * ms),
+        (13, 70 * ms, 20 * ms),
+        (14, 90 * ms, 5 * ms),
+        (15, 95 * ms, 3 * ms),
+    ]
+    cpu_plane = xplane(
+        "/host:CPU", op_meta,
+        [xline("tf_XLATfrtCpuClient/271", events),
+         xline("python-thread", [(10, 0, 50)])])  # non-XLA: ignored
+    return xspace([meta_plane, cpu_plane])
+
+
+if __name__ == "__main__":
+    import sys
+    out = sys.argv[1]
+    with open(out, "wb") as f:
+        f.write(make_fixture())
+    print(f"wrote {out} ({len(make_fixture())} bytes)")

@@ -17,6 +17,11 @@ optional trailing ``rss=`` (hosted child resident set) and ``dev=``
 (device-buffer watermark, obs.memscope) columns — parsed into fixed
 ``rss``/``dev`` CSV columns, empty when a line doesn't carry them.
 
+``--occupancy`` extracts the per-heartbeat occupancy trend from the
+[summary] family: ``time,interval,events,waste`` where ``waste`` is
+the optional ``waste=`` column (the cumulative lockstep wasted-lane
+fraction, obs.passcope) — empty on runs predating the observatory.
+
 ``--netscope`` converts a network observatory time-series stream
 (obs.netscope JSONL — ``--netscope FILE`` on a run) into CSV: one row
 per chunk record with the interval stat deltas and each kind's
@@ -40,6 +45,8 @@ FIELDS = ["time", "host", "interval", "events", "pkts_sent",
 
 RAM_FIELDS = ["time", "host", "alloc", "dealloc", "total", "sockets",
               "rss", "dev"]
+
+OCC_FIELDS = ["time", "interval", "events", "waste"]
 
 
 def node_rows(lines):
@@ -69,6 +76,27 @@ def ram_rows(lines):
             if eq and k in extra:
                 extra[k] = v
         rows.append(fixed + [extra["rss"], extra["dev"]])
+    return rows
+
+
+def occupancy_rows(lines):
+    """[summary] heartbeat lines -> rows aligned with OCC_FIELDS. The
+    ``waste=`` column is optional per line (only runs with the
+    pass-time observatory's occupancy accounting carry it, like
+    ``dev-peak-gib=``) — absent values become empty cells."""
+    rows = []
+    for line in lines:
+        m = SUMMARY_RE.search(line)
+        if not m:
+            continue
+        cols = m.group(1).split(",")
+        kv = {}
+        for c in cols[1:]:
+            k, eq, v = c.partition("=")
+            if eq:
+                kv[k] = v
+        rows.append([cols[0], kv.get("interval", ""),
+                     kv.get("events", ""), kv.get("waste", "")])
     return rows
 
 
@@ -124,6 +152,10 @@ def main():
     ap.add_argument("--ram", action="store_true",
                     help="emit the [ram] family (alloc/dealloc/total/"
                          "sockets + optional rss=/dev= columns)")
+    ap.add_argument("--occupancy", action="store_true",
+                    help="emit the per-heartbeat occupancy trend "
+                         "(time,interval,events,waste from the "
+                         "[summary] family's waste= column)")
     ap.add_argument("--netscope", default=None, metavar="JSONL",
                     help="convert a netscope time-series stream to "
                          "CSV instead of parsing a heartbeat log")
@@ -149,6 +181,10 @@ def main():
                 w = csv.writer(out)
                 w.writerow(RAM_FIELDS)
                 w.writerows(ram_rows(f))
+            elif args.occupancy:
+                w = csv.writer(out)
+                w.writerow(OCC_FIELDS)
+                w.writerows(occupancy_rows(f))
             else:
                 w = csv.writer(out)
                 w.writerow(FIELDS)

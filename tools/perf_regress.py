@@ -25,6 +25,12 @@ Policy (docs/performance.md):
   (the direction flips; the band policy is the same). Entries without
   the field (pre-memscope trajectories) neither gate nor feed a
   baseline, so the committed history stays untouched;
+- OCCUPANCY gate (obs.passcope): entries carrying ``waste_frac``
+  (the run's lockstep wasted-lane fraction) fail when waste grows
+  past ``max(median * (1 + band), median + 0.05)`` — the absolute
+  floor keeps near-zero waste medians from making the multiplicative
+  band hypersensitive. Same direction-flipped policy as memory;
+  pre-passcope entries neither gate nor feed a baseline;
 - groups with fewer than ``--min-history + 1`` entries are reported
   as "insufficient history", never failed — but a candidate whose
   rate is zero/absent against REAL history is a failed comparison
@@ -154,6 +160,37 @@ def check(entries, band=DEFAULT_BAND, min_history=1, candidate=None):
                                    if mbase else None),
             })
             any_reg = any_reg or mem_reg
+        # occupancy gate (obs.passcope, docs/performance.md): lane
+        # waste GROWING past the band is a regression like a rate
+        # drop (direction flipped, same band policy as memory).
+        # Waste medians sit near 0 on healthy dense scenarios, where
+        # a multiplicative band is hypersensitive (0.01 -> 0.012 is
+        # noise, not a regression), so the threshold also gets an
+        # absolute +0.05 floor. Entries without the field
+        # (pre-passcope trajectories) neither gate nor feed a
+        # baseline.
+        cw = cand.get("waste_frac")
+        wastes = [w for w in (e.get("waste_frac") for e in hist
+                              if not compile_bound(e))
+                  if w is not None]
+        if cw is not None and len(wastes) >= min_history:
+            wbase = median(wastes)
+            wspread = ((max(wastes) - min(wastes)) / wbase
+                       if len(wastes) >= 2 and wbase else 0.0)
+            wband = min(max(band, wspread), MAX_BAND)
+            wthresh = max(wbase * (1.0 + wband), wbase + 0.05)
+            waste_reg = cw > wthresh
+            row.update({
+                "occ_status": "REGRESSION" if waste_reg else "ok",
+                "waste_frac": round(cw, 4),
+                "occ_baseline": round(wbase, 4),
+                "occ_band": round(wband, 3),
+                "occ_threshold": round(wthresh, 4),
+                "occ_delta": round(cw - wbase, 4),
+            })
+            if cand.get("top_pass"):
+                row["top_pass"] = cand["top_pass"]
+            any_reg = any_reg or waste_reg
         rates = [r for r in (LG.entry_rate(e) for e in hist
                              if not compile_bound(e)) if r]
         if len(rates) < min_history or not rates:
@@ -255,6 +292,15 @@ def main(argv):
                       f"{r['mem_baseline']} (band "
                       f"{r['mem_band'] * 100:.0f}%, delta "
                       f"{r['mem_delta_frac'] * 100:+.1f}%)")
+            if r.get("occ_status"):
+                omark = "!!" if r["occ_status"] == "REGRESSION" else "ok"
+                top = (f", top pass {r['top_pass']}"
+                       if r.get("top_pass") else "")
+                print(f"   {omark} occupancy: waste "
+                      f"{r['waste_frac']} vs median "
+                      f"{r['occ_baseline']} (threshold "
+                      f"{r['occ_threshold']}, delta "
+                      f"{r['occ_delta']:+.4f}{top})")
         if any_reg:
             print("PERF REGRESSION — see rows marked !! "
                   "(docs/performance.md for the protocol)")

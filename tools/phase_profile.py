@@ -21,6 +21,12 @@ what fraction of the hardware bound the TCP tier is"): where the
 reference self-times its scheduler barriers (shd-scheduler.c:250-252),
 the TPU build times its compiled phases.
 
+For attribution INSIDE one compiled window program — per-pass device
+self-times keyed by the stateflow entry names, without manual
+single-stepping — use the pass-time observatory instead: run with
+``--passcope`` (obs.passcope, docs/performance.md "Reading the pass
+table") or decode a raw trace with tools/xplane_profile.py.
+
 Usage:
   python tools/phase_profile.py socks10k [--n 10000] [--stop 20]
       [--warm-s 5] [--probe-windows 30] [--runahead-ms 10] [--cpu]

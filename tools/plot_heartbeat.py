@@ -11,6 +11,9 @@ Produces per-metric time series: one line per host plus the
 aggregate. ``--netscope`` appends the network observatory panels
 (obs.netscope): per-kind sample counts and the exact p50/p99
 percentile curves over simulated time, from the run's JSONL stream.
+``--occupancy`` appends the lockstep-waste panel (obs.passcope):
+the cumulative wasted-lane fraction per heartbeat, from the
+[summary] family's ``waste=`` column.
 """
 
 import argparse
@@ -64,6 +67,16 @@ def load_netscope(path):
     return series
 
 
+def load_occupancy(log_path):
+    """-> [(t_s, waste_frac)] via the parser's --occupancy CSV; rows
+    without the waste= column (pre-passcope runs) are skipped."""
+    out = subprocess.run(
+        [sys.executable, PARSER, "--occupancy", log_path],
+        capture_output=True, text=True, check=True).stdout
+    return [(float(r["time"]), float(r["waste"]))
+            for r in csv.DictReader(io.StringIO(out)) if r["waste"]]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("log")
@@ -74,6 +87,10 @@ def main():
                     help="append network observatory panels from this "
                          "netscope stream (per-kind sample counts + "
                          "p50/p99 curves)")
+    ap.add_argument("--occupancy", action="store_true",
+                    help="append the lockstep-waste panel (the "
+                         "[summary] family's waste= column, "
+                         "obs.passcope)")
     args = ap.parse_args()
 
     series = load(args.log)
@@ -81,7 +98,9 @@ def main():
     ns = load_netscope(args.netscope) if args.netscope else None
     ns_kinds = ([k for k, pts in ns.items()
                  if any(n for _, n, _, _ in pts)] if ns else [])
-    n_panels = len(metrics) + (2 if ns_kinds else 0)
+    occ = load_occupancy(args.log) if args.occupancy else []
+    n_panels = (len(metrics) + (2 if ns_kinds else 0)
+                + (1 if occ else 0))
     fig, axes = plt.subplots(n_panels, 1,
                              figsize=(8, 2.2 * n_panels),
                              sharex=True, squeeze=False)
@@ -118,6 +137,17 @@ def main():
         ax_p.legend(loc="upper left", fontsize=6, ncol=2)
         for ax in (ax_n, ax_p):
             ax.tick_params(labelsize=7)
+    if occ:
+        # lockstep-waste trend (obs.passcope): cumulative wasted-lane
+        # fraction per heartbeat — a curve bending UP mid-run names
+        # when the drain's rung selection started overshooting
+        ax_o = axes[-1, 0]
+        ax_o.plot([t for t, _ in occ], [w for _, w in occ],
+                  color="firebrick", linewidth=1.4, label="waste")
+        ax_o.set_ylim(0, 1)
+        ax_o.set_ylabel("lane waste frac", fontsize=8)
+        ax_o.legend(loc="upper left", fontsize=7)
+        ax_o.tick_params(labelsize=7)
     axes[-1, 0].set_xlabel("simulated time (s)", fontsize=8)
     fig.tight_layout()
     fig.savefig(args.out)

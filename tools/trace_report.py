@@ -13,16 +13,65 @@ Two views, answering "where does the wall time go":
    cost and how throughput evolved over the run (the in-run
    counterpart of SimReport.speedup, which only reports the mean).
 
+With ``--passcope DIR`` (or when ``<trace-dir>/passcope.json`` from a
+``--passcope`` run sits next to the trace) the DEVICE pass table the
+pass-time observatory decoded (obs.passcope: per-pass device time
+keyed by the stateflow entry names, plus lockstep occupancy) renders
+under the host span table — both halves of "where did the time go"
+in one report.
+
 Pure stdlib, no jax: runs headless on any trace file in milliseconds.
 
 Usage:
   python tools/trace_report.py trace.json [--top 15] [--json]
+      [--passcope DIR]
 """
 
 import argparse
 import json
+import os
 import sys
 from collections import defaultdict
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_passcope_mod():
+    """obs/passcope.py by file path (no shadow_tpu/jax import — the
+    headless-tools convention, tools/perf_report.py's idiom)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_passcope", os.path.join(REPO, "shadow_tpu", "obs",
+                                  "passcope.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_passcope(trace_path, passcope_dir=None):
+    """The decoded device pass table of a --passcope run: explicit
+    DIR, else auto-detected as passcope.json beside the trace file.
+    -> the {"device_phases", "occupancy"} dict or None."""
+    cands = []
+    if passcope_dir:
+        cands.append(os.path.join(passcope_dir, "passcope.json"))
+        cands.append(passcope_dir)  # a passcope.json path directly
+    else:
+        cands.append(os.path.join(
+            os.path.dirname(os.path.abspath(trace_path)),
+            "passcope.json"))
+    for p in cands:
+        if os.path.isfile(p):
+            try:
+                with open(p) as f:
+                    return json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                raise SystemExit(f"trace_report: {p}: {e}")
+    if passcope_dir:
+        raise SystemExit(
+            f"trace_report: no passcope.json under {passcope_dir!r} "
+            "(run with --passcope to produce one)")
+    return None
 
 
 def load_events(path):
@@ -129,11 +178,16 @@ def main(argv=None):
                     help="span names to show (by self-time)")
     ap.add_argument("--json", action="store_true",
                     help="print the report as one JSON object")
+    ap.add_argument("--passcope", default=None, metavar="DIR",
+                    help="merge the device pass table from this "
+                         "--passcope run dir (default: auto-detect "
+                         "passcope.json beside the trace)")
     args = ap.parse_args(argv)
 
     events, dropped = load_events(args.trace)
     agg = self_times(events)
     chunks = chunk_rows(events)
+    pscope = load_passcope(args.trace, args.passcope)
     if dropped:
         print(f"WARNING: trace truncated — {dropped} spans dropped at "
               "the recorder's cap (obs.trace.MAX_EVENTS); totals "
@@ -147,8 +201,12 @@ def main(argv=None):
         key=lambda r: -r["self_ms"])[:args.top]
 
     if args.json:
-        print(json.dumps({"spans": spans, "chunks": chunks,
-                          "dropped_events": dropped}))
+        out = {"spans": spans, "chunks": chunks,
+               "dropped_events": dropped}
+        if pscope is not None:
+            out["device_phases"] = pscope.get("device_phases")
+            out["occupancy"] = pscope.get("occupancy")
+        print(json.dumps(out))
         return 0
 
     print("== top spans by self-time ==")
@@ -181,6 +239,14 @@ def main(argv=None):
               f"{sum(r['windows'] for r in chunks):>8} {tot_ev:>9} "
               f"{tot_wall / tot_sim if tot_sim else 0:>11.4f} "
               f"{tot_ev / tot_wall if tot_wall else 0:>10.0f}")
+
+    if pscope is not None:
+        # the device half: where the DEVICE time went per pass,
+        # under the host span table above (obs.passcope)
+        PC = _load_passcope_mod()
+        print()
+        print(PC.format_report(pscope.get("device_phases") or None,
+                               pscope.get("occupancy") or None))
     return 0
 
 

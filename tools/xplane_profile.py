@@ -12,15 +12,22 @@ schema is tensorflow/tsl's xplane.proto) and prints the top ops by
 total self duration per plane, which names the hot HLOs (fusions,
 copies, sorts, scatters) exactly.
 
+The wire decoder lives in shadow_tpu/obs/passcope.py (the pass-time
+observatory promoted it to an importable module that also maps HLO
+self-times back to the named_scope pass labels); this tool is the
+thin CLI over it — loaded BY FILE PATH so it works with no jax
+installed (the headless-tools convention). For the per-pass table
+keyed by stateflow entry names, run the engine with ``--passcope``
+or decode a trace dir with ``tools/trace_report.py --passcope``.
+
 Usage:
   python tools/xplane_profile.py socks10k [--n ...] [--warm-s 6]
       [--trace-windows 16] [--runahead-ms 10] [--top 40] [--cpu]
+  python tools/xplane_profile.py --self-check   # CI fixture decode
 """
 
 from __future__ import annotations
 
-import collections
-import glob
 import json
 import os
 import sys
@@ -29,109 +36,26 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-# --- minimal protobuf wire decoding ---------------------------------------
-
-def _varint(buf, i):
-    x = 0
-    s = 0
-    while True:
-        b = buf[i]
-        i += 1
-        x |= (b & 0x7F) << s
-        if not b & 0x80:
-            return x, i
-        s += 7
+def _load_passcope():
+    """Load obs/passcope.py by file path — no shadow_tpu package
+    import (which would pull in jax; this tool must run headless)."""
+    import importlib.util
+    path = os.path.join(REPO, "shadow_tpu", "obs", "passcope.py")
+    spec = importlib.util.spec_from_file_location("_passcope", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
-def _fields(buf):
-    """Yield (field_number, wire_type, value) over a message buffer.
-    value: int for varint(0)/fixed(1,5), memoryview for bytes(2)."""
-    i, n = 0, len(buf)
-    while i < n:
-        key, i = _varint(buf, i)
-        fn, wt = key >> 3, key & 7
-        if wt == 0:
-            v, i = _varint(buf, i)
-        elif wt == 2:
-            ln, i = _varint(buf, i)
-            v = buf[i:i + ln]
-            i += ln
-        elif wt == 1:
-            v = int.from_bytes(buf[i:i + 8], "little")
-            i += 8
-        elif wt == 5:
-            v = int.from_bytes(buf[i:i + 4], "little")
-            i += 4
-        else:  # groups unsupported/absent in xplane
-            raise ValueError(f"wire type {wt}")
-        yield fn, wt, v
-
-
-def parse_xspace(path):
-    """-> [(plane_name, {op_name: total_duration_ps})]"""
-    buf = memoryview(open(path, "rb").read())
-    planes = []
-    for fn, wt, v in _fields(buf):
-        if fn == 1 and wt == 2:             # XSpace.planes
-            planes.append(_parse_plane(v))
-    return planes
-
-
-def _parse_plane(buf):
-    name = ""
-    meta = {}                                # id -> event name
-    lines = []
-    for fn, wt, v in _fields(buf):
-        if fn == 2 and wt == 2:              # XPlane.name
-            name = bytes(v).decode("utf-8", "replace")
-        elif fn == 3 and wt == 2:            # XPlane.lines
-            lines.append(v)
-        elif fn == 4 and wt == 2:            # XPlane.event_metadata (map)
-            k, m = None, None
-            for fn2, wt2, v2 in _fields(v):
-                if fn2 == 1:
-                    k = v2
-                elif fn2 == 2 and wt2 == 2:
-                    m = v2
-            if k is not None and m is not None:
-                mname = ""
-                for fn3, wt3, v3 in _fields(m):
-                    if fn3 == 2 and wt3 == 2:  # XEventMetadata.name
-                        mname = bytes(v3).decode("utf-8", "replace")
-                meta[k] = mname
-    # Aggregate PER LINE: device traces nest container ops (module,
-    # while, conditional) on separate lines above the leaf-op line, so
-    # a single merged counter double-counts bodies inside containers
-    # and conds "cost" their whole branch. Per-line tops let the
-    # reader see both views: containers (where the window time sits
-    # structurally) and leaves (which HLOs actually burn it).
-    per_line = []                            # (line_name, durs, counts)
-    for lbuf in lines:
-        lname = ""
-        durs = collections.Counter()
-        counts = collections.Counter()
-        for fn, wt, v in _fields(lbuf):
-            if fn == 2 and wt == 2:          # XLine.name
-                lname = bytes(v).decode("utf-8", "replace")
-            # this build writes XLine.events at field 4 (older schema
-            # revisions used 6 — accept both)
-            elif fn in (4, 6) and wt == 2:   # XLine.events
-                mid, dur = None, 0
-                for fn2, wt2, v2 in _fields(v):
-                    if fn2 == 1:             # XEvent.metadata_id
-                        mid = v2
-                    elif fn2 == 3:           # XEvent.duration_ps
-                        dur = v2
-                if mid is not None:
-                    key = meta.get(mid, f"#{mid}")
-                    durs[key] += dur
-                    counts[key] += 1
-        if durs:
-            per_line.append((lname, dict(durs), dict(counts)))
-    return name, per_line
+_PC = _load_passcope()
+# re-exported: tests and older callers import the decoder from here
+_varint = _PC._varint
+_fields = _PC._fields
+parse_xspace = _PC.parse_xspace
 
 
 def aggregate(trace_dir, top=40):
+    import glob
     out = []
     for path in sorted(glob.glob(
             os.path.join(trace_dir, "**", "*.xplane.pb"),
@@ -189,7 +113,7 @@ def capture(name, n=None, warm_s=6.0, trace_windows=16, runahead_ms=0,
 def main(argv):
     import argparse
     ap = argparse.ArgumentParser()
-    ap.add_argument("config")
+    ap.add_argument("config", nargs="?", default=None)
     ap.add_argument("--n", type=int, default=None)
     ap.add_argument("--warm-s", type=float, default=6.0)
     ap.add_argument("--trace-windows", type=int, default=16)
@@ -199,10 +123,20 @@ def main(argv):
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--parse-only", default=None,
                     help="skip capture; aggregate this trace dir")
+    ap.add_argument("--self-check", action="store_true",
+                    help="decode the committed fixture trace and "
+                         "assert the exact pass table / occupancy "
+                         "numbers (obs.passcope.self_check — the CI "
+                         "step; stdlib only, no jax needed)")
     args = ap.parse_args(argv)
+    if args.self_check:
+        _PC.self_check()
+        return
     if args.parse_only:
         print(json.dumps(aggregate(args.parse_only, args.top), indent=1))
         return
+    if args.config is None:
+        ap.error("config is required unless --parse-only/--self-check")
     if args.cpu:
         os.environ["PALLAS_AXON_POOL_IPS"] = ""
         os.environ["JAX_PLATFORMS"] = "cpu"
